@@ -1,0 +1,31 @@
+"""keystone_tpu — a TPU-native framework with the capabilities of KeystoneML.
+
+A type-safe Pipeline DAG of Transformer/Estimator nodes whose optimizer lowers
+fused operator chains to single XLA computations; a distributed linear-algebra
+layer built on ``jax.sharding`` with XLA collectives over ICI/DCN in place of
+Spark ``treeAggregate``/shuffle; operator libraries for image featurization,
+NLP, statistics, and large-scale linear learning; and the canonical end-to-end
+pipelines (MNIST, Newsgroups, CIFAR, TIMIT, ImageNet).
+
+Reference: amplab/keystone (KeystoneML, Scala/Spark). See SURVEY.md for the
+structural analysis this rebuild follows. Reference paths cited in docstrings
+are ``[unverified]`` (the reference mount was empty; see SURVEY.md provenance).
+"""
+
+from keystone_tpu.workflow import (
+    Estimator,
+    LabelEstimator,
+    Pipeline,
+    PipelineDataset,
+    Transformer,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Transformer",
+    "Estimator",
+    "LabelEstimator",
+    "Pipeline",
+    "PipelineDataset",
+]

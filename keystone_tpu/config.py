@@ -1,0 +1,40 @@
+"""Global configuration for keystone_tpu.
+
+The reference computes in float64 via Breeze/netlib BLAS. On TPU, float64 is
+emulated and slow; the MXU wants float32 (with bfloat16 inputs where quality
+permits). We default to float32 end-to-end and expose a switch for tests that
+compare against float64 NumPy oracles on CPU.
+
+Ref: build.sbt (Breeze/netlib deps) [unverified].
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Config:
+    # Default dtype for dense compute (solvers, featurization).
+    default_dtype: str = "float32"
+    # dtype used for matmul accumulation-sensitive reductions (grams). XLA on
+    # TPU accumulates fp32; this is the storage dtype of gram matrices.
+    accum_dtype: str = "float32"
+    # Mesh axis name used for data (row) parallelism throughout.
+    data_axis: str = "data"
+    # Mesh axis name used for model (feature-block) parallelism.
+    model_axis: str = "model"
+    # HBM budget (bytes) assumed by the auto-caching rule when no device is
+    # queried. v5e = 16 GiB; leave headroom for XLA scratch.
+    hbm_budget_bytes: int = 12 * (1 << 30)
+    # Whether executor fuses jittable transformer chains into one XLA program.
+    # Disabled by KEYSTONE_NO_FUSE set to a truthy value (anything except
+    # "", "0", "false", "no").
+    fuse_chains: bool = field(
+        default_factory=lambda: os.environ.get("KEYSTONE_NO_FUSE", "").lower()
+        in ("", "0", "false", "no")
+    )
+
+
+config = Config()

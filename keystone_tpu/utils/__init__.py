@@ -1,0 +1,4 @@
+from keystone_tpu.utils.stats import about_eq
+from keystone_tpu.utils.mesh import default_mesh, data_sharding, replicated_sharding
+
+__all__ = ["about_eq", "default_mesh", "data_sharding", "replicated_sharding"]

@@ -1,0 +1,37 @@
+from keystone_tpu.workflow.graph import Graph, GraphId, NodeId, SourceId
+from keystone_tpu.workflow.pipeline import (
+    Estimator,
+    FusedTransformer,
+    LabelEstimator,
+    Pipeline,
+    PipelineDataset,
+    Transformer,
+)
+from keystone_tpu.workflow.executor import GraphExecutor, PipelineEnv
+from keystone_tpu.workflow.optimizer import (
+    ChainFusionRule,
+    EquivalentNodeMergeRule,
+    Optimizer,
+    Rule,
+    default_optimizer,
+)
+
+__all__ = [
+    "Graph",
+    "GraphId",
+    "NodeId",
+    "SourceId",
+    "Transformer",
+    "FusedTransformer",
+    "Estimator",
+    "LabelEstimator",
+    "Pipeline",
+    "PipelineDataset",
+    "PipelineEnv",
+    "GraphExecutor",
+    "Optimizer",
+    "Rule",
+    "ChainFusionRule",
+    "EquivalentNodeMergeRule",
+    "default_optimizer",
+]

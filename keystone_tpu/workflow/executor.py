@@ -1,0 +1,183 @@
+"""Graph executor + session environment.
+
+Ref: src/main/scala/workflow/{GraphExecutor,PipelineEnv,Prefix}.scala
+[unverified]. The executor walks the DAG in topological order, memoizing
+values by *structural prefix hash* so that:
+
+- duplicated subgraphs (created by composition's copy-on-instantiate) are
+  computed once per execution;
+- estimator fits are memoized across executions in ``PipelineEnv.fit_cache``
+  (the reference's fitted-prefix state reuse);
+- values marked by the auto-caching rule persist in ``node_cache``.
+
+Where the reference's executor schedules Spark jobs per stage, ours executes
+operators whose jittable chains were pre-fused into single XLA computations by
+the optimizer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+from keystone_tpu.workflow.graph import Graph, GraphId, NodeId, SourceId, structural_hash
+from keystone_tpu.workflow.operators import (
+    DelegatingOperator,
+    EstimatorOperator,
+    Operator,
+    TransformerOperator,
+)
+
+
+class UnboundSourceError(RuntimeError):
+    pass
+
+
+def _no_sources(sid: SourceId):
+    raise UnboundSourceError(
+        f"graph has unbound source {sid!r}; apply the pipeline to data first"
+    )
+
+
+class GraphExecutor:
+    def __init__(self, env: "PipelineEnv"):
+        self.env = env
+
+    def execute_many(
+        self, graph: Graph, targets: Sequence[GraphId]
+    ) -> Dict[GraphId, Any]:
+        """Evaluate all targets in one pass with shared memoization."""
+        for t in targets:
+            if isinstance(t, SourceId):
+                _no_sources(t)
+        hmemo: Dict[GraphId, int] = {}
+        values: Dict[GraphId, Any] = {}
+        by_hash: Dict[int, Any] = {}
+        order = graph.reachable(targets)
+        for nid in order:
+            h = structural_hash(graph, nid, _no_sources, hmemo)
+            op = graph.operators[nid]
+            if h in by_hash:
+                values[nid] = by_hash[h]
+                continue
+            if isinstance(op, EstimatorOperator) and h in self.env.fit_cache:
+                values[nid] = by_hash[h] = self.env.fit_cache[h][0]
+                continue
+            if h in self.env.node_cache:
+                values[nid] = by_hash[h] = self.env.node_cache[h][0]
+                continue
+            deps = [values[d] for d in graph.dependencies[nid]]
+            out = op.execute(deps)
+            values[nid] = by_hash[h] = out
+            if isinstance(op, EstimatorOperator):
+                self._cache_fit(graph, nid, h, op, out)
+            if getattr(op, "persist", False):
+                self.env.node_cache[h] = (out, self._prefix_pins(graph, nid))
+        return values
+
+    def _cache_fit(self, graph: Graph, nid: NodeId, h: int, op, out) -> None:
+        """Cache a fitted transformer, scoped to the estimator's lifetime.
+
+        The entry pins every prefix object except the estimator itself, which
+        is held weakly with an eviction callback: when the user drops the
+        estimator (and its pipelines), the entry — and the training data it
+        pins — is freed, and the now-recyclable ids can never produce a stale
+        hash hit because eviction precedes reuse.
+        """
+        import weakref
+
+        estimator = op.estimator
+        pins = tuple(
+            p for p in self._prefix_pins(graph, nid) if p is not estimator
+        )
+        fit_cache = self.env.fit_cache
+        try:
+            keeper: Any = weakref.ref(
+                estimator, lambda _ref, h=h: fit_cache.pop(h, None)
+            )
+        except TypeError:  # not weak-referenceable: pin strongly
+            keeper = estimator
+        fit_cache[h] = (out, pins, keeper)
+
+    @staticmethod
+    def _prefix_pins(graph: Graph, nid: NodeId) -> tuple:
+        """Strong references to every object whose id() feeds the prefix hash
+        of ``nid``. While a cache entry holds its pins, CPython cannot recycle
+        those ids, so a hash hit always means the same live objects."""
+        pins = []
+        for n in graph.reachable([nid]):
+            pins.extend(graph.operators[n].pinned_objects())
+        return tuple(pins)
+
+    def execute(self, graph: Graph, target: GraphId) -> Any:
+        return self.execute_many(graph, [target])[target]
+
+    def fit_estimators(self, graph: Graph, sink: GraphId) -> Graph:
+        """Force every estimator reachable from ``sink`` and rewrite the graph
+        so each DelegatingOperator becomes a concrete TransformerOperator.
+
+        This is the `Pipeline.fit` lowering: the result graph is
+        transformer-only on the inference path.
+        """
+        graph = self.env.optimizer.execute(graph, [sink])
+        order = graph.reachable([sink])
+        est_nodes = [
+            n for n in order if isinstance(graph.operators[n], EstimatorOperator)
+        ]
+        if est_nodes:
+            fitted = self.execute_many(graph, est_nodes)
+        else:
+            fitted = {}
+        ops = dict(graph.operators)
+        dps = dict(graph.dependencies)
+        for nid in order:
+            op = graph.operators[nid]
+            if isinstance(op, DelegatingOperator):
+                est_dep, input_dep = graph.dependencies[nid]
+                if est_dep in fitted:
+                    ops[nid] = TransformerOperator(fitted[est_dep])
+                    dps[nid] = (input_dep,)
+        # Prune: drops the now-unreferenced estimator nodes and their training
+        # DatasetOperator subtrees so a fitted pipeline doesn't pin the
+        # training set in memory.
+        return Graph(ops, dps).pruned([sink])
+
+
+class PipelineEnv:
+    """Session state: optimizer, executor, and persistent caches.
+
+    Ref: workflow/PipelineEnv.scala [unverified].
+    """
+
+    _instance: Optional["PipelineEnv"] = None
+
+    def __init__(self):
+        from keystone_tpu.workflow.optimizer import default_optimizer
+
+        self.optimizer = default_optimizer()
+        self.executor = GraphExecutor(self)
+        # structural hash of estimator node -> fitted Transformer
+        self.fit_cache: Dict[int, Any] = {}
+        # structural hash -> persisted value (auto-cache rule / Cacher nodes)
+        self.node_cache: Dict[int, Any] = {}
+
+    @classmethod
+    def get(cls) -> "PipelineEnv":
+        if cls._instance is None:
+            cls._instance = PipelineEnv()
+        return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._instance = None
+
+    def clear_caches(self) -> None:
+        """Drop all memoized fits and persisted values (frees pinned data)."""
+        self.fit_cache.clear()
+        self.node_cache.clear()
+
+    def optimize_and_execute(self, graph: Graph, sink: GraphId) -> Any:
+        g = self.optimizer.execute(graph, [sink])
+        return self.executor.execute(g, sink)
+
+    def execute(self, graph: Graph, sink: GraphId) -> Any:
+        return self.executor.execute(graph, sink)

@@ -1,0 +1,158 @@
+"""Graph operators — the node payloads of the pipeline DAG.
+
+Ref: src/main/scala/workflow/Operator.scala (TransformerOperator,
+EstimatorOperator, DelegatingOperator, DatasetOperator, DatumOperator)
+[unverified]. Expressions in the reference are lazy wrappers over RDDs; here
+an "expression" value is simply a batch (jax/numpy array or host sequence), a
+single datum, or a fitted Transformer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+import jax.numpy as jnp
+
+
+class Operator:
+    """Base operator. ``execute`` consumes evaluated dependency values."""
+
+    def execute(self, deps: Sequence[Any]) -> Any:
+        raise NotImplementedError
+
+    def signature(self) -> Any:
+        """Identity key used for structural prefix hashing."""
+        return ("op", id(self))
+
+    def prefix_hash(self, dep_hashes) -> int:
+        """Structural hash of this node given its dependency prefix hashes."""
+        return hash((self.signature(), tuple(dep_hashes)))
+
+    def pinned_objects(self):
+        """Objects whose id() feeds this operator's signature. Cache entries
+        keyed on prefixes through this node hold strong references to these so
+        CPython id reuse can never alias a stale cache entry."""
+        return ()
+
+    def label(self) -> str:
+        return type(self).__name__
+
+
+class DatasetOperator(Operator):
+    """A constant batch of data spliced into the graph (the RDD analog)."""
+
+    def __init__(self, data: Any):
+        self.data = data
+
+    def execute(self, deps):
+        return self.data
+
+    def signature(self):
+        return ("dataset", id(self.data))
+
+    def pinned_objects(self):
+        return (self.data,)
+
+    def label(self):
+        return "Dataset"
+
+
+class DatumOperator(Operator):
+    """A single constant datum."""
+
+    def __init__(self, datum: Any):
+        self.datum = datum
+
+    def execute(self, deps):
+        return self.datum
+
+    def signature(self):
+        return ("datum", id(self.datum))
+
+    def pinned_objects(self):
+        return (self.datum,)
+
+    def label(self):
+        return "Datum"
+
+
+class TransformerOperator(Operator):
+    """Applies a Transformer to its single input batch."""
+
+    def __init__(self, transformer):
+        self.transformer = transformer
+
+    def execute(self, deps):
+        return self.transformer.batch_call(deps[0])
+
+    def signature(self):
+        return ("transformer", self.transformer.signature())
+
+    def prefix_hash(self, dep_hashes):
+        # Delegated so that a fused chain hashes identically to the unfused
+        # chain it replaced (FusedTransformer folds stage-by-stage).
+        return self.transformer.chain_hash(dep_hashes[0])
+
+    def pinned_objects(self):
+        return (self.transformer,)
+
+    def label(self):
+        return type(self.transformer).__name__
+
+
+class EstimatorOperator(Operator):
+    """Fits an Estimator/LabelEstimator on its input(s); the value produced is
+    the fitted Transformer (a TransformerExpression in reference terms)."""
+
+    def __init__(self, estimator):
+        self.estimator = estimator
+
+    def execute(self, deps):
+        return self.estimator.fit(*deps)
+
+    def signature(self):
+        return ("estimator", id(self.estimator))
+
+    def pinned_objects(self):
+        return (self.estimator,)
+
+    def label(self):
+        return type(self.estimator).__name__ + ".fit"
+
+
+class DelegatingOperator(Operator):
+    """Applies the fitted transformer produced by an estimator node.
+
+    deps = [fitted_transformer, input_batch].
+    Ref: workflow/Operator.scala DelegatingOperator [unverified].
+    """
+
+    def execute(self, deps):
+        fitted, x = deps
+        return fitted.batch_call(x)
+
+    def signature(self):
+        # The behaviour is fully determined by the estimator dep's hash, so a
+        # shared constant signature keeps structurally-equal graphs equal.
+        return ("delegating",)
+
+    def label(self):
+        return "Delegating"
+
+
+class GatherOperator(Operator):
+    """Concatenates branch outputs along the feature (last) axis.
+
+    Ref: Pipeline.gather building a gather node over branch sinks
+    (workflow/Pipeline.scala) [unverified]. On TPU this lowers to one XLA
+    concatenate, which typically fuses with downstream consumers.
+    """
+
+    def execute(self, deps: Sequence[Any]):
+        return jnp.concatenate([jnp.asarray(d) for d in deps], axis=-1)
+
+    def signature(self):
+        return ("gather",)
+
+    def label(self):
+        return "Gather"

@@ -1,0 +1,153 @@
+"""Rule-based graph optimizer.
+
+Ref: src/main/scala/workflow/Optimizer.scala — Catalyst-style batches of
+rewrite rules run to fixed point [unverified]. The default pipeline here:
+
+1. ``EquivalentNodeMergeRule`` — dedups structurally identical nodes (restores
+   sharing lost to copy-on-instantiate composition).
+2. ``ChainFusionRule`` — the TPU-specific lowering: maximal chains of jittable
+   transformers become ONE ``FusedTransformer`` whose batch function is a
+   single XLA computation. This replaces the reference's per-stage RDD
+   execution with whole-chain compilation, letting XLA fuse elementwise work
+   into the matmuls/convs around it.
+
+Node-level solver selection and the auto-caching rule plug in as additional
+rules (see workflow/rules.py as they land).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+from keystone_tpu.config import config
+from keystone_tpu.workflow.graph import Graph, GraphId, NodeId, SourceId
+from keystone_tpu.workflow.operators import TransformerOperator
+from keystone_tpu.workflow.pipeline import FusedTransformer
+
+
+class Rule:
+    def apply(self, graph: Graph, targets: Sequence[GraphId]) -> Graph:
+        raise NotImplementedError
+
+
+class EquivalentNodeMergeRule(Rule):
+    """Merge nodes with identical (operator signature, dependencies).
+
+    Ref: workflow/EquivalentNodeMergeRule.scala [unverified].
+    """
+
+    def apply(self, graph: Graph, targets: Sequence[GraphId]) -> Graph:
+        order = graph.reachable(targets)
+        canon: Dict[Tuple, NodeId] = {}
+        remap: Dict[GraphId, GraphId] = {}
+        ops = {}
+        dps = {}
+        targets_set = set(targets)
+        for nid in order:
+            op = graph.operators[nid]
+            deps = tuple(remap.get(d, d) for d in graph.dependencies[nid])
+            key = (op.signature(), deps)
+            if key in canon and nid not in targets_set:
+                remap[nid] = canon[key]
+            else:
+                canon.setdefault(key, nid)
+                ops[nid] = op
+                dps[nid] = deps
+        # Always rebuild: this also prunes nodes unreachable from the targets
+        # (orphans left by composition's copy-on-instantiate).
+        return Graph(ops, dps)
+
+
+class ChainFusionRule(Rule):
+    """Fuse maximal single-consumer chains of jittable transformers.
+
+    Fused transformers are memoized on the identity of their stage tuple so
+    re-optimizing a copy of the same logical chain (every ``apply`` creates a
+    fresh graph copy) reuses the same FusedTransformer object — and therefore
+    its already-compiled jit cache. Without this, every ``get()`` would
+    re-trace and re-compile the chain.
+    """
+
+    def __init__(self):
+        # stage-id tuple -> FusedTransformer; values hold the stages strongly,
+        # so the id keys can never alias recycled objects.
+        self._fuse_cache: Dict[Tuple[int, ...], FusedTransformer] = {}
+
+    def _fused(self, stages: List) -> FusedTransformer:
+        key = tuple(id(s) for s in stages)
+        fused = self._fuse_cache.get(key)
+        if fused is None:
+            fused = FusedTransformer(stages)
+            self._fuse_cache[key] = fused
+        return fused
+
+    def apply(self, graph: Graph, targets: Sequence[GraphId]) -> Graph:
+        if not config.fuse_chains:
+            return graph
+        targets_set = set(targets)
+        cons = graph.consumers(targets)
+        order = graph.reachable(targets)
+
+        def fusable(gid: GraphId) -> bool:
+            if not isinstance(gid, NodeId):
+                return False
+            op = graph.operators.get(gid)
+            return (
+                isinstance(op, TransformerOperator) and op.transformer.jittable
+            )
+
+        chain_of: Dict[NodeId, List[NodeId]] = {}
+        for nid in order:
+            if not fusable(nid):
+                continue
+            dep = graph.dependencies[nid][0]
+            if (
+                fusable(dep)
+                and len(cons.get(dep, ())) == 1
+                and dep not in targets_set
+                and dep in chain_of
+            ):
+                chain_of[nid] = chain_of.pop(dep) + [nid]
+            else:
+                chain_of[nid] = [nid]
+
+        changed = False
+        ops = dict(graph.operators)
+        dps = dict(graph.dependencies)
+        for tail, chain in chain_of.items():
+            if len(chain) < 2:
+                continue
+            changed = True
+            stages = [graph.operators[c].transformer for c in chain]
+            ops[tail] = TransformerOperator(self._fused(stages))
+            dps[tail] = graph.dependencies[chain[0]]
+            for c in chain[:-1]:
+                ops.pop(c, None)
+                dps.pop(c, None)
+        return Graph(ops, dps) if changed else graph
+
+
+class Optimizer:
+    """Batches of rules, each run to fixed point (bounded)."""
+
+    def __init__(self, batches: Sequence[Tuple[str, Sequence[Rule], int]]):
+        self.batches = list(batches)
+
+    def execute(self, graph: Graph, targets: Sequence[GraphId]) -> Graph:
+        for _name, rules, max_iters in self.batches:
+            for _ in range(max_iters):
+                before = (graph.operators, graph.dependencies)
+                for rule in rules:
+                    graph = rule.apply(graph, targets)
+                if (graph.operators, graph.dependencies) == before:
+                    break
+        return graph
+
+
+def default_optimizer() -> Optimizer:
+    return Optimizer(
+        [
+            ("dedup", [EquivalentNodeMergeRule()], 3),
+            ("fusion", [ChainFusionRule()], 1),
+        ]
+    )

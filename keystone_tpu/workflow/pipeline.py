@@ -1,0 +1,377 @@
+"""Pipeline API: Transformer / Estimator / LabelEstimator / Pipeline.
+
+Ref: src/main/scala/workflow/{Pipeline,Transformer,Estimator,LabelEstimator,
+PipelineDataset}.scala [unverified]. The algebra is preserved:
+
+- ``Transformer`` — a pure per-datum (liftable to per-batch) function; itself
+  composable like a one-node pipeline.
+- ``Estimator.fit(data) -> Transformer``; ``with_data`` splices a lazy fit
+  into a graph.
+- ``pipeline.and_then(...)`` composes; ``Pipeline.gather([...])`` merges
+  branches by feature concatenation.
+- Applying a pipeline is lazy: you get a ``PipelineDataset`` handle; ``get()``
+  optimizes the graph and executes it.
+
+The execution difference from the reference: instead of staging RDD
+transformations, contiguous jittable transformer chains are fused by the
+optimizer into single XLA computations (see workflow/optimizer.py), and batch
+values are (possibly sharded) device arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.workflow.graph import (
+    Graph,
+    GraphId,
+    NodeId,
+    SourceId,
+    fresh_source_id,
+)
+from keystone_tpu.workflow.operators import (
+    DatasetOperator,
+    DatumOperator,
+    DelegatingOperator,
+    EstimatorOperator,
+    GatherOperator,
+    Operator,
+    TransformerOperator,
+)
+
+
+def _is_array(x: Any) -> bool:
+    return isinstance(x, (jax.Array, np.ndarray))
+
+
+# ---------------------------------------------------------------------------
+# Transformer
+# ---------------------------------------------------------------------------
+
+
+class Transformer:
+    """A pure function applied per-datum, lifted to batches.
+
+    Subclasses override ``apply_batch`` (device code operating on a batch with
+    a leading example axis — the common case, jitted and fused by the
+    executor) or ``apply`` (per-datum host code; set ``jittable = False``).
+
+    Ref: workflow/Transformer.scala — per-datum ``apply`` lifted to RDDs via
+    mapPartitions [unverified]. Here the lift is vectorization: the batch IS
+    the unit of execution, which is what the MXU wants.
+    """
+
+    jittable: bool = True
+
+    def apply(self, x: Any) -> Any:
+        if _is_array(x) or jnp.isscalar(x):
+            return self.batch_call(jnp.asarray(x)[None, ...])[0]
+        raise NotImplementedError(
+            f"{type(self).__name__} must override apply() for non-array data"
+        )
+
+    def apply_batch(self, X: Any) -> Any:
+        # Host-side default: per-datum loop. Device transformers override.
+        return [self.apply(x) for x in X]
+
+    # -- execution ---------------------------------------------------------
+
+    def batch_call(self, X: Any) -> Any:
+        """Apply to a batch, via the cached jitted function when possible."""
+        if self.jittable and _is_array(X):
+            return self._jitted()(X)
+        return self.apply_batch(X)
+
+    def _jitted(self) -> Callable:
+        fn = getattr(self, "_jit_cache", None)
+        if fn is None:
+            fn = jax.jit(self.apply_batch)
+            object.__setattr__(self, "_jit_cache", fn)
+        return fn
+
+    def signature(self) -> Any:
+        """Key for structural prefix hashing; object identity by default."""
+        return id(self)
+
+    def chain_hash(self, h_in: int) -> int:
+        """Prefix hash of applying this transformer to an input with hash
+        ``h_in``. FusedTransformer folds so fusion never changes hashes."""
+        return hash((("transformer", self.signature()), (h_in,)))
+
+    # -- composition sugar -------------------------------------------------
+
+    def to_pipeline(self) -> "Pipeline":
+        source = fresh_source_id()
+        graph, nid = Graph().add(TransformerOperator(self), [source])
+        return Pipeline(graph, source, nid)
+
+    def and_then(self, nxt, *fit_args) -> "Pipeline":
+        return self.to_pipeline().and_then(nxt, *fit_args)
+
+    def apply_pipeline(self, data) -> "PipelineDataset":
+        return self.to_pipeline().apply(data)
+
+    def __call__(self, data):
+        """Eager convenience: transform a batch (or datum) directly."""
+        if isinstance(data, PipelineDataset):
+            return self.to_pipeline().apply(data)
+        if _is_array(data):
+            return self.batch_call(data)
+        return self.apply_batch(data)
+
+
+class FusedTransformer(Transformer):
+    """A chain of jittable transformers compiled as one XLA computation.
+
+    Produced by the optimizer's chain-fusion rule — the analog of the
+    reference's lowering of a whole RDD stage, except the "stage" here is a
+    single jitted program XLA can fuse end-to-end.
+    """
+
+    def __init__(self, stages: Sequence[Transformer]):
+        flat: List[Transformer] = []
+        for s in stages:
+            if isinstance(s, FusedTransformer):
+                flat.extend(s.stages)
+            else:
+                flat.append(s)
+        self.stages = flat
+        self.jittable = all(s.jittable for s in flat)
+
+    def apply_batch(self, X):
+        for s in self.stages:
+            X = s.apply_batch(X)
+        return X
+
+    def signature(self):
+        return ("fused",) + tuple(s.signature() for s in self.stages)
+
+    def chain_hash(self, h_in: int) -> int:
+        # Fold stage-by-stage so the fused node's prefix hash equals the
+        # unfused chain's — fusion is hash-invariant (fit_cache keeps hitting
+        # whether or not a prefix got fused in a particular graph copy).
+        for s in self.stages:
+            h_in = s.chain_hash(h_in)
+        return h_in
+
+    def __repr__(self):
+        return "Fused(" + " | ".join(type(s).__name__ for s in self.stages) + ")"
+
+
+# ---------------------------------------------------------------------------
+# Estimators
+# ---------------------------------------------------------------------------
+
+
+def _splice_data(graph: Graph, data: Any):
+    """Splice data (raw batch or lazy PipelineDataset) into ``graph``.
+
+    Returns (graph, graph_id_producing_the_data).
+    """
+    if isinstance(data, PipelineDataset):
+        return graph.union(data.graph), data.sink
+    g, nid = graph.add(DatasetOperator(data), [])
+    return g, nid
+
+
+class Estimator:
+    """``fit(data) -> Transformer``. Ref: workflow/Estimator.scala [unverified]."""
+
+    def fit(self, data) -> Transformer:
+        raise NotImplementedError
+
+    def with_data(self, data) -> "Pipeline":
+        """A pipeline that lazily fits this estimator on ``data`` and applies
+        the fitted transformer to the pipeline input (Estimator.withData)."""
+        graph = Graph()
+        graph, data_id = _splice_data(graph, data)
+        graph, est_id = graph.add(EstimatorOperator(self), [data_id])
+        source = fresh_source_id()
+        graph, out_id = graph.add(DelegatingOperator(), [est_id, source])
+        return Pipeline(graph, source, out_id)
+
+    def fit_pipeline(self, data) -> "Pipeline":
+        """Eagerly fit and return the fitted transformer as a pipeline."""
+        return self.fit(_force(data)).to_pipeline()
+
+
+class LabelEstimator:
+    """``fit(data, labels) -> Transformer``.
+
+    Ref: workflow/LabelEstimator.scala [unverified].
+    """
+
+    def fit(self, data, labels) -> Transformer:
+        raise NotImplementedError
+
+    def with_data(self, data, labels) -> "Pipeline":
+        graph = Graph()
+        graph, data_id = _splice_data(graph, data)
+        graph, labels_id = _splice_data(graph, labels)
+        graph, est_id = graph.add(EstimatorOperator(self), [data_id, labels_id])
+        source = fresh_source_id()
+        graph, out_id = graph.add(DelegatingOperator(), [est_id, source])
+        return Pipeline(graph, source, out_id)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline
+# ---------------------------------------------------------------------------
+
+
+class Pipeline:
+    """A lazily-constructed dataflow from one source to one sink.
+
+    Ref: workflow/Pipeline.scala [unverified].
+    """
+
+    def __init__(self, graph: Graph, source: SourceId, sink: GraphId):
+        self.graph = graph
+        self.source = source
+        self.sink = sink
+
+    # -- composition -------------------------------------------------------
+
+    @staticmethod
+    def _coerce(obj) -> "Pipeline":
+        if isinstance(obj, Pipeline):
+            return obj
+        if isinstance(obj, Transformer):
+            return obj.to_pipeline()
+        raise TypeError(f"cannot compose with {type(obj).__name__}")
+
+    def and_then(self, nxt, *fit_args) -> "Pipeline":
+        """``pipeline.and_then(transformer_or_pipeline)``, or
+        ``pipeline.and_then(estimator, data[, labels])`` which fits the
+        estimator on this pipeline applied to ``data``."""
+        if isinstance(nxt, (Estimator, LabelEstimator)):
+            return self._and_then_fit(nxt, *fit_args)
+        if fit_args:
+            raise TypeError("fit data only valid when composing an estimator")
+        nxt = Pipeline._coerce(nxt)
+        merged = self.graph.union(nxt.graph)
+        merged, (new_sink,) = merged.instantiate([nxt.sink], {nxt.source: self.sink})
+        return Pipeline(merged.pruned([new_sink]), self.source, new_sink)
+
+    def _and_then_fit(self, est, data, labels=None) -> "Pipeline":
+        if labels is None and not isinstance(est, Estimator):
+            raise TypeError("LabelEstimator requires labels")
+        if labels is not None and not isinstance(est, LabelEstimator):
+            raise TypeError("labels are only valid for a LabelEstimator")
+        features = self.apply(data)
+        if labels is None:
+            tail = est.with_data(features)
+        else:
+            tail = est.with_data(features, labels)
+        return self.and_then(tail)
+
+    @staticmethod
+    def gather(branches: Sequence[Union["Pipeline", Transformer]]) -> "Pipeline":
+        """Merge parallel branches over the same input by concatenating their
+        outputs on the feature axis (Pipeline.gather)."""
+        branches = [Pipeline._coerce(b) for b in branches]
+        source = fresh_source_id()
+        merged = Graph()
+        sinks: List[GraphId] = []
+        for b in branches:
+            merged = merged.union(b.graph)
+            merged, (s,) = merged.instantiate([b.sink], {b.source: source})
+            sinks.append(s)
+        merged, out = merged.add(GatherOperator(), sinks)
+        return Pipeline(merged.pruned([out]), source, out)
+
+    # -- application -------------------------------------------------------
+
+    def apply(self, data) -> "PipelineDataset":
+        """Lazily apply to a batch (array / host sequence / PipelineDataset)."""
+        if isinstance(data, PipelineDataset):
+            merged = self.graph.union(data.graph)
+            merged, (sink,) = merged.instantiate([self.sink], {self.source: data.sink})
+            return PipelineDataset(merged.pruned([sink]), sink)
+        graph, data_id = self.graph.add(DatasetOperator(data), [])
+        graph, (sink,) = graph.instantiate([self.sink], {self.source: data_id})
+        return PipelineDataset(graph.pruned([sink]), sink)
+
+    def __call__(self, data) -> "PipelineDataset":
+        return self.apply(data)
+
+    def apply_datum(self, datum) -> Any:
+        """Apply to a single datum, eagerly (driver-local in the reference).
+
+        Lifts the datum to a one-element batch so every transformer sees the
+        leading example axis its ``apply_batch`` contract promises, then
+        unwraps the result.
+        """
+        if _is_array(datum) or jnp.isscalar(datum):
+            batch: Any = jnp.asarray(datum)[None, ...]
+        else:
+            batch = [datum]
+        from keystone_tpu.workflow.executor import PipelineEnv
+
+        ds = self.apply(batch)
+        fitted_graph = PipelineEnv.get().executor.fit_estimators(ds.graph, ds.sink)
+        out = PipelineEnv.get().execute(fitted_graph, ds.sink)
+        return out[0]
+
+    # -- fitting -----------------------------------------------------------
+
+    def fit(self) -> "Pipeline":
+        """Force every estimator in the graph and return a transformer-only
+        pipeline (the reference's fitted pipeline).
+
+        Ref: Pipeline.fit returning FittedPipeline [unverified].
+        """
+        from keystone_tpu.workflow.executor import PipelineEnv
+
+        graph = PipelineEnv.get().executor.fit_estimators(self.graph, self.sink)
+        # Prune to the subgraph feeding our sink.
+        return Pipeline(graph, self.source, self.sink)
+
+    # -- introspection -----------------------------------------------------
+
+    def transformers(self) -> List[Transformer]:
+        """Transformer chain in topological order (fitted pipelines only)."""
+        out = []
+        for nid in self.graph.reachable([self.sink]):
+            op = self.graph.operators[nid]
+            if isinstance(op, TransformerOperator):
+                out.append(op.transformer)
+        return out
+
+    def describe(self) -> str:
+        lines = []
+        for nid in self.graph.reachable([self.sink]):
+            op = self.graph.operators[nid]
+            deps = ", ".join(map(repr, self.graph.dependencies[nid]))
+            lines.append(f"{nid!r}: {op.label()} <- [{deps}]")
+        return "\n".join(lines)
+
+
+class PipelineDataset:
+    """Lazy handle to the result of applying a pipeline to a batch.
+
+    Ref: workflow/PipelineDataset.scala [unverified]. ``get()`` triggers
+    optimization + execution (memoized).
+    """
+
+    def __init__(self, graph: Graph, sink: GraphId):
+        self.graph = graph
+        self.sink = sink
+        self._value: Any = None
+        self._computed = False
+
+    def get(self) -> Any:
+        if not self._computed:
+            from keystone_tpu.workflow.executor import PipelineEnv
+
+            self._value = PipelineEnv.get().optimize_and_execute(self.graph, self.sink)
+            self._computed = True
+        return self._value
+
+
+def _force(data):
+    return data.get() if isinstance(data, PipelineDataset) else data

@@ -1,0 +1,32 @@
+"""Test fixture: a virtual 8-device CPU mesh.
+
+The reference tests all "distributed" logic on a local[n] SparkContext
+(Ref: src/test/scala shared LocalSparkContext trait [unverified]); our analog
+is XLA's forced host-platform device count — the same collective code paths
+run on 8 fake CPU devices as on a TPU pod slice.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(autouse=True)
+def fresh_env():
+    """Fresh PipelineEnv per test — the analog of a fresh SparkContext."""
+    from keystone_tpu.workflow.executor import PipelineEnv
+
+    PipelineEnv.reset()
+    yield
+    PipelineEnv.reset()

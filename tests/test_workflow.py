@@ -1,0 +1,292 @@
+"""Core workflow tests: composition algebra, laziness, fitting, fusion, memo.
+
+Mirrors the reference's workflow suites (PipelineSuite, EstimatorSuite,
+TransformerSuite [unverified paths]).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.workflow import (
+    Estimator,
+    LabelEstimator,
+    Pipeline,
+    PipelineEnv,
+    Transformer,
+)
+from keystone_tpu.workflow.operators import TransformerOperator
+from keystone_tpu.workflow.pipeline import FusedTransformer
+
+
+class Plus(Transformer):
+    def __init__(self, c):
+        self.c = c
+
+    def apply_batch(self, X):
+        return X + self.c
+
+
+class Times(Transformer):
+    def __init__(self, c):
+        self.c = c
+
+    def apply_batch(self, X):
+        return X * self.c
+
+
+class MeanShift(Estimator):
+    """Fits the mean of the data; transformer subtracts it."""
+
+    def __init__(self):
+        self.fit_count = 0
+
+    def fit(self, data):
+        self.fit_count += 1
+        return Plus(-jnp.mean(jnp.asarray(data), axis=0))
+
+
+class ScaleToLabels(LabelEstimator):
+    def __init__(self):
+        self.fit_count = 0
+
+    def fit(self, data, labels):
+        self.fit_count += 1
+        scale = jnp.mean(jnp.asarray(labels)) / jnp.mean(jnp.asarray(data))
+        return Times(scale)
+
+
+def test_transformer_batch_and_datum():
+    t = Plus(2.0)
+    X = np.arange(6.0).reshape(3, 2)
+    np.testing.assert_allclose(t(X), X + 2.0)
+    np.testing.assert_allclose(t.apply(np.ones(2)), np.ones(2) + 2.0)
+
+
+def test_and_then_composition():
+    p = Plus(1.0).and_then(Times(3.0)).and_then(Plus(-2.0))
+    X = np.ones((4, 2))
+    out = p(X).get()
+    np.testing.assert_allclose(out, (1.0 + 1.0) * 3.0 - 2.0)
+
+
+def test_pipeline_is_lazy():
+    calls = []
+
+    class Probe(Transformer):
+        jittable = False
+
+        def apply_batch(self, X):
+            calls.append(1)
+            return X
+
+    p = Probe().to_pipeline()
+    ds = p(np.ones((2, 2)))
+    assert calls == []
+    ds.get()
+    assert calls == [1]
+    ds.get()  # memoized
+    assert calls == [1]
+
+
+def test_estimator_with_data():
+    est = MeanShift()
+    X = np.array([[1.0, 2.0], [3.0, 4.0]])
+    p = est.with_data(X)
+    out = p(X).get()
+    np.testing.assert_allclose(out, X - X.mean(axis=0), atol=1e-6)
+    assert est.fit_count == 1
+
+
+def test_fit_cache_across_applications():
+    est = MeanShift()
+    X = np.array([[1.0, 2.0], [3.0, 4.0]])
+    p = est.with_data(X)
+    p(X).get()
+    p(X * 2).get()
+    assert est.fit_count == 1  # fitted-prefix reuse
+
+
+def test_label_estimator():
+    est = ScaleToLabels()
+    X = np.full((4, 1), 2.0)
+    y = np.full((4, 1), 6.0)
+    p = est.with_data(X, y)
+    out = p(np.ones((2, 1))).get()
+    np.testing.assert_allclose(out, 3.0 * np.ones((2, 1)), atol=1e-5)
+
+
+def test_and_then_estimator_fits_on_pipeline_output():
+    # pipeline.and_then(est, data): estimator sees pipeline(data)
+    est = MeanShift()
+    X = np.array([[0.0], [2.0]])  # after Plus(1): mean = 2
+    p = Plus(1.0).and_then(est, X)
+    out = p(np.array([[5.0]])).get()
+    np.testing.assert_allclose(out, np.array([[4.0]]), atol=1e-6)  # 5+1-2
+
+
+def test_gather_concatenates_branches():
+    b1 = Plus(1.0).to_pipeline()
+    b2 = Times(2.0).to_pipeline()
+    p = Pipeline.gather([b1, b2])
+    X = np.ones((3, 2))
+    out = p(X).get()
+    assert out.shape == (3, 4)
+    np.testing.assert_allclose(out[:, :2], 2.0)
+    np.testing.assert_allclose(out[:, 2:], 2.0)
+
+
+def test_gather_shares_common_prefix_computation():
+    calls = []
+
+    class Probe(Transformer):
+        jittable = False
+
+        def apply_batch(self, X):
+            calls.append(1)
+            return X
+
+    base = Probe().to_pipeline()
+    p = Pipeline.gather([base.and_then(Plus(1.0)), base.and_then(Times(2.0))])
+    p(np.ones((2, 2))).get()
+    # Structural-hash memo dedups the copied Probe nodes within one execution.
+    assert calls == [1]
+
+
+def test_fit_returns_transformer_only_pipeline():
+    est = MeanShift()
+    X = np.array([[1.0], [3.0]])
+    p = Plus(0.0).and_then(est, X)
+    fitted = p.fit()
+    ts = fitted.transformers()
+    assert all(isinstance(t, Transformer) for t in ts)
+    out = fitted(np.array([[2.0]])).get()
+    np.testing.assert_allclose(out, np.array([[0.0]]), atol=1e-6)
+
+
+def test_chain_fusion_rule():
+    p = Plus(1.0).and_then(Times(3.0)).and_then(Plus(-2.0))
+    env = PipelineEnv.get()
+    ds = p(np.ones((2, 2)))
+    g = env.optimizer.execute(ds.graph, [ds.sink])
+    t_ops = [
+        op for op in g.operators.values() if isinstance(op, TransformerOperator)
+    ]
+    assert len(t_ops) == 1
+    assert isinstance(t_ops[0].transformer, FusedTransformer)
+    assert len(t_ops[0].transformer.stages) == 3
+    np.testing.assert_allclose(ds.get(), 4.0 * np.ones((2, 2)))
+
+
+def test_fusion_preserves_prefix_hash():
+    est = MeanShift()
+    X = np.ones((4, 2))
+    feats = Plus(1.0).and_then(Times(2.0))
+    p = feats.and_then(est, X)
+    p(X).get()
+    assert est.fit_count == 1
+    # Re-applying through a different graph copy must not refit.
+    p(X * 3).get()
+    assert est.fit_count == 1
+
+
+def test_apply_datum():
+    p = Plus(1.0).and_then(Times(2.0))
+    out = p.apply_datum(np.array([1.0, 2.0]))
+    np.testing.assert_allclose(out, np.array([4.0, 6.0]))
+
+
+def test_host_transformer_on_lists():
+    class Upper(Transformer):
+        jittable = False
+
+        def apply(self, x):
+            return x.upper()
+
+    p = Upper().to_pipeline()
+    assert p(["ab", "cd"]).get() == ["AB", "CD"]
+
+
+def test_fusion_is_hash_invariant():
+    # The same logical prefix must hash equal whether or not it got fused.
+    from keystone_tpu.workflow.graph import structural_hash
+    from keystone_tpu.workflow import PipelineEnv
+
+    t1, t2 = Plus(1.0), Times(2.0)
+    X = np.ones((2, 2))
+    p = t1.and_then(t2)
+    ds = p(X)
+    env = PipelineEnv.get()
+    fused_g = env.optimizer.execute(ds.graph, [ds.sink])
+
+    def no_src(s):
+        raise AssertionError
+
+    h_unfused = structural_hash(ds.graph, ds.sink, no_src)
+    # sink id survives optimization (merge rule preserves targets)
+    h_fused = structural_hash(fused_g, ds.sink, no_src)
+    assert h_unfused == h_fused
+
+
+def test_fitted_pipeline_drops_training_data():
+    from keystone_tpu.workflow.operators import DatasetOperator, EstimatorOperator
+
+    est = MeanShift()
+    X = np.ones((8, 2))
+    p = Plus(0.0).and_then(est, X)
+    fitted = p.fit()
+    ops = list(fitted.graph.operators.values())
+    assert not any(isinstance(o, (DatasetOperator, EstimatorOperator)) for o in ops)
+
+
+def test_fit_cache_pins_objects_and_evicts_with_estimator():
+    import gc
+
+    from keystone_tpu.workflow import PipelineEnv
+
+    est = MeanShift()
+    X = np.ones((4, 2))
+    est.with_data(X)(X).get()
+    env = PipelineEnv.get()
+    (entry,) = env.fit_cache.values()
+    _fitted, pins, keeper = entry
+    # Data is pinned (id-reuse safety); the estimator itself is held weakly.
+    assert any(o is X for o in pins)
+    assert keeper() is est
+    # Dropping the estimator evicts the entry (and frees the pinned data).
+    del est, entry, keeper, _fitted
+    gc.collect()
+    assert env.fit_cache == {}
+
+
+def test_repeated_apply_reuses_fused_jit():
+    p = Plus(1.0).and_then(Times(2.0)).and_then(Plus(0.5))
+    X = np.ones((2, 2))
+    fused_objs = set()
+    from keystone_tpu.workflow import PipelineEnv
+    from keystone_tpu.workflow.operators import TransformerOperator
+
+    for _ in range(3):
+        ds = p(X)
+        g = PipelineEnv.get().optimizer.execute(ds.graph, [ds.sink])
+        for op in g.operators.values():
+            if isinstance(op, TransformerOperator):
+                fused_objs.add(id(op.transformer))
+        ds.get()
+    # Same FusedTransformer object across graph copies => one jit cache.
+    assert len(fused_objs) == 1
+
+
+def test_apply_datum_respects_batch_contract():
+    class RowNormalize(Transformer):
+        def apply_batch(self, X):
+            return X / X.sum(axis=1, keepdims=True)
+
+    out = RowNormalize().to_pipeline().apply_datum(np.array([1.0, 3.0]))
+    np.testing.assert_allclose(out, [0.25, 0.75])
+
+
+def test_estimator_with_labels_rejected():
+    est = MeanShift()
+    with pytest.raises(TypeError, match="LabelEstimator"):
+        Plus(1.0).and_then(est, np.ones((2, 1)), np.ones((2, 1)))
