@@ -21,6 +21,10 @@ class Config:
     # dtype used for matmul accumulation-sensitive reductions (grams). XLA on
     # TPU accumulates fp32; this is the storage dtype of gram matrices.
     accum_dtype: str = "float32"
+    # Matmul precision for solver-path compute (grams, QR, residuals). TPU
+    # default matmul precision is bf16-class and loses ~3 decimal digits;
+    # solvers need full fp32 ("highest"). Featurization uses the default.
+    solver_precision: str = "highest"
     # Mesh axis name used for data (row) parallelism throughout.
     data_axis: str = "data"
     # Mesh axis name used for model (feature-block) parallelism.

@@ -1,0 +1,24 @@
+"""Distributed linear algebra over a TPU device mesh.
+
+The TPU-native rebuild of the reference's `amplab/ml-matrix` dependency
+(RowPartitionedMatrix, TSQR, NormalEquations, BlockCoordinateDescent —
+Ref: edu.berkeley.cs.amplab:mlmatrix, see SURVEY.md §2.2 [unverified]).
+
+Spark `treeAggregate` tree-reductions become XLA `psum`/`all_gather`
+collectives over the ICI mesh (emitted inside `shard_map` regions); the
+per-partition Breeze gemms become per-chip MXU matmuls; the driver-side
+Cholesky/QR solves become replicated on-device solves.
+"""
+
+from keystone_tpu.linalg.row_matrix import RowMatrix
+from keystone_tpu.linalg.normal_equations import solve_least_squares_normal
+from keystone_tpu.linalg.tsqr import tsqr_r, solve_least_squares_tsqr
+from keystone_tpu.linalg.bcd import block_coordinate_descent
+
+__all__ = [
+    "RowMatrix",
+    "solve_least_squares_normal",
+    "tsqr_r",
+    "solve_least_squares_tsqr",
+    "block_coordinate_descent",
+]

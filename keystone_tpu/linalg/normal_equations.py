@@ -1,0 +1,33 @@
+"""Least squares via normal equations.
+
+Ref: ml-matrix `NormalEquations.solveLeastSquares` — AᵀA and AᵀB accumulated
+with `treeAggregate`, Cholesky solve on the driver (SURVEY.md §2.2, §3.2)
+[unverified]. Here: per-shard grams + `psum` over ICI, replicated on-device
+Cholesky (every chip solves the small (d, d) system redundantly — cheaper
+than shipping it anywhere).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.linalg import cho_factor, cho_solve
+
+from keystone_tpu.linalg.row_matrix import RowMatrix
+
+
+@jax.jit
+def _chol_solve(gram, atb, lam):
+    d = gram.shape[0]
+    reg = gram + lam * jnp.eye(d, dtype=gram.dtype)
+    c, low = cho_factor(reg)
+    return cho_solve((c, low), atb)
+
+
+def solve_least_squares_normal(
+    A: RowMatrix, B: RowMatrix, lam: float = 0.0
+) -> jax.Array:
+    """argmin_W ||A W - B||² + lam ||W||²  →  (d, k) replicated array."""
+    gram = A.gram()
+    atb = A.atb(B)
+    return _chol_solve(gram, atb, jnp.asarray(lam, dtype=gram.dtype))

@@ -1,0 +1,146 @@
+"""Row-sharded tall-skinny distributed matrix.
+
+Ref: ml-matrix `RowPartitionedMatrix` / `DistributedMatrix` (SURVEY.md §2.2)
+[unverified]. An ``RDD[RowPartition(DenseMatrix)]`` becomes a single device
+array sharded on its leading axis over the mesh's ``data`` axis; rows are
+zero-padded to a multiple of the shard count (zero rows are invisible to the
+gram/normal-equation reductions, and `collect` strips them).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from keystone_tpu.config import config
+from keystone_tpu.utils.mesh import default_mesh, pad_rows
+
+
+def _precision():
+    return {
+        "highest": lax.Precision.HIGHEST,
+        "high": lax.Precision.HIGH,
+        "default": lax.Precision.DEFAULT,
+    }[config.solver_precision]
+
+
+@lru_cache(maxsize=None)
+def _gram_fn(mesh: Mesh, axis: str, precision):
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P())
+    def gram(a):
+        return lax.psum(jnp.matmul(a.T, a, precision=precision), axis)
+
+    return gram
+
+
+@lru_cache(maxsize=None)
+def _atb_fn(mesh: Mesh, axis: str, precision):
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P())
+    def atb(a, b):
+        return lax.psum(jnp.matmul(a.T, b, precision=precision), axis)
+
+    return atb
+
+
+@lru_cache(maxsize=None)
+def _matmul_fn(mesh: Mesh, axis: str, precision):
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(axis))
+    def mm(a, w):
+        return jnp.matmul(a, w, precision=precision)
+
+    return mm
+
+
+class RowMatrix:
+    """An (n, d) matrix stored row-sharded over the mesh ``data`` axis.
+
+    ``data`` has shape (n_padded, d) with ``n_padded % num_shards == 0``;
+    ``n`` is the logical row count.
+    """
+
+    def __init__(self, data: jax.Array, n: int, mesh: Mesh):
+        self.data = data
+        self.n = int(n)
+        self.mesh = mesh
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_array(
+        cls,
+        x,
+        mesh: Optional[Mesh] = None,
+        dtype=None,
+    ) -> "RowMatrix":
+        mesh = mesh or default_mesh()
+        axis = config.data_axis
+        k = mesh.shape[axis]
+        dtype = dtype or config.default_dtype
+        x = np.asarray(x, dtype=dtype) if isinstance(x, np.ndarray) else jnp.asarray(x, dtype=dtype)
+        padded, n = pad_rows(x, k)
+        sharding = NamedSharding(mesh, P(axis))
+        data = jax.device_put(padded, sharding)
+        return cls(data, n, mesh)
+
+    # -- properties --------------------------------------------------------
+
+    @property
+    def shape(self):
+        return (self.n, self.data.shape[1])
+
+    @property
+    def padded_rows(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def num_shards(self) -> int:
+        return self.mesh.shape[config.data_axis]
+
+    # -- ops ---------------------------------------------------------------
+
+    def collect(self) -> np.ndarray:
+        """Gather to host, stripping padding (the RDD ``collect`` analog)."""
+        return np.asarray(self.data)[: self.n]
+
+    def gram(self) -> jax.Array:
+        """AᵀA, replicated: per-shard MXU gemm + psum over ICI
+        (the ``treeAggregate`` of local grams in NormalEquations)."""
+        return _gram_fn(self.mesh, config.data_axis, _precision())(self.data)
+
+    def atb(self, other: "RowMatrix") -> jax.Array:
+        """AᵀB for a row-aligned B."""
+        self._check_aligned(other)
+        return _atb_fn(self.mesh, config.data_axis, _precision())(
+            self.data, other.data
+        )
+
+    def matmul(self, w: jax.Array) -> "RowMatrix":
+        """A @ W for replicated W; result stays row-sharded."""
+        out = _matmul_fn(self.mesh, config.data_axis, _precision())(
+            self.data, jnp.asarray(w, dtype=self.data.dtype)
+        )
+        return RowMatrix(out, self.n, self.mesh)
+
+    def cols(self, start: int, stop: int) -> "RowMatrix":
+        """Column block view (feature-block parallelism's unit of work)."""
+        return RowMatrix(self.data[:, start:stop], self.n, self.mesh)
+
+    def _check_aligned(self, other: "RowMatrix") -> None:
+        if (
+            other.padded_rows != self.padded_rows
+            or other.n != self.n
+            or other.mesh is not self.mesh
+        ):
+            raise ValueError(
+                "row-matrices must share n, padding, and mesh "
+                f"(got {self.shape}/{self.padded_rows} vs {other.shape}/{other.padded_rows})"
+            )

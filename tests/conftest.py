@@ -8,13 +8,22 @@ run on 8 fake CPU devices as on a TPU pod slice.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# XLA_FLAGS must be in the env before the CPU backend initializes.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The axon sitecustomize force-registers the TPU platform ignoring
+# JAX_PLATFORMS; overriding the config after import is the reliable switch.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+assert len(jax.devices()) == 8, f"expected 8 CPU devices, got {jax.devices()}"
 
 
 @pytest.fixture

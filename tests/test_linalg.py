@@ -1,0 +1,160 @@
+"""Distributed linalg vs NumPy oracles on the 8-device CPU mesh.
+
+Mirrors the reference's solver test strategy: small fixed-seed systems
+checked against direct solves (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.linalg import (
+    RowMatrix,
+    block_coordinate_descent,
+    solve_least_squares_normal,
+    solve_least_squares_tsqr,
+    tsqr_r,
+)
+from keystone_tpu.linalg.bcd import assemble_blocks
+
+
+def _problem(rng, n=200, d=24, k=3):
+    A = rng.normal(size=(n, d)).astype(np.float32)
+    W_true = rng.normal(size=(d, k)).astype(np.float32)
+    B = A @ W_true + 0.01 * rng.normal(size=(n, k)).astype(np.float32)
+    return A, B, W_true
+
+
+def _ridge_oracle(A, B, lam):
+    d = A.shape[1]
+    return np.linalg.solve(
+        A.astype(np.float64).T @ A.astype(np.float64) + lam * np.eye(d),
+        A.astype(np.float64).T @ B.astype(np.float64),
+    )
+
+
+def test_from_array_pads_and_collects(rng):
+    A = rng.normal(size=(13, 4)).astype(np.float32)
+    M = RowMatrix.from_array(A)
+    assert M.padded_rows % M.num_shards == 0
+    assert M.shape == (13, 4)
+    np.testing.assert_allclose(M.collect(), A)
+
+
+def test_gram_matches_numpy(rng):
+    A = rng.normal(size=(100, 8)).astype(np.float32)
+    M = RowMatrix.from_array(A)
+    np.testing.assert_allclose(M.gram(), A.T @ A, rtol=1e-5, atol=1e-4)
+
+
+def test_atb_matches_numpy(rng):
+    A = rng.normal(size=(57, 6)).astype(np.float32)
+    B = rng.normal(size=(57, 3)).astype(np.float32)
+    Ma, Mb = RowMatrix.from_array(A), RowMatrix.from_array(B)
+    np.testing.assert_allclose(Ma.atb(Mb), A.T @ B, rtol=1e-5, atol=1e-4)
+
+
+def test_matmul_row_sharded(rng):
+    A = rng.normal(size=(30, 5)).astype(np.float32)
+    W = rng.normal(size=(5, 2)).astype(np.float32)
+    out = RowMatrix.from_array(A).matmul(W)
+    np.testing.assert_allclose(out.collect(), A @ W, rtol=1e-5, atol=1e-5)
+
+
+def test_tsqr_r_reproduces_gram(rng):
+    # R is unique up to signs; RᵀR must equal AᵀA.
+    A = rng.normal(size=(160, 12)).astype(np.float32)
+    R = np.asarray(tsqr_r(RowMatrix.from_array(A)))
+    np.testing.assert_allclose(R.T @ R, A.T @ A, rtol=1e-4, atol=1e-3)
+
+
+def test_tsqr_r_short_shards(rng):
+    # Local shard rows (24/8 = 3) < d = 5 exercises the R padding path.
+    A = rng.normal(size=(24, 5)).astype(np.float32)
+    R = np.asarray(tsqr_r(RowMatrix.from_array(A)))
+    np.testing.assert_allclose(R.T @ R, A.T @ A, rtol=1e-4, atol=1e-3)
+
+
+def test_normal_equations_solve(rng):
+    A, B, _ = _problem(rng)
+    lam = 0.1
+    W = solve_least_squares_normal(
+        RowMatrix.from_array(A), RowMatrix.from_array(B), lam
+    )
+    np.testing.assert_allclose(W, _ridge_oracle(A, B, lam), rtol=1e-3, atol=1e-3)
+
+
+def test_tsqr_solve_matches_lstsq(rng):
+    A, B, _ = _problem(rng)
+    W = solve_least_squares_tsqr(RowMatrix.from_array(A), RowMatrix.from_array(B))
+    oracle = np.linalg.lstsq(A.astype(np.float64), B.astype(np.float64), rcond=None)[0]
+    np.testing.assert_allclose(W, oracle, rtol=1e-3, atol=1e-3)
+
+
+def test_tsqr_solve_with_ridge(rng):
+    A, B, _ = _problem(rng)
+    lam = 0.5
+    W = solve_least_squares_tsqr(
+        RowMatrix.from_array(A), RowMatrix.from_array(B), lam
+    )
+    np.testing.assert_allclose(W, _ridge_oracle(A, B, lam), rtol=1e-3, atol=1e-3)
+
+
+def test_bcd_single_block_equals_normal_equations(rng):
+    A, B, _ = _problem(rng)
+    lam = 0.2
+    Ma, Mb = RowMatrix.from_array(A), RowMatrix.from_array(B)
+    W_blocks, blocks = block_coordinate_descent(
+        Ma, Mb, block_size=A.shape[1], num_iters=1, lam=lam
+    )
+    assert blocks == [(0, A.shape[1])]
+    np.testing.assert_allclose(
+        assemble_blocks(W_blocks, blocks),
+        _ridge_oracle(A, B, lam),
+        rtol=1e-3,
+        atol=1e-3,
+    )
+
+
+def test_bcd_converges_to_direct_solution(rng):
+    A, B, _ = _problem(rng, n=400, d=32)
+    lam = 0.1
+    W_blocks, blocks = block_coordinate_descent(
+        RowMatrix.from_array(A),
+        RowMatrix.from_array(B),
+        block_size=8,
+        num_iters=30,
+        lam=lam,
+    )
+    W = np.asarray(assemble_blocks(W_blocks, blocks))
+    oracle = _ridge_oracle(A, B, lam)
+    np.testing.assert_allclose(W, oracle, rtol=2e-2, atol=2e-2)
+
+
+def test_bcd_weighted_matches_weighted_oracle(rng):
+    A, B, _ = _problem(rng)
+    lam = 0.3
+    w = rng.uniform(0.5, 2.0, size=A.shape[0]).astype(np.float32)
+    W_blocks, blocks = block_coordinate_descent(
+        RowMatrix.from_array(A),
+        RowMatrix.from_array(B),
+        block_size=A.shape[1],
+        num_iters=1,
+        lam=lam,
+        row_weights=w,
+    )
+    Aw = A * w[:, None]
+    d = A.shape[1]
+    oracle = np.linalg.solve(
+        Aw.astype(np.float64).T @ A.astype(np.float64) + lam * np.eye(d),
+        Aw.astype(np.float64).T @ B.astype(np.float64),
+    )
+    np.testing.assert_allclose(
+        assemble_blocks(W_blocks, blocks), oracle, rtol=1e-3, atol=1e-3
+    )
+
+
+def test_alignment_errors(rng):
+    Ma = RowMatrix.from_array(rng.normal(size=(16, 3)))
+    Mb = RowMatrix.from_array(rng.normal(size=(24, 3)))
+    with pytest.raises(ValueError, match="share n"):
+        Ma.atb(Mb)
