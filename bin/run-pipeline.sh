@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# Pipeline launcher — the `bin/run-pipeline.sh <PipelineClass> <args...>`
+# entry point (Ref: bin/run-pipeline.sh wrapping spark-submit, BASELINE.json).
+# Here it maps the reference's pipeline class names onto python modules.
+#
+# Env knobs (the KEYSTONE_MEM analog):
+#   KEYSTONE_PLATFORM=cpu|axon     force the JAX platform (default: auto)
+#   KEYSTONE_NUM_DEVICES=N         virtual CPU device count (testing meshes)
+#   KEYSTONE_NO_FUSE=1             disable chain fusion (debugging)
+set -euo pipefail
+
+if [[ $# -lt 1 ]]; then
+  echo "usage: $0 <Pipeline> [args...]" >&2
+  echo "pipelines: MnistRandomFFT LinearPixels RandomPatchCifar" >&2
+  echo "           NewsgroupsPipeline AmazonReviewsPipeline TimitPipeline" >&2
+  echo "           VOCSIFTFisher ImageNetSiftLcsFV" >&2
+  exit 64
+fi
+
+PIPELINE="$1"; shift
+
+case "$PIPELINE" in
+  MnistRandomFFT)        MOD=keystone_tpu.pipelines.images.mnist_random_fft ;;
+  LinearPixels)          MOD=keystone_tpu.pipelines.images.linear_pixels ;;
+  RandomPatchCifar)      MOD=keystone_tpu.pipelines.images.random_patch_cifar ;;
+  NewsgroupsPipeline)    MOD=keystone_tpu.pipelines.text.newsgroups ;;
+  AmazonReviewsPipeline) MOD=keystone_tpu.pipelines.text.amazon_reviews ;;
+  TimitPipeline)         MOD=keystone_tpu.pipelines.speech.timit ;;
+  VOCSIFTFisher)         MOD=keystone_tpu.pipelines.images.voc_sift_fisher ;;
+  ImageNetSiftLcsFV)     MOD=keystone_tpu.pipelines.images.imagenet_sift_lcs_fv ;;
+  *) echo "unknown pipeline: $PIPELINE" >&2; exit 64 ;;
+esac
+
+REPO_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="${REPO_DIR}${PYTHONPATH:+:$PYTHONPATH}"
+
+if [[ ! -f "${REPO_DIR}/${MOD//.//}.py" ]]; then
+  echo "pipeline $PIPELINE is not implemented yet (module $MOD missing)" >&2
+  exit 69
+fi
+
+if [[ -n "${KEYSTONE_NUM_DEVICES:-}" ]]; then
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=${KEYSTONE_NUM_DEVICES}"
+fi
+if [[ -n "${KEYSTONE_PLATFORM:-}" ]]; then
+  export KEYSTONE_PLATFORM
+fi
+
+exec python -m "$MOD" "$@"

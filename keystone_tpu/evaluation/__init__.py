@@ -1,0 +1,6 @@
+from keystone_tpu.evaluation.multiclass import (
+    MulticlassClassifierEvaluator,
+    MulticlassMetrics,
+)
+
+__all__ = ["MulticlassClassifierEvaluator", "MulticlassMetrics"]

@@ -1,0 +1,5 @@
+from keystone_tpu.loaders.labeled_data import LabeledData
+from keystone_tpu.loaders.csv_loader import CsvDataLoader
+from keystone_tpu.loaders.mnist import MnistLoader
+
+__all__ = ["LabeledData", "CsvDataLoader", "MnistLoader"]

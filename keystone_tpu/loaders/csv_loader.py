@@ -1,0 +1,27 @@
+"""Generic CSV → array loader.
+
+Ref: src/main/scala/loaders/CsvDataLoader.scala — parse each line into a
+dense vector [unverified]. Host-side NumPy parse; arrays then flow to the
+device through the pipeline.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from keystone_tpu.config import config
+from keystone_tpu.loaders.labeled_data import LabeledData
+
+
+class CsvDataLoader:
+    @staticmethod
+    def load(path: str, dtype=None) -> np.ndarray:
+        return np.loadtxt(path, delimiter=",", dtype=dtype or config.default_dtype)
+
+    @staticmethod
+    def load_labeled(path: str, label_col: int = 0) -> LabeledData:
+        """CSV with a label column (first by default, MNIST-CSV style)."""
+        raw = np.loadtxt(path, delimiter=",", dtype=np.float64)
+        labels = raw[:, label_col].astype(np.int32)
+        data = np.delete(raw, label_col, axis=1).astype(config.default_dtype)
+        return LabeledData(data, labels)

@@ -1,0 +1,19 @@
+"""(data, labels) pairing used by every supervised pipeline.
+
+Ref: src/main/scala/loaders/LabeledData.scala [unverified].
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+
+@dataclass
+class LabeledData:
+    data: Any
+    labels: Any
+
+    def __iter__(self):
+        yield self.data
+        yield self.labels

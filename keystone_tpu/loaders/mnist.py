@@ -1,0 +1,76 @@
+"""MNIST loader: IDX or CSV files when available, synthetic fallback.
+
+Ref: the reference's MNIST pipeline reads the Bosen-format CSV dumps via
+`CsvDataLoader` (SURVEY.md §2.11) [unverified]. This environment has no
+network, so `synthetic(...)` generates a deterministic MNIST-like dataset
+(per-class prototype digits + noise) for tests and smoke runs; quality
+numbers on real MNIST require pointing `--train/--test` at real files.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+from keystone_tpu.config import config
+from keystone_tpu.loaders.csv_loader import CsvDataLoader
+from keystone_tpu.loaders.labeled_data import LabeledData
+
+
+def _read_idx(path: str) -> np.ndarray:
+    with open(path, "rb") as f:
+        magic = struct.unpack(">HBB", f.read(4))
+        _, dtype_code, ndim = magic
+        dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(dims)
+
+
+class MnistLoader:
+    @staticmethod
+    def load(path: str) -> LabeledData:
+        """Load from a CSV (label first) or an IDX image/label file pair
+        (``path`` without extension + '-images-idx3-ubyte'/'-labels-idx1-ubyte')."""
+        if path.endswith(".csv"):
+            return CsvDataLoader.load_labeled(path)
+        imgs = _read_idx(path + "-images-idx3-ubyte")
+        labels = _read_idx(path + "-labels-idx1-ubyte")
+        X = imgs.reshape(imgs.shape[0], -1).astype(config.default_dtype) / 255.0
+        return LabeledData(X, labels.astype(np.int32))
+
+    @staticmethod
+    def synthetic(
+        n: int = 4096, num_classes: int = 10, dim: int = 784, seed: int = 0
+    ) -> Tuple[LabeledData, LabeledData]:
+        """Deterministic MNIST-like data: smooth per-class prototypes + noise.
+
+        Returns (train, test). Linearly separable enough that the canonical
+        RandomFFT pipeline reaches its MNIST-level accuracy bar, small enough
+        to run in CI.
+        """
+        rng = np.random.default_rng(seed)
+        # Smooth prototypes: low-frequency random images per class.
+        freq = rng.normal(size=(num_classes, 8, 8))
+        protos = np.zeros((num_classes, 28, 28), dtype=np.float64)
+        for c in range(num_classes):
+            f = np.zeros((28, 28))
+            f[:8, :8] = freq[c]
+            protos[c] = np.abs(np.fft.ifft2(f).real)
+        protos = protos.reshape(num_classes, -1)
+        protos /= protos.max(axis=1, keepdims=True)
+
+        def make(count, seed_off):
+            r = np.random.default_rng(seed + seed_off)
+            y = r.integers(0, num_classes, size=count)
+            X = protos[y][:, :dim] if dim <= 784 else np.pad(
+                protos[y], ((0, 0), (0, dim - 784))
+            )
+            X = X + 0.35 * r.normal(size=X.shape)
+            return LabeledData(
+                X.astype(config.default_dtype), y.astype(np.int32)
+            )
+
+        return make(n, 1), make(max(n // 4, 256), 2)

@@ -1,0 +1,13 @@
+from keystone_tpu.nodes.learning.linear_mapper import (
+    LinearMapEstimator,
+    LinearMapper,
+)
+from keystone_tpu.nodes.learning.local_least_squares import (
+    LocalLeastSquaresEstimator,
+)
+
+__all__ = [
+    "LinearMapper",
+    "LinearMapEstimator",
+    "LocalLeastSquaresEstimator",
+]

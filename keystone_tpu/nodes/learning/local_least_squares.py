@@ -1,0 +1,38 @@
+"""Single-device dense least squares for small d.
+
+Ref: src/main/scala/nodes/learning/LocalLeastSquaresEstimator.scala —
+collect to the driver and solve directly [unverified]. Here "local" means
+one un-sharded XLA computation (still on the accelerator); it is the
+low-(n, d) corner of the LeastSquaresEstimator cost model.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.nodes.learning.linear_mapper import LinearMapper
+from keystone_tpu.workflow import LabelEstimator
+
+
+@jax.jit
+def _solve(X, Y, lam):
+    x_mean = X.mean(axis=0)
+    y_mean = Y.mean(axis=0)
+    Xc = X - x_mean
+    Yc = Y - y_mean
+    d = X.shape[1]
+    G = Xc.T @ Xc + lam * jnp.eye(d, dtype=X.dtype)
+    W = jnp.linalg.solve(G, Xc.T @ Yc)
+    return W, y_mean - x_mean @ W
+
+
+class LocalLeastSquaresEstimator(LabelEstimator):
+    def __init__(self, lam: float = 0.0):
+        self.lam = lam
+
+    def fit(self, data, labels) -> LinearMapper:
+        X = jnp.asarray(data)
+        Y = jnp.asarray(labels)
+        W, b = _solve(X, Y, jnp.asarray(self.lam, dtype=X.dtype))
+        return LinearMapper(W, b)

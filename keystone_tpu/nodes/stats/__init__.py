@@ -1,0 +1,19 @@
+from keystone_tpu.nodes.stats.random_signs import RandomSignNode
+from keystone_tpu.nodes.stats.fft import PaddedFFT
+from keystone_tpu.nodes.stats.rectifier import LinearRectifier
+from keystone_tpu.nodes.stats.scalers import StandardScaler, StandardScalerModel
+from keystone_tpu.nodes.stats.random_features import CosineRandomFeatures
+from keystone_tpu.nodes.stats.hellinger import SignedHellingerMapper
+from keystone_tpu.nodes.stats.samplers import sample_rows, sample_columns
+
+__all__ = [
+    "RandomSignNode",
+    "PaddedFFT",
+    "LinearRectifier",
+    "StandardScaler",
+    "StandardScalerModel",
+    "CosineRandomFeatures",
+    "SignedHellingerMapper",
+    "sample_rows",
+    "sample_columns",
+]

@@ -1,0 +1,29 @@
+"""Zero-padded FFT featurizer.
+
+Ref: src/main/scala/nodes/stats/PaddedFFT.scala — zero-pad the input vector
+to the next power of two and take the FFT (used by MnistRandomFFT,
+BASELINE.json) [unverified]. We use the real-input FFT and lay out the
+real and imaginary parts side by side, scaled by 1/sqrt(n) so downstream
+solvers see O(1) features; on TPU the batched FFT lowers to a single XLA op.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from keystone_tpu.workflow import Transformer
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class PaddedFFT(Transformer):
+    def apply_batch(self, X):
+        n = _next_pow2(X.shape[-1])
+        Xp = jnp.pad(X, [(0, 0)] * (X.ndim - 1) + [(0, n - X.shape[-1])])
+        F = jnp.fft.rfft(Xp, axis=-1) / jnp.sqrt(n).astype(Xp.dtype)
+        return jnp.concatenate([F.real, F.imag], axis=-1).astype(X.dtype)

@@ -1,0 +1,16 @@
+"""Signed Hellinger (signed square root) mapper — Fisher-Vector
+normalization step.
+
+Ref: src/main/scala/nodes/stats/SignedHellingerMapper.scala [unverified].
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from keystone_tpu.workflow import Transformer
+
+
+class SignedHellingerMapper(Transformer):
+    def apply_batch(self, X):
+        return jnp.sign(X) * jnp.sqrt(jnp.abs(X))

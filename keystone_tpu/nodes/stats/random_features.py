@@ -1,0 +1,48 @@
+"""Random Fourier features: cos(XW + b).
+
+Ref: src/main/scala/nodes/stats/CosineRandomFeatures.scala — W drawn
+Gaussian (RBF kernel) or Cauchy (Laplacian kernel), b uniform in [0, 2π);
+the TIMIT pipeline's featurizer (BASELINE.json) [unverified].
+
+The projection is one large MXU gemm; gamma scales the kernel bandwidth.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.config import config
+from keystone_tpu.workflow import Transformer
+
+
+class CosineRandomFeatures(Transformer):
+    def __init__(self, W: jax.Array, b: jax.Array):
+        self.W = jnp.asarray(W)
+        self.b = jnp.asarray(b)
+
+    @classmethod
+    def create(
+        cls,
+        input_dim: int,
+        num_features: int,
+        gamma: float = 1.0,
+        distribution: str = "gaussian",
+        seed: int = 0,
+    ) -> "CosineRandomFeatures":
+        kw, kb = jax.random.split(jax.random.PRNGKey(seed))
+        dtype = config.default_dtype
+        if distribution == "gaussian":
+            W = jax.random.normal(kw, (input_dim, num_features), dtype=dtype)
+        elif distribution == "cauchy":
+            W = jax.random.cauchy(kw, (input_dim, num_features), dtype=dtype)
+        else:
+            raise ValueError(f"unknown distribution {distribution!r}")
+        b = jax.random.uniform(
+            kb, (num_features,), minval=0.0, maxval=2 * np.pi, dtype=dtype
+        )
+        return cls(W * gamma, b)
+
+    def apply_batch(self, X):
+        return jnp.cos(X @ self.W + self.b)

@@ -1,0 +1,19 @@
+"""Linear rectifier: max(x, threshold) (+ optional alpha offset).
+
+Ref: src/main/scala/nodes/stats/LinearRectifier.scala [unverified].
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from keystone_tpu.workflow import Transformer
+
+
+class LinearRectifier(Transformer):
+    def __init__(self, max_val: float = 0.0, alpha: float = 0.0):
+        self.max_val = max_val
+        self.alpha = alpha
+
+    def apply_batch(self, X):
+        return jnp.maximum(X - self.alpha, self.max_val)

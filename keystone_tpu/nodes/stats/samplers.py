@@ -1,0 +1,26 @@
+"""Row/column sampling helpers feeding profilers and sample-based fits
+(GMM, PCA).
+
+Ref: src/main/scala/nodes/stats/Sampler.scala, ColumnSampler [unverified].
+Host-side index generation + device gather, deterministic by seed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def sample_rows(X, num_samples: int, seed: int = 0):
+    n = X.shape[0]
+    if num_samples >= n:
+        return X
+    idx = np.random.default_rng(seed).choice(n, size=num_samples, replace=False)
+    return X[np.sort(idx)]
+
+
+def sample_columns(X, num_cols: int, seed: int = 0):
+    d = X.shape[-1]
+    if num_cols >= d:
+        return X
+    idx = np.random.default_rng(seed).choice(d, size=num_cols, replace=False)
+    return X[..., np.sort(idx)]

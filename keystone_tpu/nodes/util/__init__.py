@@ -1,0 +1,13 @@
+from keystone_tpu.nodes.util.labels import ClassLabelIndicators
+from keystone_tpu.nodes.util.classifiers import MaxClassifier, TopKClassifier
+from keystone_tpu.nodes.util.misc import Cast, Identity, VectorCombiner, VectorSplitter
+
+__all__ = [
+    "ClassLabelIndicators",
+    "MaxClassifier",
+    "TopKClassifier",
+    "Cast",
+    "Identity",
+    "VectorSplitter",
+    "VectorCombiner",
+]

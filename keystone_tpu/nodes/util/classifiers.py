@@ -1,0 +1,28 @@
+"""Prediction post-processing nodes.
+
+Ref: src/main/scala/nodes/util/{MaxClassifier,TopKClassifier}.scala —
+argmax / top-k over the score vector [unverified].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.workflow import Transformer
+
+
+class MaxClassifier(Transformer):
+    def apply_batch(self, scores):
+        return jnp.argmax(scores, axis=-1).astype(jnp.int32)
+
+
+class TopKClassifier(Transformer):
+    """Indices of the k largest scores, best first."""
+
+    def __init__(self, k: int):
+        self.k = k
+
+    def apply_batch(self, scores):
+        _, idx = jax.lax.top_k(scores, self.k)
+        return idx.astype(jnp.int32)

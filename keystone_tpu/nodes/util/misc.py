@@ -1,0 +1,59 @@
+"""Small utility nodes.
+
+Ref: src/main/scala/nodes/util/{Identity,FloatToDouble,VectorSplitter,
+VectorCombiner}.scala [unverified]. `FloatToDouble` generalizes to `Cast`
+(on TPU the interesting casts are bf16 ↔ f32).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from keystone_tpu.workflow import Transformer
+
+
+class Identity(Transformer):
+    def apply_batch(self, X):
+        return X
+
+
+class Cast(Transformer):
+    def __init__(self, dtype):
+        self.dtype = jnp.dtype(dtype)
+
+    def signature(self):
+        return ("cast", str(self.dtype))
+
+    def apply_batch(self, X):
+        return jnp.asarray(X).astype(self.dtype)
+
+
+class VectorSplitter(Transformer):
+    """Split the feature axis into fixed-size blocks (the bridge into the
+    block solvers; Ref: nodes/util/VectorSplitter.scala [unverified]).
+
+    Returns a list of arrays — host-level structure, so not jittable.
+    """
+
+    jittable = False
+
+    def __init__(self, block_size: int):
+        self.block_size = block_size
+
+    def apply_batch(self, X) -> List:
+        d = X.shape[-1]
+        return [
+            X[..., s : min(s + self.block_size, d)]
+            for s in range(0, d, self.block_size)
+        ]
+
+
+class VectorCombiner(Transformer):
+    """Concatenate a list of feature blocks back together."""
+
+    jittable = False
+
+    def apply_batch(self, blocks):
+        return jnp.concatenate(blocks, axis=-1)

@@ -1,0 +1,121 @@
+"""MnistRandomFFT — the canonical MNIST pipeline.
+
+Ref: src/main/scala/pipelines/images/mnist/MnistRandomFFT.scala
+(BASELINE.json config: "random-Fourier features + LinearMapEstimator"):
+for each of `num_ffts` blocks, RandomSignNode → PaddedFFT → LinearRectifier;
+blocks merged with Pipeline.gather; LinearMapEstimator on the gathered
+features; MaxClassifier [unverified].
+
+TPU notes: the whole featurization (sign flips, batched FFTs, rectifier,
+concat) fuses into one XLA computation by the chain-fusion rule + gather
+node; the solve is the psum-reduced distributed ridge solver.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.loaders import MnistLoader
+from keystone_tpu.nodes.learning import LinearMapEstimator
+from keystone_tpu.nodes.stats import LinearRectifier, PaddedFFT, RandomSignNode
+from keystone_tpu.nodes.util import ClassLabelIndicators, MaxClassifier
+from keystone_tpu.workflow import Pipeline
+
+
+@dataclass
+class MnistRandomFFTConfig:
+    train_path: Optional[str] = None
+    test_path: Optional[str] = None
+    num_ffts: int = 4
+    lam: float = 0.1
+    seed: int = 0
+    num_classes: int = 10
+    synthetic_n: int = 4096  # used when no data paths are given
+
+
+def build_pipeline(conf: MnistRandomFFTConfig, train, train_labels) -> Pipeline:
+    dim = train.shape[1]
+    branches = [
+        RandomSignNode.create(dim, seed=conf.seed + i)
+        .and_then(PaddedFFT())
+        .and_then(LinearRectifier())
+        for i in range(conf.num_ffts)
+    ]
+    features = Pipeline.gather(branches)
+    targets = ClassLabelIndicators(conf.num_classes)(train_labels)
+    return features.and_then(
+        LinearMapEstimator(lam=conf.lam), train, targets
+    ).and_then(MaxClassifier())
+
+
+def run(conf: MnistRandomFFTConfig) -> dict:
+    t0 = time.time()
+    if conf.train_path:
+        if not conf.test_path:
+            raise ValueError(
+                "--test is required when --train is given (evaluating on the "
+                "training set would report memorization as test accuracy)"
+            )
+        train = MnistLoader.load(conf.train_path)
+        test = MnistLoader.load(conf.test_path)
+    else:
+        train, test = MnistLoader.synthetic(n=conf.synthetic_n, seed=conf.seed)
+    t_load = time.time() - t0
+
+    t0 = time.time()
+    pipeline = build_pipeline(conf, train.data, train.labels)
+    predictions = pipeline(test.data).get()  # fits lazily, then predicts
+    t_fit = time.time() - t0
+
+    metrics = MulticlassClassifierEvaluator(conf.num_classes).evaluate(
+        predictions, test.labels
+    )
+    train_pred = pipeline(train.data).get()
+    train_metrics = MulticlassClassifierEvaluator(conf.num_classes).evaluate(
+        train_pred, train.labels
+    )
+    return {
+        "test_accuracy": metrics.total_accuracy,
+        "train_accuracy": train_metrics.total_accuracy,
+        "macro_f1": metrics.macro_f1,
+        "load_seconds": t_load,
+        "fit_predict_seconds": t_fit,
+        "summary": metrics.summary(),
+    }
+
+
+def main(argv=None):
+    from keystone_tpu.utils.platform import setup_platform
+
+    setup_platform()
+    p = argparse.ArgumentParser(description="MnistRandomFFT pipeline")
+    p.add_argument("--train", dest="train_path")
+    p.add_argument("--test", dest="test_path")
+    p.add_argument("--num-ffts", type=int, default=4)
+    p.add_argument("--lam", type=float, default=0.1)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--synthetic-n", type=int, default=4096)
+    a = p.parse_args(argv)
+    conf = MnistRandomFFTConfig(
+        train_path=a.train_path,
+        test_path=a.test_path,
+        num_ffts=a.num_ffts,
+        lam=a.lam,
+        seed=a.seed,
+        synthetic_n=a.synthetic_n,
+    )
+    out = run(conf)
+    print(out["summary"])
+    print(
+        f"train acc {out['train_accuracy']:.4f} | "
+        f"load {out['load_seconds']:.2f}s | fit+predict {out['fit_predict_seconds']:.2f}s"
+    )
+    return out
+
+
+if __name__ == "__main__":
+    main()

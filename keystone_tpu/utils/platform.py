@@ -1,0 +1,18 @@
+"""Platform selection for CLI entry points.
+
+`KEYSTONE_PLATFORM=cpu|axon|tpu` forces the JAX platform. Needed because the
+axon sitecustomize force-registers the TPU plugin regardless of
+JAX_PLATFORMS; config.update after import is the reliable switch.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def setup_platform() -> None:
+    plat = os.environ.get("KEYSTONE_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)

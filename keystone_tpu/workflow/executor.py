@@ -171,9 +171,15 @@ class PipelineEnv:
         cls._instance = None
 
     def clear_caches(self) -> None:
-        """Drop all memoized fits and persisted values (frees pinned data)."""
+        """Drop all memoized fits, persisted values, and optimizer-held state
+        (frees pinned data)."""
         self.fit_cache.clear()
         self.node_cache.clear()
+        for _name, rules, _iters in getattr(self.optimizer, "batches", []):
+            for rule in rules:
+                clear = getattr(rule, "clear_cache", None)
+                if clear is not None:
+                    clear()
 
     def optimize_and_execute(self, graph: Graph, sink: GraphId) -> Any:
         g = self.optimizer.execute(graph, [sink])

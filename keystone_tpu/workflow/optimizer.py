@@ -73,6 +73,9 @@ class ChainFusionRule(Rule):
         # so the id keys can never alias recycled objects.
         self._fuse_cache: Dict[Tuple[int, ...], FusedTransformer] = {}
 
+    def clear_cache(self) -> None:
+        self._fuse_cache.clear()
+
     def _fused(self, stages: List) -> FusedTransformer:
         key = tuple(id(s) for s in stages)
         fused = self._fuse_cache.get(key)

@@ -76,6 +76,12 @@ class Transformer:
 
     def apply_batch(self, X: Any) -> Any:
         # Host-side default: per-datum loop. Device transformers override.
+        if type(self).apply is Transformer.apply:
+            # Neither method overridden — fail clearly instead of letting the
+            # two defaults recurse into each other.
+            raise NotImplementedError(
+                f"{type(self).__name__} must override apply_batch() or apply()"
+            )
         return [self.apply(x) for x in X]
 
     # -- execution ---------------------------------------------------------

@@ -1,0 +1,58 @@
+"""Linear solver estimators vs oracles; evaluation metrics."""
+
+import numpy as np
+
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.nodes.learning import (
+    LinearMapEstimator,
+    LocalLeastSquaresEstimator,
+)
+
+
+def _ridge_with_intercept_oracle(X, Y, lam):
+    Xc = X - X.mean(axis=0)
+    Yc = Y - Y.mean(axis=0)
+    d = X.shape[1]
+    W = np.linalg.solve(Xc.T @ Xc + lam * np.eye(d), Xc.T @ Yc)
+    b = Y.mean(axis=0) - X.mean(axis=0) @ W
+    return W, b
+
+
+def test_linear_map_estimator_matches_oracle(rng):
+    X = rng.normal(size=(120, 10)).astype(np.float32)
+    Y = rng.normal(size=(120, 3)).astype(np.float32)
+    lam = 0.5
+    mapper = LinearMapEstimator(lam=lam).fit(X, Y)
+    W, b = _ridge_with_intercept_oracle(
+        X.astype(np.float64), Y.astype(np.float64), lam
+    )
+    np.testing.assert_allclose(mapper.W, W, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(mapper.b, b, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(mapper(X), X @ W + b, rtol=1e-3, atol=1e-3)
+
+
+def test_linear_map_estimator_tsqr_method(rng):
+    X = rng.normal(size=(80, 6)).astype(np.float32)
+    Y = rng.normal(size=(80, 2)).astype(np.float32)
+    m_normal = LinearMapEstimator(lam=0.1).fit(X, Y)
+    m_tsqr = LinearMapEstimator(lam=0.1, method="tsqr").fit(X, Y)
+    np.testing.assert_allclose(m_normal.W, m_tsqr.W, rtol=1e-3, atol=1e-3)
+
+
+def test_local_least_squares_matches_distributed(rng):
+    X = rng.normal(size=(60, 5)).astype(np.float32)
+    Y = rng.normal(size=(60, 2)).astype(np.float32)
+    m_local = LocalLeastSquaresEstimator(lam=0.2).fit(X, Y)
+    m_dist = LinearMapEstimator(lam=0.2).fit(X, Y)
+    np.testing.assert_allclose(m_local.W, m_dist.W, rtol=1e-3, atol=1e-3)
+
+
+def test_multiclass_evaluator():
+    pred = np.array([0, 1, 1, 2, 2, 2])
+    act = np.array([0, 1, 2, 2, 2, 0])
+    m = MulticlassClassifierEvaluator(3).evaluate(pred, act)
+    assert m.confusion.sum() == 6
+    assert m.confusion[2, 2] == 2
+    np.testing.assert_allclose(m.total_accuracy, 4 / 6)
+    np.testing.assert_allclose(m.per_class_accuracy, [0.5, 1.0, 2 / 3])
+    assert 0.0 < m.macro_f1 <= 1.0

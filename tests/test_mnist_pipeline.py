@@ -1,0 +1,36 @@
+"""End-to-end MnistRandomFFT integration test (SURVEY.md §4: whole-pipeline
+suite on a tiny dataset asserting accuracy above a floor)."""
+
+import numpy as np
+
+from keystone_tpu.loaders import MnistLoader
+from keystone_tpu.pipelines.images.mnist_random_fft import (
+    MnistRandomFFTConfig,
+    build_pipeline,
+    run,
+)
+
+
+def test_synthetic_loader_deterministic():
+    a, _ = MnistLoader.synthetic(n=64, seed=3)
+    b, _ = MnistLoader.synthetic(n=64, seed=3)
+    np.testing.assert_array_equal(a.data, b.data)
+    np.testing.assert_array_equal(a.labels, b.labels)
+    assert a.data.shape == (64, 784)
+
+
+def test_mnist_random_fft_end_to_end():
+    out = run(MnistRandomFFTConfig(num_ffts=2, synthetic_n=1024, seed=0))
+    # The acceptance bar from SURVEY.md §7 step 2 (>=96% on MNIST-like data).
+    assert out["test_accuracy"] >= 0.96, out["summary"]
+
+
+def test_fitted_pipeline_reusable():
+    conf = MnistRandomFFTConfig(num_ffts=1, synthetic_n=512, seed=1)
+    train, test = MnistLoader.synthetic(n=conf.synthetic_n, seed=conf.seed)
+    pipe = build_pipeline(conf, train.data, train.labels)
+    fitted = pipe.fit()
+    p1 = np.asarray(fitted(test.data).get())
+    p2 = np.asarray(fitted(test.data).get())
+    np.testing.assert_array_equal(p1, p2)
+    assert p1.shape == (test.data.shape[0],)
