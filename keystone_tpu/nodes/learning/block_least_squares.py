@@ -1,0 +1,141 @@
+"""Block least squares — the workhorse solver for high-dimensional features.
+
+Ref: src/main/scala/nodes/learning/BlockLinearMapper.scala —
+`BlockLeastSquaresEstimator(blockSize, numIter, lambda)` runs ml-matrix
+BlockCoordinateDescent over feature blocks and returns `BlockLinearMapper`
+(per-block weights applied block-by-block); the CIFAR/TIMIT workhorse.
+`BlockWeightedLeastSquaresEstimator(..., mixtureWeight)` is the
+class-rebalanced ImageNet variant (SURVEY.md §2.4, §3.2) [unverified].
+
+TPU lowering: see keystone_tpu/linalg/bcd.py. The intercept is fit by
+centering features and labels (b = ȳ − x̄ᵀW), matching the reference's
+mean-scaler pairing.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.linalg import RowMatrix, block_coordinate_descent
+from keystone_tpu.workflow import LabelEstimator, Transformer
+
+
+class BlockLinearMapper(Transformer):
+    """Applies W block-by-block: scores = Σ_b X_b W_b + b.
+
+    Keeping per-block weights (instead of one dense (d, k) matrix) is what
+    lets a 256k-dim model stream through memory; XLA fuses the per-block
+    gemm+accumulate chain.
+    """
+
+    def __init__(
+        self,
+        W_blocks: Sequence[jax.Array],
+        blocks: Sequence[Tuple[int, int]],
+        b: Optional[jax.Array] = None,
+    ):
+        self.W_blocks = [jnp.asarray(w) for w in W_blocks]
+        self.blocks = list(blocks)
+        self.b = None if b is None else jnp.asarray(b)
+
+    def apply_batch(self, X):
+        out = None
+        for (s, e), w in zip(self.blocks, self.W_blocks):
+            contrib = X[..., s:e] @ w
+            out = contrib if out is None else out + contrib
+        if self.b is not None:
+            out = out + self.b
+        return out
+
+    @property
+    def W(self) -> jax.Array:
+        return jnp.concatenate(self.W_blocks, axis=0)
+
+
+class BlockLeastSquaresEstimator(LabelEstimator):
+    def __init__(
+        self,
+        block_size: int = 4096,
+        num_iters: int = 1,
+        lam: float = 0.0,
+        fit_intercept: bool = True,
+    ):
+        self.block_size = block_size
+        self.num_iters = num_iters
+        self.lam = lam
+        self.fit_intercept = fit_intercept
+
+    def _weights(self, Y: jnp.ndarray) -> Optional[jax.Array]:
+        return None
+
+    def fit(self, data, labels) -> BlockLinearMapper:
+        X = jnp.asarray(data)
+        Y = jnp.asarray(labels)
+        weights = self._weights(Y)
+        if self.fit_intercept:
+            # Weighted problems need weighted centering: the intercept of
+            # weighted ridge absorbs the weighted means, b = ȳ_w − x̄_wᵀW.
+            if weights is None:
+                x_mean = X.mean(axis=0)
+                y_mean = Y.mean(axis=0)
+            else:
+                wsum = jnp.maximum(weights.sum(), 1e-12)
+                x_mean = (weights[:, None] * X).sum(axis=0) / wsum
+                y_mean = (weights[:, None] * Y).sum(axis=0) / wsum
+            X = X - x_mean
+            Y = Y - y_mean
+        A = RowMatrix.from_array(X)
+        B = RowMatrix.from_array(Y)
+        W_blocks, blocks = block_coordinate_descent(
+            A,
+            B,
+            block_size=self.block_size,
+            num_iters=self.num_iters,
+            lam=self.lam,
+            row_weights=weights,
+        )
+        b = None
+        if self.fit_intercept:
+            W = jnp.concatenate(W_blocks, axis=0)
+            b = y_mean - x_mean @ W
+        return BlockLinearMapper(W_blocks, blocks, b)
+
+
+class BlockWeightedLeastSquaresEstimator(BlockLeastSquaresEstimator):
+    """Class-rebalanced block least squares.
+
+    Each example of class c gets weight
+        w = (1 − mixture_weight) + mixture_weight · n / (k · n_c),
+    i.e. mixture_weight interpolates between the unweighted problem (0) and
+    fully class-balanced weighting (1). Reconstruction of the reference's
+    `mixtureWeight` semantics [unverified — verify against
+    nodes/learning/BlockWeightedLeastSquaresEstimator.scala].
+    """
+
+    def __init__(
+        self,
+        block_size: int = 4096,
+        num_iters: int = 1,
+        lam: float = 0.0,
+        mixture_weight: float = 0.5,
+        fit_intercept: bool = True,
+    ):
+        super().__init__(block_size, num_iters, lam, fit_intercept)
+        self.mixture_weight = mixture_weight
+
+    def _weights(self, Y: jnp.ndarray) -> Optional[jax.Array]:
+        # Y may be centered; class identity is still the row-wise argmax of
+        # the ±1 indicator encoding.
+        classes = jnp.argmax(Y, axis=1)
+        k = Y.shape[1]
+        n = Y.shape[0]
+        counts = jnp.bincount(classes, length=k).astype(Y.dtype)
+        counts = jnp.maximum(counts, 1.0)
+        per_class = (1.0 - self.mixture_weight) + self.mixture_weight * n / (
+            k * counts
+        )
+        return per_class[classes]

@@ -1,0 +1,112 @@
+"""Diagonal-covariance Gaussian mixture fit by EM.
+
+Ref: src/main/scala/nodes/learning/GaussianMixtureModel.scala —
+`GaussianMixtureModelEstimator` (Breeze EM) and the EncEval-backed external
+variant used for Fisher vectors; diagonal covariances (SURVEY.md §2.4,
+§3.4) [unverified].
+
+TPU lowering: each EM sweep is responsibilities (log-space gemm-shaped
+computation + logsumexp) and moment re-estimation (two MXU gemms), scanned
+with lax.fori_loop into a single XLA program. This is the pure-TPU GMM; the
+C++ EncEval-parity implementation lives in keystone_tpu/native.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.config import config
+from keystone_tpu.nodes.learning.kmeans import _fit_kmeans, _sq_dists
+from keystone_tpu.workflow import Estimator, Transformer
+
+
+class GaussianMixtureModel(Transformer):
+    """Fitted GMM. As a transformer it emits per-component soft assignments
+    (responsibilities) — the quantity Fisher-vector encoding consumes."""
+
+    def __init__(self, weights, means, variances):
+        self.weights = jnp.asarray(weights)  # (k,)
+        self.means = jnp.asarray(means)  # (k, d)
+        self.variances = jnp.asarray(variances)  # (k, d)
+
+    def log_likelihoods(self, X):
+        """(n, k) log p(x | component j) + log w_j."""
+        X = jnp.asarray(X)
+        inv = 1.0 / self.variances  # (k, d)
+        # Expand ||(x - μ)/σ||² into gemm-shaped terms.
+        quad = (
+            (X * X) @ inv.T
+            - 2.0 * X @ (self.means * inv).T
+            + jnp.sum(self.means * self.means * inv, axis=1)
+        )
+        log_det = jnp.sum(jnp.log(self.variances), axis=1)
+        d = X.shape[1]
+        log_norm = -0.5 * (d * jnp.log(2 * jnp.pi) + log_det)
+        return jnp.log(self.weights) + log_norm - 0.5 * quad
+
+    def apply_batch(self, X):
+        ll = self.log_likelihoods(X)
+        return jax.nn.softmax(ll, axis=-1)
+
+    def predict(self, X):
+        return jnp.argmax(self.log_likelihoods(X), axis=-1)
+
+
+@partial(jax.jit, static_argnames=("k", "max_iters"))
+def _fit_gmm(X, key, k: int, max_iters: int, min_var: float):
+    n, d = X.shape
+
+    # Init from a short k-means run.
+    centers = _fit_kmeans(X, key, k, 5)
+    assign = jnp.argmin(_sq_dists(X, centers), axis=1)
+    onehot = jax.nn.one_hot(assign, k, dtype=X.dtype)
+    counts = jnp.maximum(onehot.sum(axis=0), 1.0)
+    weights0 = counts / n
+    means0 = (onehot.T @ X) / counts[:, None]
+    ex2 = (onehot.T @ (X * X)) / counts[:, None]
+    vars0 = jnp.maximum(ex2 - means0**2, min_var)
+
+    def em(_i, carry):
+        weights, means, variances = carry
+        inv = 1.0 / variances
+        quad = (
+            (X * X) @ inv.T
+            - 2.0 * X @ (means * inv).T
+            + jnp.sum(means * means * inv, axis=1)
+        )
+        log_norm = -0.5 * (
+            d * jnp.log(2 * jnp.pi) + jnp.sum(jnp.log(variances), axis=1)
+        )
+        log_r = jnp.log(weights) + log_norm - 0.5 * quad
+        r = jax.nn.softmax(log_r, axis=-1)  # (n, k)
+        nk = jnp.maximum(r.sum(axis=0), 1e-6)
+        new_means = (r.T @ X) / nk[:, None]
+        new_ex2 = (r.T @ (X * X)) / nk[:, None]
+        new_vars = jnp.maximum(new_ex2 - new_means**2, min_var)
+        return nk / n, new_means, new_vars
+
+    return jax.lax.fori_loop(0, max_iters, em, (weights0, means0, vars0))
+
+
+class GaussianMixtureModelEstimator(Estimator):
+    def __init__(
+        self,
+        k: int,
+        max_iters: int = 50,
+        min_var: float = 1e-4,
+        seed: int = 0,
+    ):
+        self.k = k
+        self.max_iters = max_iters
+        self.min_var = min_var
+        self.seed = seed
+
+    def fit(self, data) -> GaussianMixtureModel:
+        X = jnp.asarray(data, dtype=config.default_dtype)
+        w, m, v = _fit_gmm(
+            X, jax.random.PRNGKey(self.seed), self.k, self.max_iters, self.min_var
+        )
+        return GaussianMixtureModel(w, m, v)

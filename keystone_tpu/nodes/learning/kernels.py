@@ -1,0 +1,44 @@
+"""Kernel generators.
+
+Ref: src/main/scala/nodes/learning/KernelMatrix.scala /
+GaussianKernelGenerator (SURVEY.md §2.4 kernel ridge row) [unverified].
+A kernel generator produces gemm-shaped kernel blocks on demand — the
+KernelMatrix of the reference becomes block computation fused into the
+consumer, never an n×n array in memory.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def pairwise_sq_dists(X, Z):
+    """||x − z||² for all pairs, gemm-shaped (MXU-friendly), clamped ≥ 0
+    against cancellation. The single source of truth for this expansion."""
+    sq = (
+        jnp.sum(X * X, axis=1, keepdims=True)
+        - 2.0 * X @ Z.T
+        + jnp.sum(Z * Z, axis=1)
+    )
+    return jnp.maximum(sq, 0.0)
+
+
+class KernelGenerator:
+    def block(self, X, Z):
+        """Kernel block k(X, Z) of shape (len(X), len(Z))."""
+        raise NotImplementedError
+
+
+class GaussianKernelGenerator(KernelGenerator):
+    """k(x, z) = exp(−gamma ||x − z||²)."""
+
+    def __init__(self, gamma: float):
+        self.gamma = gamma
+
+    def block(self, X, Z):
+        return jnp.exp(-self.gamma * pairwise_sq_dists(X, Z))
+
+
+class LinearKernelGenerator(KernelGenerator):
+    def block(self, X, Z):
+        return X @ Z.T

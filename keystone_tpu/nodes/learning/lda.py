@@ -1,0 +1,47 @@
+"""Linear discriminant analysis (projection learning).
+
+Ref: src/main/scala/nodes/learning/LinearDiscriminantAnalysis.scala —
+solves the generalized eigenproblem on between-/within-class scatter and
+projects onto the top discriminant directions [unverified].
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from keystone_tpu.config import config
+from keystone_tpu.nodes.learning.pca import PCATransformer
+from keystone_tpu.workflow import LabelEstimator
+
+
+class LinearDiscriminantAnalysis(LabelEstimator):
+    def __init__(self, dims: int, eps: float = 1e-6):
+        self.dims = dims
+        self.eps = eps
+
+    def fit(self, data, labels) -> PCATransformer:
+        X = jnp.asarray(data, dtype=config.default_dtype)
+        y = jnp.asarray(labels).astype(jnp.int32).ravel()
+        classes = jnp.unique(y)  # host-side: label set is data-dependent
+        mean = X.mean(axis=0)
+        d = X.shape[1]
+        Sw = jnp.zeros((d, d), X.dtype)
+        Sb = jnp.zeros((d, d), X.dtype)
+        for c in classes:
+            mask = (y == c)[:, None].astype(X.dtype)
+            nc = mask.sum()
+            mu_c = (X * mask).sum(axis=0) / jnp.maximum(nc, 1.0)
+            Xc = (X - mu_c) * mask
+            Sw = Sw + Xc.T @ Xc
+            diff = (mu_c - mean)[:, None]
+            Sb = Sb + nc * (diff @ diff.T)
+        # Solve Sw⁻¹ Sb via symmetric whitening for stability.
+        evals_w, evecs_w = jnp.linalg.eigh(
+            Sw + self.eps * jnp.eye(d, dtype=X.dtype)
+        )
+        inv_sqrt = (evecs_w / jnp.sqrt(evals_w)) @ evecs_w.T
+        M = inv_sqrt @ Sb @ inv_sqrt
+        _evals, evecs = jnp.linalg.eigh(M)
+        # eigh sorts ascending: take the top `dims`, best first.
+        top = evecs[:, ::-1][:, : self.dims]
+        return PCATransformer(inv_sqrt @ top, mean)

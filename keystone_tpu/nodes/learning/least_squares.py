@@ -1,0 +1,105 @@
+"""Solver-selecting least squares — the node-level-optimizable estimator.
+
+Ref: src/main/scala/nodes/learning/LeastSquaresEstimator.scala — an
+`Optimizable` estimator advertising {local, normal-equations/TSQR, block}
+implementations; a cost model picks one from data stats (n, d, k, cluster
+size) at optimization time (SURVEY.md §2.4, §3.5) [unverified].
+
+The cost model here is re-grounded in TPU reality (SURVEY.md §7 hard part
+5: "the algorithm carries over, the constants don't"):
+
+- gram memory: normal equations materialize a (d, d) gram — must fit HBM
+  alongside the data shard; past that, block coordinate descent streams
+  feature blocks.
+- conditioning: TSQR costs ~2× normal equations but squares neither the
+  condition number nor the gram storage on the augmented system.
+- tiny problems: one un-sharded solve avoids collective overhead.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from keystone_tpu.config import config
+from keystone_tpu.nodes.learning.block_least_squares import (
+    BlockLeastSquaresEstimator,
+)
+from keystone_tpu.nodes.learning.linear_mapper import LinearMapEstimator
+from keystone_tpu.nodes.learning.local_least_squares import (
+    LocalLeastSquaresEstimator,
+)
+from keystone_tpu.workflow import LabelEstimator, Transformer
+
+
+@dataclass
+class SolverChoice:
+    name: str  # "local" | "normal" | "block"
+    reason: str
+
+
+def choose_solver(
+    n: int,
+    d: int,
+    k: int,
+    hbm_budget_bytes: int | None = None,
+    block_size: int = 4096,
+) -> SolverChoice:
+    hbm = hbm_budget_bytes or config.hbm_budget_bytes
+    bytes_per = 4  # f32
+    if n * d * bytes_per < 1 << 24 and d <= 2048:
+        return SolverChoice("local", f"tiny problem (n={n}, d={d})")
+    # Normal equations materialize the (d, d) gram plus the replicated (d, k)
+    # solution and rhs; all must sit in HBM next to the data shard.
+    dense_bytes = (d * d + 2 * d * k) * bytes_per
+    if dense_bytes <= hbm // 8 and d <= 32768:
+        return SolverChoice(
+            "normal", f"gram+solution fit HBM ({dense_bytes >> 20} MiB)"
+        )
+    return SolverChoice(
+        "block",
+        f"(d={d}, k={k}) too large for a dense gram; "
+        f"streaming {block_size}-col blocks",
+    )
+
+
+class LeastSquaresEstimator(LabelEstimator):
+    """Picks the concrete solver by cost model at fit time.
+
+    `num_iters`/`block_size` only apply when the block solver is chosen.
+    """
+
+    def __init__(
+        self,
+        lam: float = 0.0,
+        block_size: int = 4096,
+        num_iters: int = 3,
+        hbm_budget_bytes: int | None = None,
+    ):
+        self.lam = lam
+        self.block_size = block_size
+        self.num_iters = num_iters
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self.last_choice: SolverChoice | None = None
+
+    def fit(self, data, labels) -> Transformer:
+        X = jnp.asarray(data)
+        Y = jnp.asarray(labels)
+        n, d = X.shape
+        k = Y.shape[1] if Y.ndim > 1 else 1
+        choice = choose_solver(
+            n, d, k, self.hbm_budget_bytes, self.block_size
+        )
+        self.last_choice = choice
+        if choice.name == "local":
+            est: LabelEstimator = LocalLeastSquaresEstimator(self.lam)
+        elif choice.name == "normal":
+            est = LinearMapEstimator(self.lam)
+        else:
+            est = BlockLeastSquaresEstimator(
+                block_size=self.block_size,
+                num_iters=self.num_iters,
+                lam=self.lam,
+            )
+        return est.fit(X, Y)

@@ -1,0 +1,83 @@
+"""Multinomial logistic regression trained with LBFGS.
+
+Ref: src/main/scala/nodes/learning/LogisticRegressionEstimator.scala —
+wraps MLlib `LogisticRegressionWithLBFGS` (SURVEY.md §2.4) [unverified].
+Re-implemented natively: optax LBFGS minimizing softmax cross-entropy +
+L2, the whole optimization loop compiled as one XLA while-loop.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.config import config
+from keystone_tpu.workflow import LabelEstimator, Transformer
+
+
+class LogisticRegressionModel(Transformer):
+    def __init__(self, W, b):
+        self.W = jnp.asarray(W)
+        self.b = jnp.asarray(b)
+
+    def apply_batch(self, X):
+        """Class scores (logits); compose MaxClassifier for labels."""
+        return X @ self.W + self.b
+
+
+@partial(jax.jit, static_argnames=("num_classes", "max_iters"))
+def _fit_lbfgs(X, y, num_classes: int, reg: float, max_iters: int):
+    import optax  # deferred: only this estimator needs optax
+
+    n, d = X.shape
+    onehot = jax.nn.one_hot(y, num_classes, dtype=X.dtype)
+
+    def loss_fn(params):
+        W, b = params
+        logits = X @ W + b
+        ce = -jnp.mean(
+            jnp.sum(onehot * jax.nn.log_softmax(logits, axis=-1), axis=-1)
+        )
+        return ce + 0.5 * reg * jnp.sum(W * W)
+
+    params = (
+        jnp.zeros((d, num_classes), X.dtype),
+        jnp.zeros((num_classes,), X.dtype),
+    )
+    opt = optax.lbfgs()
+    state = opt.init(params)
+    value_and_grad = optax.value_and_grad_from_state(loss_fn)
+
+    def step(carry, _):
+        params, state = carry
+        value, grad = value_and_grad(params, state=state)
+        updates, state = opt.update(
+            grad, state, params, value=value, grad=grad, value_fn=loss_fn
+        )
+        params = optax.apply_updates(params, updates)
+        return (params, state), value
+
+    (params, _state), _losses = jax.lax.scan(
+        step, (params, state), None, length=max_iters
+    )
+    return params
+
+
+class LogisticRegressionEstimator(LabelEstimator):
+    def __init__(
+        self,
+        num_classes: int,
+        reg: float = 1e-4,
+        max_iters: int = 100,
+    ):
+        self.num_classes = num_classes
+        self.reg = reg
+        self.max_iters = max_iters
+
+    def fit(self, data, labels) -> LogisticRegressionModel:
+        X = jnp.asarray(data, dtype=config.default_dtype)
+        y = jnp.asarray(labels).astype(jnp.int32).ravel()
+        W, b = _fit_lbfgs(X, y, self.num_classes, self.reg, self.max_iters)
+        return LogisticRegressionModel(W, b)

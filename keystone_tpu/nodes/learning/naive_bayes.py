@@ -1,0 +1,47 @@
+"""Multinomial naive Bayes.
+
+Ref: src/main/scala/nodes/learning/NaiveBayesEstimator.scala — wraps Spark
+MLlib `NaiveBayes` (multinomial, additive smoothing); the Newsgroups
+classifier (SURVEY.md §2.4, §2.11) [unverified]. Re-implemented natively
+(SURVEY.md §7 non-goals: MLlib internals) — fit is two reductions; the
+model emits log-posterior scores, so MaxClassifier composes downstream.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.config import config
+from keystone_tpu.workflow import LabelEstimator, Transformer
+
+
+class NaiveBayesModel(Transformer):
+    def __init__(self, log_prior, log_likelihood):
+        self.log_prior = jnp.asarray(log_prior)  # (k,)
+        self.log_likelihood = jnp.asarray(log_likelihood)  # (k, d)
+
+    def apply_batch(self, X):
+        return X @ self.log_likelihood.T + self.log_prior
+
+
+class NaiveBayesEstimator(LabelEstimator):
+    """fit(term-frequency features, int labels) with Laplace smoothing."""
+
+    def __init__(self, num_classes: int, smoothing: float = 1.0):
+        self.num_classes = num_classes
+        self.smoothing = smoothing
+
+    def fit(self, data, labels) -> NaiveBayesModel:
+        X = jnp.asarray(data, dtype=config.default_dtype)
+        y = jnp.asarray(labels).astype(jnp.int32).ravel()
+        k = self.num_classes
+        onehot = jax.nn.one_hot(y, k, dtype=X.dtype)  # (n, k)
+        class_counts = onehot.sum(axis=0)  # (k,)
+        feature_counts = onehot.T @ X  # (k, d)
+        log_prior = jnp.log(class_counts) - jnp.log(class_counts.sum())
+        smoothed = feature_counts + self.smoothing
+        log_likelihood = jnp.log(smoothed) - jnp.log(
+            smoothed.sum(axis=1, keepdims=True)
+        )
+        return NaiveBayesModel(log_prior, log_likelihood)

@@ -1,0 +1,68 @@
+"""PCA: local SVD and distributed (TSQR-based) variants.
+
+Ref: src/main/scala/nodes/learning/PCA.scala — `PCAEstimator` (driver-local
+SVD via Breeze/LAPACK gesdd) and `DistributedPCAEstimator` (TSQR-based),
+both producing `PCATransformer` projecting onto the top components
+(SURVEY.md §2.4, §3.4: PCA of SIFT descriptors) [unverified].
+
+TPU lowering: the local variant is one on-device SVD of the centered data;
+the distributed variant reduces the row-sharded data to its (d, d) R factor
+by TSQR (all_gather over ICI), then SVDs the small R — identical right
+singular vectors, no n×d gather.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.linalg import RowMatrix, tsqr_r
+from keystone_tpu.workflow import Estimator, Transformer
+
+
+class PCATransformer(Transformer):
+    def __init__(self, components: jax.Array, mean: jax.Array | None = None):
+        # components: (d, dims) — columns are principal directions.
+        self.components = jnp.asarray(components)
+        self.mean = None if mean is None else jnp.asarray(mean)
+
+    def apply_batch(self, X):
+        if self.mean is not None:
+            X = X - self.mean
+        return X @ self.components
+
+
+def _components_from_r(R: jax.Array, dims: int) -> jax.Array:
+    # Right singular vectors of the data = eigenvectors of RᵀR.
+    _u, _s, vt = jnp.linalg.svd(R, full_matrices=False)
+    return vt[:dims].T
+
+
+class PCAEstimator(Estimator):
+    """Un-sharded SVD PCA (the sample sizes the reference uses fit easily)."""
+
+    def __init__(self, dims: int, center: bool = True):
+        self.dims = dims
+        self.center = center
+
+    def fit(self, data) -> PCATransformer:
+        X = jnp.asarray(data)
+        mean = X.mean(axis=0) if self.center else None
+        Xc = X - mean if self.center else X
+        _u, _s, vt = jnp.linalg.svd(Xc, full_matrices=False)
+        return PCATransformer(vt[: self.dims].T, mean)
+
+
+class DistributedPCAEstimator(Estimator):
+    """PCA via TSQR of the row-sharded (centered) data matrix."""
+
+    def __init__(self, dims: int, center: bool = True):
+        self.dims = dims
+        self.center = center
+
+    def fit(self, data) -> PCATransformer:
+        X = jnp.asarray(data)
+        mean = X.mean(axis=0) if self.center else None
+        Xc = X - mean if self.center else X
+        R = tsqr_r(RowMatrix.from_array(Xc))
+        return PCATransformer(_components_from_r(R, self.dims), mean)

@@ -1,0 +1,39 @@
+"""ZCA whitening (the RandomPatchCifar preprocessing).
+
+Ref: src/main/scala/nodes/images/ZCAWhitener.scala —
+`ZCAWhitenerEstimator(eps)` fits on the patch matrix via SVD; the whitener
+maps x → (x − μ) V (S²/n + εI)^(−1/2) Vᵀ (SURVEY.md §2.4) [unverified].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.workflow import Estimator, Transformer
+
+
+class ZCAWhitener(Transformer):
+    def __init__(self, whitener: jax.Array, mean: jax.Array):
+        self.whitener = jnp.asarray(whitener)  # (d, d)
+        self.mean = jnp.asarray(mean)
+
+    def apply_batch(self, X):
+        return (X - self.mean) @ self.whitener
+
+
+class ZCAWhitenerEstimator(Estimator):
+    def __init__(self, eps: float = 0.1):
+        self.eps = eps
+
+    def fit(self, data) -> ZCAWhitener:
+        X = jnp.asarray(data)
+        n = X.shape[0]
+        mean = X.mean(axis=0)
+        Xc = X - mean
+        # Eigendecomposition of the covariance (symmetric, stable on TPU).
+        cov = (Xc.T @ Xc) / n + 0.0
+        evals, evecs = jnp.linalg.eigh(cov)
+        scale = 1.0 / jnp.sqrt(jnp.maximum(evals, 0.0) + self.eps)
+        whitener = (evecs * scale) @ evecs.T
+        return ZCAWhitener(whitener, mean)
