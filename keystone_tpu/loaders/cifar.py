@@ -1,0 +1,62 @@
+"""CIFAR-10 loader: binary format + synthetic fallback.
+
+Ref: src/main/scala/loaders/CifarLoader.scala — parses the CIFAR-10 binary
+format (1 label byte + 3072 channel-major pixel bytes per record)
+(SURVEY.md §2.9) [unverified]. Output here is NHWC float32 in [0, 1].
+
+`synthetic(...)` generates a deterministic CIFAR-like set (class-specific
+color/texture statistics) for the no-network environment.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from keystone_tpu.config import config
+from keystone_tpu.loaders.labeled_data import LabeledData
+
+_REC = 1 + 3 * 32 * 32
+
+
+class CifarLoader:
+    @staticmethod
+    def load(path: str) -> LabeledData:
+        raw = np.fromfile(path, dtype=np.uint8)
+        if raw.size % _REC != 0:
+            raise ValueError(f"{path}: not CIFAR-10 binary (size {raw.size})")
+        raw = raw.reshape(-1, _REC)
+        labels = raw[:, 0].astype(np.int32)
+        # channel-major (3, 32, 32) → NHWC
+        imgs = raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        X = imgs.astype(config.default_dtype) / 255.0
+        return LabeledData(X, labels)
+
+    @staticmethod
+    def synthetic(
+        n: int = 2048, num_classes: int = 10, seed: int = 0
+    ) -> Tuple[LabeledData, LabeledData]:
+        """Class-distinct smooth color images + noise. Returns (train, test)."""
+        rng = np.random.default_rng(seed)
+        # Per-class low-frequency color pattern.
+        freq = rng.normal(size=(num_classes, 3, 4, 4))
+        protos = np.zeros((num_classes, 32, 32, 3))
+        for c in range(num_classes):
+            for ch in range(3):
+                f = np.zeros((32, 32))
+                f[:4, :4] = freq[c, ch]
+                protos[c, :, :, ch] = np.fft.ifft2(f).real
+        protos -= protos.min(axis=(1, 2, 3), keepdims=True)
+        protos /= protos.max(axis=(1, 2, 3), keepdims=True)
+
+        def make(count, off):
+            r = np.random.default_rng(seed + off)
+            y = r.integers(0, num_classes, size=count)
+            X = protos[y] + 0.25 * r.normal(size=(count, 32, 32, 3))
+            return LabeledData(
+                np.clip(X, 0, 1).astype(config.default_dtype),
+                y.astype(np.int32),
+            )
+
+        return make(n, 1), make(max(n // 4, 256), 2)

@@ -1,0 +1,24 @@
+from keystone_tpu.nodes.images.convolver import Convolver
+from keystone_tpu.nodes.images.pooling import Pooler, SymmetricRectifier
+from keystone_tpu.nodes.images.patches import (
+    CenterCornerPatcher,
+    RandomPatcher,
+    Windower,
+)
+from keystone_tpu.nodes.images.pixels import (
+    GrayScaler,
+    ImageVectorizer,
+    PixelScaler,
+)
+
+__all__ = [
+    "Convolver",
+    "Pooler",
+    "SymmetricRectifier",
+    "RandomPatcher",
+    "CenterCornerPatcher",
+    "Windower",
+    "GrayScaler",
+    "PixelScaler",
+    "ImageVectorizer",
+]

@@ -1,0 +1,62 @@
+"""Dense patch convolution.
+
+Ref: src/main/scala/nodes/images/Convolver.scala — convolves images with a
+filter bank via explicit im2col + BLAS gemm, optionally folding a ZCA
+whitener into the filters (the RandomPatchCifar featurizer; SURVEY.md §2.5,
+§3.1) [unverified].
+
+TPU lowering: `lax.conv_general_dilated` — the MXU performs im2col+gemm
+natively, so the reference's hand-rolled loop becomes one conv op. A fitted
+whitener (x − μ)V is folded in algebraically: conv(X, Vᵀf) − (μVᵀf) per
+filter, keeping everything a single fused computation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from keystone_tpu.workflow import Transformer
+
+
+class Convolver(Transformer):
+    """filters: (num_filters, fh, fw, c) NHWC batch convolution, VALID."""
+
+    def __init__(
+        self,
+        filters: jax.Array,
+        stride: int = 1,
+        whitener=None,
+    ):
+        filters = jnp.asarray(filters)
+        self.num_filters, self.fh, self.fw, self.c = filters.shape
+        if whitener is not None:
+            # Fold ZCA: patch featurization is ((p − μ) M) fᵀ = p (M f) − μ M f.
+            flat = filters.reshape(self.num_filters, -1)  # (nf, fh·fw·c)
+            M = jnp.asarray(whitener.whitener)
+            mu = jnp.asarray(whitener.mean)
+            flat_w = flat @ M.T  # M is symmetric for ZCA; keep .T for clarity
+            self.bias = -(mu @ M.T) @ flat.T  # (nf,)
+            filters = flat_w.reshape(
+                self.num_filters, self.fh, self.fw, self.c
+            )
+        else:
+            self.bias = None
+        self.filters = filters
+        self.stride = stride
+
+    def apply_batch(self, X):
+        # NHWC × OHWI → NHWO
+        out = lax.conv_general_dilated(
+            X,
+            self.filters,
+            window_strides=(self.stride, self.stride),
+            padding="VALID",
+            dimension_numbers=("NHWC", "OHWI", "NHWC"),
+        )
+        if self.bias is not None:
+            out = out + self.bias
+        return out

@@ -1,0 +1,93 @@
+"""Patch extraction nodes.
+
+Ref: src/main/scala/nodes/images/{RandomPatcher,Windower,
+CenterCornerPatcher}.scala (SURVEY.md §2.5) [unverified].
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.workflow import Transformer
+
+
+class RandomPatcher(Transformer):
+    """Extract `num_patches` random (size × size) patches from the batch —
+    the filter-learning sampler of RandomPatchCifar. Deterministic by seed.
+
+    Host-side index generation (tiny), one device gather (fast).
+    """
+
+    jittable = False  # output count depends on num_patches, not batch size
+
+    def __init__(self, num_patches: int, patch_size: int, seed: int = 0):
+        self.num_patches = num_patches
+        self.patch_size = patch_size
+        self.seed = seed
+
+    def apply_batch(self, X):
+        X = jnp.asarray(X)
+        n, h, w, _c = X.shape
+        p = self.patch_size
+        rng = np.random.default_rng(self.seed)
+        img_idx = rng.integers(0, n, size=self.num_patches)
+        tops = rng.integers(0, h - p + 1, size=self.num_patches)
+        lefts = rng.integers(0, w - p + 1, size=self.num_patches)
+        rows = tops[:, None] + np.arange(p)[None, :]  # (np, p)
+        cols = lefts[:, None] + np.arange(p)[None, :]
+        # Advanced-indexing gather: (num_patches, p, p, c).
+        return X[img_idx[:, None, None], rows[:, :, None], cols[:, None, :], :]
+
+
+class Windower(Transformer):
+    """All (size × size) windows at `stride` — the im2col view, exposed as a
+    node for featurizers that want explicit patches."""
+
+    def __init__(self, stride: int, window_size: int):
+        self.stride = stride
+        self.window_size = window_size
+
+    def apply_batch(self, X):
+        n, h, w, c = X.shape
+        p, s = self.window_size, self.stride
+        out_h = (h - p) // s + 1
+        out_w = (w - p) // s + 1
+        i0 = (jnp.arange(out_h) * s)[:, None] + jnp.arange(p)[None, :]
+        j0 = (jnp.arange(out_w) * s)[:, None] + jnp.arange(p)[None, :]
+        # (n, out_h, p, w, c) → (n, out_h, out_w, p, p, c)
+        rows = X[:, i0, :, :]
+        wins = rows[:, :, :, j0, :]
+        wins = jnp.moveaxis(wins, 3, 2)  # windows before in-patch rows? see below
+        # resulting layout: (n, out_h, out_w, p, p, c)
+        return wins.reshape(n * out_h * out_w, p, p, c)
+
+
+class CenterCornerPatcher(Transformer):
+    """Center + four corner crops, optionally horizontally flipped — the
+    test-time augmentation of the ImageNet pipeline. Emits (n·views, s, s, c)
+    with views grouped per image."""
+
+    def __init__(self, crop_size: int, with_flips: bool = True):
+        self.crop_size = crop_size
+        self.with_flips = with_flips
+
+    @property
+    def num_views(self) -> int:
+        return 10 if self.with_flips else 5
+
+    def apply_batch(self, X):
+        n, h, w, _c = X.shape
+        s = self.crop_size
+        ct, cl = (h - s) // 2, (w - s) // 2
+        crops = [
+            X[:, :s, :s, :],
+            X[:, :s, w - s :, :],
+            X[:, h - s :, :s, :],
+            X[:, h - s :, w - s :, :],
+            X[:, ct : ct + s, cl : cl + s, :],
+        ]
+        if self.with_flips:
+            crops += [c[:, :, ::-1, :] for c in crops]
+        stacked = jnp.stack(crops, axis=1)  # (n, views, s, s, c)
+        return stacked.reshape(-1, s, s, X.shape[-1])

@@ -1,0 +1,30 @@
+"""Pixel-level nodes.
+
+Ref: src/main/scala/nodes/images/{GrayScaler,PixelScaler,ImageVectorizer}
+.scala (SURVEY.md §2.5) [unverified].
+"""
+
+from __future__ import annotations
+
+from keystone_tpu.utils.image import grayscale, vectorize
+from keystone_tpu.workflow import Transformer
+
+
+class GrayScaler(Transformer):
+    def apply_batch(self, X):
+        return grayscale(X)
+
+
+class PixelScaler(Transformer):
+    """uint8 pixel range → [0, 1] floats."""
+
+    def __init__(self, scale: float = 255.0):
+        self.scale = scale
+
+    def apply_batch(self, X):
+        return X / self.scale
+
+
+class ImageVectorizer(Transformer):
+    def apply_batch(self, X):
+        return vectorize(X)

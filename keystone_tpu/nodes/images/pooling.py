@@ -1,0 +1,52 @@
+"""Spatial pooling and the symmetric rectifier.
+
+Ref: src/main/scala/nodes/images/{Pooler,SymmetricRectifier}.scala — sum
+pooling over a spatial grid; symmetric rectification doubles channels into
+(x − α)⁺ and (−x − α)⁺ (SURVEY.md §2.5) [unverified].
+
+TPU lowering: `lax.reduce_window` (pooling) and fused elementwise max/concat.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from keystone_tpu.workflow import Transformer
+
+
+class SymmetricRectifier(Transformer):
+    def __init__(self, alpha: float = 0.0, max_val: float = 0.0):
+        self.alpha = alpha
+        self.max_val = max_val
+
+    def apply_batch(self, X):
+        pos = jnp.maximum(X - self.alpha, self.max_val)
+        neg = jnp.maximum(-X - self.alpha, self.max_val)
+        return jnp.concatenate([pos, neg], axis=-1)
+
+
+class Pooler(Transformer):
+    """Pool NHWC over (pool_size × pool_size) windows with `stride`.
+
+    mode: "sum" (the reference's default for CIFAR features), "mean", "max".
+    """
+
+    def __init__(self, stride: int, pool_size: int, mode: str = "sum"):
+        if mode not in ("sum", "mean", "max"):
+            raise ValueError(f"unknown pooling mode {mode!r}")
+        self.stride = stride
+        self.pool_size = pool_size
+        self.mode = mode
+
+    def apply_batch(self, X):
+        dims = (1, self.pool_size, self.pool_size, 1)
+        strides = (1, self.stride, self.stride, 1)
+        if self.mode == "max":
+            return lax.reduce_window(
+                X, -jnp.inf, lax.max, dims, strides, "VALID"
+            )
+        out = lax.reduce_window(X, 0.0, lax.add, dims, strides, "VALID")
+        if self.mode == "mean":
+            out = out / (self.pool_size * self.pool_size)
+        return out

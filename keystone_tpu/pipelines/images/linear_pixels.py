@@ -1,0 +1,82 @@
+"""LinearPixels — the CIFAR sanity pipeline: raw pixels → linear solve.
+
+Ref: src/main/scala/pipelines/images/cifar/LinearPixels.scala
+(SURVEY.md §2.11) [unverified].
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.loaders.cifar import CifarLoader
+from keystone_tpu.nodes.images import GrayScaler, ImageVectorizer
+from keystone_tpu.nodes.learning import LinearMapEstimator
+from keystone_tpu.nodes.util import ClassLabelIndicators, MaxClassifier
+
+
+@dataclass
+class LinearPixelsConfig:
+    train_path: Optional[str] = None
+    test_path: Optional[str] = None
+    lam: float = 1.0
+    num_classes: int = 10
+    synthetic_n: int = 2048
+
+
+def run(conf: LinearPixelsConfig) -> dict:
+    if conf.train_path:
+        if not conf.test_path:
+            raise ValueError("--test is required when --train is given")
+        train = CifarLoader.load(conf.train_path)
+        test = CifarLoader.load(conf.test_path)
+    else:
+        train, test = CifarLoader.synthetic(n=conf.synthetic_n)
+
+    t0 = time.time()
+    featurizer = GrayScaler().and_then(ImageVectorizer())
+    targets = ClassLabelIndicators(conf.num_classes)(train.labels)
+    pipeline = featurizer.and_then(
+        LinearMapEstimator(lam=conf.lam), train.data, targets
+    ).and_then(MaxClassifier())
+    predictions = pipeline(test.data).get()
+    elapsed = time.time() - t0
+
+    metrics = MulticlassClassifierEvaluator(conf.num_classes).evaluate(
+        predictions, test.labels
+    )
+    return {
+        "test_accuracy": metrics.total_accuracy,
+        "seconds": elapsed,
+        "summary": metrics.summary(),
+    }
+
+
+def main(argv=None):
+    from keystone_tpu.utils.platform import setup_platform
+
+    setup_platform()
+    p = argparse.ArgumentParser(description="LinearPixels CIFAR pipeline")
+    p.add_argument("--train", dest="train_path")
+    p.add_argument("--test", dest="test_path")
+    p.add_argument("--lam", type=float, default=1.0)
+    p.add_argument("--synthetic-n", type=int, default=2048)
+    a = p.parse_args(argv)
+    out = run(
+        LinearPixelsConfig(
+            train_path=a.train_path,
+            test_path=a.test_path,
+            lam=a.lam,
+            synthetic_n=a.synthetic_n,
+        )
+    )
+    print(out["summary"])
+    print(f"total {out['seconds']:.2f}s")
+    return out
+
+
+if __name__ == "__main__":
+    main()

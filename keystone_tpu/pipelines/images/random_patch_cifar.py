@@ -1,0 +1,155 @@
+"""RandomPatchCifar — the canonical CIFAR pipeline.
+
+Ref: src/main/scala/pipelines/images/cifar/RandomPatchCifar.scala
+(BASELINE.json config: "Convolver + ZCAWhitener + BlockLeastSquaresEstimator"):
+random patches → ZCA whitening → convolution with whitened random-patch
+filters → symmetric rectification → spatial sum pooling →
+BlockLeastSquaresEstimator → MaxClassifier (SURVEY.md §2.11, §3.1)
+[unverified].
+
+TPU notes: filter prep (patch sampling + ZCA fit) is a small fit on the
+device; the conv + rectify + pool featurization fuses into one XLA program
+(MXU conv, vector-unit rectify, reduce_window pool); the solve is the
+psum-reduced block coordinate descent.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.loaders.cifar import CifarLoader
+from keystone_tpu.nodes.images import (
+    Convolver,
+    ImageVectorizer,
+    Pooler,
+    RandomPatcher,
+    SymmetricRectifier,
+)
+from keystone_tpu.nodes.learning import (
+    BlockLeastSquaresEstimator,
+    ZCAWhitenerEstimator,
+)
+from keystone_tpu.nodes.util import ClassLabelIndicators, MaxClassifier
+from keystone_tpu.workflow import Pipeline
+
+
+@dataclass
+class RandomPatchCifarConfig:
+    train_path: Optional[str] = None
+    test_path: Optional[str] = None
+    num_filters: int = 256
+    patch_size: int = 6
+    patch_sample: int = 10000
+    pool_size: int = 13
+    pool_stride: int = 13
+    alpha: float = 0.25
+    lam: float = 10.0
+    block_size: int = 4096
+    num_iters: int = 3
+    zca_eps: float = 0.1
+    num_classes: int = 10
+    seed: int = 0
+    synthetic_n: int = 2048
+
+
+def build_featurizer(conf: RandomPatchCifarConfig, train_images) -> Pipeline:
+    """Fit filters (random whitened patches) and build the conv featurizer."""
+    patches = RandomPatcher(
+        num_patches=conf.patch_sample,
+        patch_size=conf.patch_size,
+        seed=conf.seed,
+    )(train_images)
+    flat = jnp.asarray(patches).reshape(patches.shape[0], -1)
+    whitener = ZCAWhitenerEstimator(eps=conf.zca_eps).fit(flat)
+    # Sample num_filters whitened patches as filters, unit-normalized.
+    rng = np.random.default_rng(conf.seed + 1)
+    idx = rng.choice(flat.shape[0], size=conf.num_filters, replace=False)
+    filt_flat = np.asarray(whitener(flat[idx]))
+    norms = np.linalg.norm(filt_flat, axis=1, keepdims=True)
+    filt_flat = filt_flat / np.maximum(norms, 1e-8)
+    c = train_images.shape[-1]
+    filters = filt_flat.reshape(
+        conf.num_filters, conf.patch_size, conf.patch_size, c
+    )
+    return (
+        Convolver(filters, whitener=whitener)
+        .and_then(SymmetricRectifier(alpha=conf.alpha))
+        .and_then(Pooler(conf.pool_stride, conf.pool_size, mode="sum"))
+        .and_then(ImageVectorizer())
+    )
+
+
+def run(conf: RandomPatchCifarConfig) -> dict:
+    if conf.train_path:
+        if not conf.test_path:
+            raise ValueError("--test is required when --train is given")
+        train = CifarLoader.load(conf.train_path)
+        test = CifarLoader.load(conf.test_path)
+    else:
+        train, test = CifarLoader.synthetic(n=conf.synthetic_n)
+
+    t0 = time.time()
+    featurizer = build_featurizer(conf, train.data)
+    targets = ClassLabelIndicators(conf.num_classes)(train.labels)
+    pipeline = featurizer.and_then(
+        BlockLeastSquaresEstimator(
+            block_size=conf.block_size,
+            num_iters=conf.num_iters,
+            lam=conf.lam,
+        ),
+        train.data,
+        targets,
+    ).and_then(MaxClassifier())
+    predictions = pipeline(test.data).get()
+    elapsed = time.time() - t0
+
+    metrics = MulticlassClassifierEvaluator(conf.num_classes).evaluate(
+        predictions, test.labels
+    )
+    return {
+        "test_accuracy": metrics.total_accuracy,
+        "macro_f1": metrics.macro_f1,
+        "seconds": elapsed,
+        "summary": metrics.summary(),
+    }
+
+
+def main(argv=None):
+    from keystone_tpu.utils.platform import setup_platform
+
+    setup_platform()
+    p = argparse.ArgumentParser(description="RandomPatchCifar pipeline")
+    p.add_argument("--train", dest="train_path")
+    p.add_argument("--test", dest="test_path")
+    p.add_argument("--num-filters", type=int, default=256)
+    p.add_argument("--patch-size", type=int, default=6)
+    p.add_argument("--lam", type=float, default=10.0)
+    p.add_argument("--num-iters", type=int, default=3)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--synthetic-n", type=int, default=2048)
+    a = p.parse_args(argv)
+    conf = RandomPatchCifarConfig(
+        train_path=a.train_path,
+        test_path=a.test_path,
+        num_filters=a.num_filters,
+        patch_size=a.patch_size,
+        lam=a.lam,
+        num_iters=a.num_iters,
+        seed=a.seed,
+        synthetic_n=a.synthetic_n,
+    )
+    out = run(conf)
+    print(out["summary"])
+    print(f"total {out['seconds']:.2f}s")
+    return out
+
+
+if __name__ == "__main__":
+    main()

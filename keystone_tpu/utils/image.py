@@ -1,0 +1,62 @@
+"""Image conventions and utilities.
+
+Ref: src/main/scala/utils/Image.scala — the reference carries a zero-copy
+multi-layout image container (ChannelMajor/ColumnMajor/RowMajor vectorized
+images + ImageMetadata) because JVM featurization code is layout-sensitive
+(SURVEY.md §2.12) [unverified].
+
+TPU rebuild: batches of images are plain **NHWC float arrays** — XLA owns
+physical layout assignment, so the multi-layout machinery collapses to one
+logical convention plus `ImageMetadata` for shape bookkeeping. Utilities
+mirror `utils/ImageUtils.scala` (grayscale, crop, flip, mapPixels).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+# ITU-R BT.601 luma weights, the standard grayscale conversion.
+_LUMA = (0.299, 0.587, 0.114)
+
+
+@dataclass(frozen=True)
+class ImageMetadata:
+    height: int
+    width: int
+    channels: int
+
+    @property
+    def num_pixels(self) -> int:
+        return self.height * self.width * self.channels
+
+
+def metadata_of(batch) -> ImageMetadata:
+    _, h, w, c = batch.shape
+    return ImageMetadata(h, w, c)
+
+
+def grayscale(batch):
+    """NHWC → NHW1 luminance."""
+    if batch.shape[-1] == 1:
+        return batch
+    w = jnp.asarray(_LUMA, dtype=batch.dtype)
+    return jnp.tensordot(batch, w, axes=[[-1], [0]])[..., None]
+
+
+def crop(batch, top: int, left: int, height: int, width: int):
+    return batch[:, top : top + height, left : left + width, :]
+
+
+def flip_horizontal(batch):
+    return batch[:, :, ::-1, :]
+
+
+def map_pixels(batch, fn):
+    return fn(batch)
+
+
+def vectorize(batch):
+    """NHWC → (N, H·W·C) row vectors."""
+    return batch.reshape(batch.shape[0], -1)
