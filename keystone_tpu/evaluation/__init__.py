@@ -2,5 +2,14 @@ from keystone_tpu.evaluation.multiclass import (
     MulticlassClassifierEvaluator,
     MulticlassMetrics,
 )
+from keystone_tpu.evaluation.binary import (
+    BinaryClassifierEvaluator,
+    BinaryMetrics,
+)
 
-__all__ = ["MulticlassClassifierEvaluator", "MulticlassMetrics"]
+__all__ = [
+    "MulticlassClassifierEvaluator",
+    "MulticlassMetrics",
+    "BinaryClassifierEvaluator",
+    "BinaryMetrics",
+]

@@ -1,0 +1,71 @@
+"""Amazon reviews loader: JSON-lines/CSV reviews + synthetic fallback.
+
+Ref: src/main/scala/loaders/AmazonReviewsDataLoader.scala — star rating →
+binary label (> 3.5 positive) (SURVEY.md §2.9) [unverified].
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+from typing import Tuple
+
+import numpy as np
+
+from keystone_tpu.loaders.labeled_data import LabeledData
+
+_POS = ["great", "excellent", "love", "perfect", "best", "amazing", "works"]
+_NEG = ["terrible", "broke", "waste", "refund", "awful", "disappointed", "poor"]
+_FILLER = ["the", "product", "i", "it", "this", "was", "and", "my", "to", "use"]
+
+
+class AmazonReviewsDataLoader:
+    THRESHOLD = 3.5
+
+    @staticmethod
+    def load(path: str) -> LabeledData:
+        """JSON-lines ({"reviewText", "overall"}) or CSV (text, stars)."""
+        texts, labels = [], []
+        with open(path, errors="replace") as f:
+            if path.endswith(".json") or path.endswith(".jsonl"):
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = json.loads(line)
+                    texts.append(rec["reviewText"])
+                    labels.append(
+                        1 if float(rec["overall"]) > AmazonReviewsDataLoader.THRESHOLD else 0
+                    )
+            else:
+                for row in csv.reader(f):
+                    if len(row) < 2:
+                        continue
+                    texts.append(row[0])
+                    labels.append(
+                        1 if float(row[1]) > AmazonReviewsDataLoader.THRESHOLD else 0
+                    )
+        return LabeledData(texts, np.asarray(labels, dtype=np.int32))
+
+    @staticmethod
+    def synthetic(
+        n: int = 1000, seed: int = 0
+    ) -> Tuple[LabeledData, LabeledData]:
+        def make(count, off):
+            r = np.random.default_rng(seed + off)
+            texts, labels = [], []
+            for _ in range(count):
+                pos = bool(r.integers(0, 2))
+                vocab = _POS if pos else _NEG
+                words = list(r.choice(vocab, size=r.integers(3, 8))) + list(
+                    r.choice(_FILLER, size=r.integers(8, 16))
+                )
+                # A little label noise via cross-polarity words.
+                if r.uniform() < 0.3:
+                    words += list(r.choice(_NEG if pos else _POS, size=1))
+                r.shuffle(words)
+                texts.append(" ".join(words))
+                labels.append(1 if pos else 0)
+            return LabeledData(texts, np.asarray(labels, dtype=np.int32))
+
+        return make(n, 1), make(max(n // 4, 100), 2)

@@ -1,0 +1,92 @@
+"""20 Newsgroups loader: directory-per-class text + synthetic fallback.
+
+Ref: src/main/scala/loaders/NewsgroupsDataLoader.scala (SURVEY.md §2.9)
+[unverified].
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Tuple
+
+import numpy as np
+
+from keystone_tpu.loaders.labeled_data import LabeledData
+
+# Class-specific vocabulary for the synthetic corpus generator.
+_TOPICS = [
+    ["space", "orbit", "rocket", "nasa", "launch", "moon", "satellite"],
+    ["hockey", "goal", "puck", "team", "season", "playoff", "skate"],
+    ["windows", "driver", "file", "disk", "program", "install", "boot"],
+    ["car", "engine", "dealer", "mileage", "brake", "tire", "drive"],
+    ["god", "faith", "church", "belief", "scripture", "moral", "prayer"],
+]
+_COMMON = ["the", "a", "of", "to", "and", "in", "is", "that", "it", "for"]
+
+
+class NewsgroupsDataLoader:
+    @staticmethod
+    def load(
+        path: str, classes: List[str] | None = None
+    ) -> Tuple[LabeledData, List[str]]:
+        """Directory-per-class layout: path/<group>/<doc files>.
+
+        Pass the training split's `classes` when loading a test split so the
+        label indices align; unknown subdirectories then raise instead of
+        silently shifting every label.
+
+        Returns (LabeledData(texts, int labels), class names).
+        """
+        found = sorted(
+            d
+            for d in os.listdir(path)
+            if os.path.isdir(os.path.join(path, d))
+        )
+        if classes is None:
+            classes = found
+        else:
+            unknown = set(found) - set(classes)
+            if unknown:
+                raise ValueError(
+                    f"{path} has classes {sorted(unknown)} not present in the "
+                    f"training class list {classes}"
+                )
+        index = {c: i for i, c in enumerate(classes)}
+        texts: List[str] = []
+        labels: List[int] = []
+        for cls in found:
+            ci = index[cls]
+            cdir = os.path.join(path, cls)
+            for fname in sorted(os.listdir(cdir)):
+                fpath = os.path.join(cdir, fname)
+                if os.path.isfile(fpath):
+                    with open(fpath, errors="replace") as f:
+                        texts.append(f.read())
+                    labels.append(ci)
+        return (
+            LabeledData(texts, np.asarray(labels, dtype=np.int32)),
+            list(classes),
+        )
+
+    @staticmethod
+    def synthetic(
+        n: int = 1000, num_classes: int = 5, seed: int = 0
+    ) -> Tuple[LabeledData, LabeledData, List[str]]:
+        """Deterministic topic-mixture corpus. Returns (train, test, names)."""
+        num_classes = min(num_classes, len(_TOPICS))
+
+        def make(count, off):
+            r = np.random.default_rng(seed + off)
+            texts, labels = [], []
+            for _ in range(count):
+                c = int(r.integers(0, num_classes))
+                words = list(
+                    r.choice(_TOPICS[c], size=r.integers(8, 20))
+                ) + list(r.choice(_COMMON, size=r.integers(10, 25)))
+                r.shuffle(words)
+                texts.append(" ".join(words))
+                labels.append(c)
+            return LabeledData(texts, np.asarray(labels, dtype=np.int32))
+
+        names = [t[0] for t in _TOPICS[:num_classes]]
+        return make(n, 1), make(max(n // 4, 100), 2), names

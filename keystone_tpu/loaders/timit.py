@@ -1,0 +1,69 @@
+"""TIMIT frame-features loader + synthetic fallback.
+
+Ref: src/main/scala/loaders/TimitFeaturesDataLoader.scala — pre-extracted
+MFCC frame features (the reference consumes dumps, not raw audio) with
+per-frame phone labels (SURVEY.md §2.9) [unverified].
+
+Formats: .npz with arrays `features` (n, d) and `labels` (n,), or a pair of
+CSVs (features, labels). `synthetic` generates phone-class gaussian frames
+with context splicing like the canonical 440-dim MFCC-context setup.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from keystone_tpu.config import config
+from keystone_tpu.loaders.labeled_data import LabeledData
+
+
+class TimitFeaturesDataLoader:
+    NUM_PHONES = 147  # the reference's phone-state label count
+
+    @staticmethod
+    def load(features_path: str, labels_path: str | None = None) -> LabeledData:
+        if features_path.endswith(".npz"):
+            data = np.load(features_path)
+            return LabeledData(
+                data["features"].astype(config.default_dtype),
+                data["labels"].astype(np.int32),
+            )
+        X = np.loadtxt(features_path, delimiter=",", dtype=config.default_dtype)
+        if labels_path is None:
+            raise ValueError("labels_path required for CSV features")
+        y = np.loadtxt(labels_path, dtype=np.int64).astype(np.int32)
+        return LabeledData(X, y)
+
+    @staticmethod
+    def synthetic(
+        n: int = 4096,
+        num_phones: int = 24,
+        frame_dim: int = 40,
+        context: int = 5,
+        seed: int = 0,
+    ) -> Tuple[LabeledData, LabeledData]:
+        """Gaussian phone clusters with ±context frame splicing
+        (dim = frame_dim · (2·context + 1), like the 440-dim MFCC setup)."""
+        rng = np.random.default_rng(seed)
+        protos = rng.normal(scale=1.0, size=(num_phones, frame_dim))
+        dim = frame_dim * (2 * context + 1)
+
+        def make(count, off):
+            r = np.random.default_rng(seed + off)
+            y = r.integers(0, num_phones, size=count)
+            center = protos[y] + 0.6 * r.normal(size=(count, frame_dim))
+            # Neighbor frames: same phone signal, more noise (coarticulation).
+            frames = [center]
+            for _k in range(2 * context):
+                frames.append(
+                    protos[y] + 1.2 * r.normal(size=(count, frame_dim))
+                )
+            X = np.concatenate(frames, axis=1)
+            assert X.shape[1] == dim
+            return LabeledData(
+                X.astype(config.default_dtype), y.astype(np.int32)
+            )
+
+        return make(n, 1), make(max(n // 4, 256), 2)

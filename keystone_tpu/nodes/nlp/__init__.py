@@ -1,0 +1,17 @@
+from keystone_tpu.nodes.nlp.tokenize import LowerCase, Tokenizer, Trim
+from keystone_tpu.nodes.nlp.ngrams import NGramsFeaturizer
+from keystone_tpu.nodes.nlp.term_frequency import TermFrequency
+from keystone_tpu.nodes.nlp.encoders import (
+    CommonSparseFeatures,
+    WordFrequencyEncoder,
+)
+
+__all__ = [
+    "Trim",
+    "LowerCase",
+    "Tokenizer",
+    "NGramsFeaturizer",
+    "TermFrequency",
+    "CommonSparseFeatures",
+    "WordFrequencyEncoder",
+]

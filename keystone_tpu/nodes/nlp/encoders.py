@@ -1,0 +1,93 @@
+"""Vocabulary encoders: top-K term dictionary → dense feature vectors.
+
+Ref: src/main/scala/nodes/util/CommonSparseFeatures.scala and
+nodes/nlp/WordFrequencyEncoder.scala — keep the K most frequent terms and
+encode documents against that dictionary (SURVEY.md §2.7/§2.8)
+[unverified].
+
+TPU note: the reference emits Spark sparse vectors; here encoding produces
+dense (batch, K) arrays — at the vocabulary sizes the canonical text
+pipelines use, the dense batch is exactly what the MXU-backed classifiers
+(NaiveBayes gemms, logistic regression) want. Encoding is host-side; the
+result flows to the device once per batch.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Mapping, Sequence
+
+import numpy as np
+
+from keystone_tpu.config import config
+from keystone_tpu.workflow import Estimator, Transformer
+
+
+class SparseFeatureVectorizer(Transformer):
+    """Encodes (term → weight) maps against a fixed term index."""
+
+    jittable = False
+
+    def __init__(self, index: Mapping[str, int]):
+        self.index = dict(index)
+        self.dim = len(self.index)
+
+    def apply_batch(self, docs: Sequence[Mapping[str, float]]):
+        out = np.zeros((len(docs), self.dim), dtype=config.default_dtype)
+        index = self.index
+        for i, doc in enumerate(docs):
+            for term, weight in doc.items():
+                j = index.get(term)
+                if j is not None:
+                    out[i, j] = weight
+        return out
+
+    @property
+    def vocabulary(self) -> List[str]:
+        inv = [""] * self.dim
+        for term, j in self.index.items():
+            inv[j] = term
+        return inv
+
+
+class CountVectorizer(SparseFeatureVectorizer):
+    """Encodes token lists as dense count vectors against a fixed index."""
+
+    def apply_batch(self, docs: Sequence[Sequence[str]]):
+        out = np.zeros((len(docs), self.dim), dtype=config.default_dtype)
+        index = self.index
+        for i, tokens in enumerate(docs):
+            for t in tokens:
+                j = index.get(t)
+                if j is not None:
+                    out[i, j] += 1.0
+        return out
+
+
+class CommonSparseFeatures(Estimator):
+    """Fit: keep the `num_features` terms appearing in the most documents."""
+
+    def __init__(self, num_features: int):
+        self.num_features = num_features
+
+    def fit(self, docs: Sequence[Mapping[str, float]]) -> SparseFeatureVectorizer:
+        doc_freq: Counter = Counter()
+        for doc in docs:
+            doc_freq.update(doc.keys())
+        top = [t for t, _c in doc_freq.most_common(self.num_features)]
+        return SparseFeatureVectorizer({t: i for i, t in enumerate(top)})
+
+
+class WordFrequencyEncoder(Estimator):
+    """Fit over token lists: most frequent words → index; encodes documents
+    as dense count vectors."""
+
+    def __init__(self, num_words: int):
+        self.num_words = num_words
+
+    def fit(self, token_docs: Sequence[Sequence[str]]) -> CountVectorizer:
+        freq: Counter = Counter()
+        for tokens in token_docs:
+            freq.update(tokens)
+        top = [w for w, _c in freq.most_common(self.num_words)]
+        return CountVectorizer({w: i for i, w in enumerate(top)})

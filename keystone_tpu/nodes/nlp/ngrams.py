@@ -1,0 +1,29 @@
+"""N-gram featurizer over token sequences.
+
+Ref: src/main/scala/nodes/nlp/NGramsFeaturizer.scala — emits all n-grams
+for n in [min_n, max_n] (SURVEY.md §2.7) [unverified].
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from keystone_tpu.workflow import Transformer
+
+
+class NGramsFeaturizer(Transformer):
+    jittable = False
+
+    def __init__(self, min_n: int = 1, max_n: int = 2, joiner: str = " "):
+        if min_n < 1 or max_n < min_n:
+            raise ValueError(f"bad n-gram range [{min_n}, {max_n}]")
+        self.min_n = min_n
+        self.max_n = max_n
+        self.joiner = joiner
+
+    def apply(self, tokens: Sequence[str]) -> List[str]:
+        out: List[str] = []
+        for n in range(self.min_n, self.max_n + 1):
+            for i in range(len(tokens) - n + 1):
+                out.append(self.joiner.join(tokens[i : i + n]))
+        return out

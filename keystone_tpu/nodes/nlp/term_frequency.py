@@ -1,0 +1,31 @@
+"""Term-frequency weighting over token multisets.
+
+Ref: src/main/scala/nodes/nlp/TermFrequency.scala — maps each document's
+terms to (term, weight) with a pluggable weighting (identity or log)
+(SURVEY.md §2.7) [unverified].
+"""
+
+from __future__ import annotations
+
+import math
+from collections import Counter
+from typing import Callable, Dict, Sequence
+
+from keystone_tpu.workflow import Transformer
+
+
+class TermFrequency(Transformer):
+    jittable = False
+
+    def __init__(self, fn: str | Callable[[float], float] = "identity"):
+        if fn == "identity":
+            self.fn: Callable[[float], float] = lambda c: c
+        elif fn == "log":
+            self.fn = lambda c: math.log(c + 1.0)
+        elif callable(fn):
+            self.fn = fn
+        else:
+            raise ValueError(f"unknown weighting {fn!r}")
+
+    def apply(self, tokens: Sequence[str]) -> Dict[str, float]:
+        return {t: self.fn(c) for t, c in Counter(tokens).items()}

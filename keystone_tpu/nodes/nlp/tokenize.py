@@ -1,0 +1,39 @@
+"""Text cleanup and tokenization nodes (host-side: irregular string work is
+CPU work; the TPU sees only the encoded vectors downstream).
+
+Ref: src/main/scala/nodes/nlp/{Trim,LowerCase,Tokenizer}.scala
+(SURVEY.md §2.7) [unverified].
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from keystone_tpu.workflow import Transformer
+
+
+class Trim(Transformer):
+    jittable = False
+
+    def apply(self, x: str) -> str:
+        return x.strip()
+
+
+class LowerCase(Transformer):
+    jittable = False
+
+    def apply(self, x: str) -> str:
+        return x.lower()
+
+
+class Tokenizer(Transformer):
+    """Split on a regex (default: runs of non-word characters)."""
+
+    jittable = False
+
+    def __init__(self, pattern: str = r"[^\w']+"):
+        self.pattern = re.compile(pattern)
+
+    def apply(self, x: str) -> List[str]:
+        return [t for t in self.pattern.split(x) if t]

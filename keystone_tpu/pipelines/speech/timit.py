@@ -1,0 +1,141 @@
+"""TimitPipeline — the canonical speech pipeline.
+
+Ref: src/main/scala/pipelines/speech/timit/TimitPipeline.scala
+(BASELINE.json config: "MFCC + CosineRandomFeatures +
+BlockLeastSquaresEstimator"): frame features → StandardScaler →
+CosineRandomFeatures (Gaussian or Cauchy W, ~100k+ dims) → multi-epoch
+BlockLeastSquaresEstimator → MaxClassifier (SURVEY.md §2.11) [unverified].
+
+This is the first real stress of the distributed-linalg layer at high
+feature dimension: the random-feature projection is one large MXU gemm and
+the solve streams feature blocks through the psum-reduced BCD loop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.loaders.timit import TimitFeaturesDataLoader
+from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
+from keystone_tpu.nodes.stats import CosineRandomFeatures, StandardScaler
+from keystone_tpu.nodes.util import ClassLabelIndicators, MaxClassifier
+
+
+@dataclass
+class TimitConfig:
+    features_path: Optional[str] = None
+    labels_path: Optional[str] = None
+    test_features_path: Optional[str] = None
+    test_labels_path: Optional[str] = None
+    num_features: int = 4096
+    gamma: float = 0.055  # the RBF bandwidth scale of the reference setup
+    distribution: str = "gaussian"  # or "cauchy"
+    lam: float = 0.1
+    block_size: int = 2048
+    num_iters: int = 3
+    num_phones: int = 24
+    seed: int = 0
+    synthetic_n: int = 4096
+
+
+def run(conf: TimitConfig) -> dict:
+    if conf.features_path:
+        if not conf.test_features_path:
+            raise ValueError("test features are required with real data")
+        train = TimitFeaturesDataLoader.load(conf.features_path, conf.labels_path)
+        test = TimitFeaturesDataLoader.load(
+            conf.test_features_path, conf.test_labels_path
+        )
+        num_phones = TimitFeaturesDataLoader.NUM_PHONES
+    else:
+        train, test = TimitFeaturesDataLoader.synthetic(
+            n=conf.synthetic_n, num_phones=conf.num_phones, seed=conf.seed
+        )
+        num_phones = conf.num_phones
+
+    t0 = time.time()
+    featurizer = StandardScaler().with_data(train.data).and_then(
+        CosineRandomFeatures.create(
+            input_dim=train.data.shape[1],
+            num_features=conf.num_features,
+            gamma=conf.gamma,
+            distribution=conf.distribution,
+            seed=conf.seed,
+        )
+    )
+    targets = ClassLabelIndicators(num_phones)(train.labels)
+    pipeline = featurizer.and_then(
+        BlockLeastSquaresEstimator(
+            block_size=conf.block_size,
+            num_iters=conf.num_iters,
+            lam=conf.lam,
+        ),
+        train.data,
+        targets,
+    ).and_then(MaxClassifier())
+    predictions = pipeline(test.data).get()
+    elapsed = time.time() - t0
+
+    metrics = MulticlassClassifierEvaluator(num_phones).evaluate(
+        predictions, test.labels
+    )
+    return {
+        "test_accuracy": metrics.total_accuracy,
+        "phone_error_rate": 1.0 - metrics.total_accuracy,
+        "macro_f1": metrics.macro_f1,
+        "seconds": elapsed,
+        "summary": metrics.summary(),
+    }
+
+
+def main(argv=None):
+    from keystone_tpu.utils.platform import setup_platform
+
+    setup_platform()
+    p = argparse.ArgumentParser(description="TIMIT speech pipeline")
+    p.add_argument("--features", dest="features_path")
+    p.add_argument("--labels", dest="labels_path")
+    p.add_argument("--test-features", dest="test_features_path")
+    p.add_argument("--test-labels", dest="test_labels_path")
+    p.add_argument("--num-features", type=int, default=4096)
+    p.add_argument("--gamma", type=float, default=0.055)
+    p.add_argument(
+        "--distribution", choices=["gaussian", "cauchy"], default="gaussian"
+    )
+    p.add_argument("--lam", type=float, default=0.1)
+    p.add_argument("--block-size", type=int, default=2048)
+    p.add_argument("--num-iters", type=int, default=3)
+    p.add_argument("--num-phones", type=int, default=24)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--synthetic-n", type=int, default=4096)
+    a = p.parse_args(argv)
+    out = run(
+        TimitConfig(
+            features_path=a.features_path,
+            labels_path=a.labels_path,
+            test_features_path=a.test_features_path,
+            test_labels_path=a.test_labels_path,
+            num_features=a.num_features,
+            gamma=a.gamma,
+            distribution=a.distribution,
+            lam=a.lam,
+            block_size=a.block_size,
+            num_iters=a.num_iters,
+            num_phones=a.num_phones,
+            seed=a.seed,
+            synthetic_n=a.synthetic_n,
+        )
+    )
+    print(out["summary"])
+    print(
+        f"PER {out['phone_error_rate']:.4f} | total {out['seconds']:.2f}s"
+    )
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""AmazonReviewsPipeline — binary sentiment over the same text front-end.
+
+Ref: src/main/scala/pipelines/text/AmazonReviewsPipeline.scala — text
+front-end → logistic regression, binary evaluation (SURVEY.md §2.11)
+[unverified].
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from keystone_tpu.evaluation.binary import BinaryClassifierEvaluator
+from keystone_tpu.loaders.amazon import AmazonReviewsDataLoader
+from keystone_tpu.nodes.learning import LogisticRegressionEstimator
+from keystone_tpu.nodes.nlp import (
+    CommonSparseFeatures,
+    LowerCase,
+    NGramsFeaturizer,
+    TermFrequency,
+    Tokenizer,
+    Trim,
+)
+
+
+@dataclass
+class AmazonReviewsConfig:
+    train_path: Optional[str] = None
+    test_path: Optional[str] = None
+    num_features: int = 20000
+    ngrams: int = 2
+    reg: float = 1e-3
+    synthetic_n: int = 1000
+
+
+def run(conf: AmazonReviewsConfig) -> dict:
+    if conf.train_path:
+        if not conf.test_path:
+            raise ValueError("--test is required when --train is given")
+        train = AmazonReviewsDataLoader.load(conf.train_path)
+        test = AmazonReviewsDataLoader.load(conf.test_path)
+    else:
+        train, test = AmazonReviewsDataLoader.synthetic(n=conf.synthetic_n)
+
+    t0 = time.time()
+    featurizer = (
+        Trim()
+        .and_then(LowerCase())
+        .and_then(Tokenizer())
+        .and_then(NGramsFeaturizer(1, conf.ngrams))
+        .and_then(TermFrequency("log"))
+        .and_then(CommonSparseFeatures(conf.num_features), train.data)
+    )
+    pipeline = featurizer.and_then(
+        LogisticRegressionEstimator(num_classes=2, reg=conf.reg),
+        train.data,
+        train.labels,
+    )
+    scores = np.asarray(pipeline(test.data).get())
+    elapsed = time.time() - t0
+
+    predictions = scores.argmax(axis=1)
+    margin = scores[:, 1] - scores[:, 0]
+    metrics = BinaryClassifierEvaluator.evaluate(
+        predictions, test.labels, scores=margin
+    )
+    return {
+        "accuracy": metrics.accuracy,
+        "auc": metrics.auc,
+        "f1": metrics.f1,
+        "seconds": elapsed,
+        "summary": metrics.summary(),
+    }
+
+
+def main(argv=None):
+    from keystone_tpu.utils.platform import setup_platform
+
+    setup_platform()
+    p = argparse.ArgumentParser(description="Amazon reviews sentiment pipeline")
+    p.add_argument("--train", dest="train_path")
+    p.add_argument("--test", dest="test_path")
+    p.add_argument("--num-features", type=int, default=20000)
+    p.add_argument("--ngrams", type=int, default=2)
+    p.add_argument("--reg", type=float, default=1e-3)
+    p.add_argument("--synthetic-n", type=int, default=1000)
+    a = p.parse_args(argv)
+    out = run(
+        AmazonReviewsConfig(
+            train_path=a.train_path,
+            test_path=a.test_path,
+            num_features=a.num_features,
+            ngrams=a.ngrams,
+            reg=a.reg,
+            synthetic_n=a.synthetic_n,
+        )
+    )
+    print(out["summary"])
+    print(f"total {out['seconds']:.2f}s")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+"""NewsgroupsPipeline — the canonical text-classification pipeline.
+
+Ref: src/main/scala/pipelines/text/NewsgroupsPipeline.scala
+(BASELINE.json config: "NGrams + tf-idf + NaiveBayes /
+LogisticRegressionEstimator"): Trim → LowerCase → Tokenizer →
+NGramsFeaturizer → TermFrequency(log) → CommonSparseFeatures →
+NaiveBayesEstimator → MaxClassifier (SURVEY.md §2.11) [unverified].
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.loaders.newsgroups import NewsgroupsDataLoader
+from keystone_tpu.nodes.learning import (
+    LogisticRegressionEstimator,
+    NaiveBayesEstimator,
+)
+from keystone_tpu.nodes.nlp import (
+    CommonSparseFeatures,
+    LowerCase,
+    NGramsFeaturizer,
+    TermFrequency,
+    Tokenizer,
+    Trim,
+)
+from keystone_tpu.nodes.util import MaxClassifier
+
+
+@dataclass
+class NewsgroupsConfig:
+    train_path: Optional[str] = None
+    test_path: Optional[str] = None
+    num_features: int = 10000
+    ngrams: int = 2
+    classifier: str = "naive_bayes"  # or "logistic"
+    num_classes: int = 5
+    synthetic_n: int = 1000
+
+
+def run(conf: NewsgroupsConfig) -> dict:
+    if conf.train_path:
+        if not conf.test_path:
+            raise ValueError("--test is required when --train is given")
+        train, classes = NewsgroupsDataLoader.load(conf.train_path)
+        # Pass the train class list so test label indices align with it.
+        test, _ = NewsgroupsDataLoader.load(conf.test_path, classes=classes)
+        num_classes = len(classes)
+    else:
+        train, test, classes = NewsgroupsDataLoader.synthetic(
+            n=conf.synthetic_n, num_classes=conf.num_classes
+        )
+        num_classes = len(classes)
+
+    t0 = time.time()
+    featurizer = (
+        Trim()
+        .and_then(LowerCase())
+        .and_then(Tokenizer())
+        .and_then(NGramsFeaturizer(1, conf.ngrams))
+        .and_then(TermFrequency("log"))
+        .and_then(CommonSparseFeatures(conf.num_features), train.data)
+    )
+    if conf.classifier == "naive_bayes":
+        pipeline = featurizer.and_then(
+            NaiveBayesEstimator(num_classes), train.data, train.labels
+        )
+    elif conf.classifier == "logistic":
+        pipeline = featurizer.and_then(
+            LogisticRegressionEstimator(num_classes), train.data, train.labels
+        )
+    else:
+        raise ValueError(f"unknown classifier {conf.classifier!r}")
+    pipeline = pipeline.and_then(MaxClassifier())
+    predictions = pipeline(test.data).get()
+    elapsed = time.time() - t0
+
+    metrics = MulticlassClassifierEvaluator(num_classes).evaluate(
+        predictions, test.labels
+    )
+    return {
+        "test_accuracy": metrics.total_accuracy,
+        "macro_f1": metrics.macro_f1,
+        "seconds": elapsed,
+        "classes": classes,
+        "summary": metrics.summary(),
+    }
+
+
+def main(argv=None):
+    from keystone_tpu.utils.platform import setup_platform
+
+    setup_platform()
+    p = argparse.ArgumentParser(description="Newsgroups text pipeline")
+    p.add_argument("--train", dest="train_path")
+    p.add_argument("--test", dest="test_path")
+    p.add_argument("--num-features", type=int, default=10000)
+    p.add_argument("--ngrams", type=int, default=2)
+    p.add_argument(
+        "--classifier", choices=["naive_bayes", "logistic"], default="naive_bayes"
+    )
+    p.add_argument("--synthetic-n", type=int, default=1000)
+    a = p.parse_args(argv)
+    out = run(
+        NewsgroupsConfig(
+            train_path=a.train_path,
+            test_path=a.test_path,
+            num_features=a.num_features,
+            ngrams=a.ngrams,
+            classifier=a.classifier,
+            synthetic_n=a.synthetic_n,
+        )
+    )
+    print(out["summary"])
+    print(f"total {out['seconds']:.2f}s")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,114 @@
+"""NLP node unit tests + text pipeline integration tests."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.evaluation.binary import BinaryClassifierEvaluator
+from keystone_tpu.nodes.nlp import (
+    CommonSparseFeatures,
+    LowerCase,
+    NGramsFeaturizer,
+    TermFrequency,
+    Tokenizer,
+    Trim,
+    WordFrequencyEncoder,
+)
+from keystone_tpu.pipelines.text.amazon_reviews import (
+    AmazonReviewsConfig,
+    run as run_amazon,
+)
+from keystone_tpu.pipelines.text.newsgroups import (
+    NewsgroupsConfig,
+    run as run_newsgroups,
+)
+
+
+def test_tokenize_chain():
+    p = Trim().and_then(LowerCase()).and_then(Tokenizer())
+    out = p(["  Hello, World!  ", "A-B c"]).get()
+    assert out == [["hello", "world"], ["a", "b", "c"]]
+
+
+def test_ngrams():
+    node = NGramsFeaturizer(1, 2)
+    assert node.apply(["a", "b", "c"]) == ["a", "b", "c", "a b", "b c"]
+    with pytest.raises(ValueError):
+        NGramsFeaturizer(2, 1)
+
+
+def test_term_frequency_log():
+    node = TermFrequency("log")
+    out = node.apply(["x", "x", "y"])
+    np.testing.assert_allclose(out["x"], np.log(3.0))
+    np.testing.assert_allclose(out["y"], np.log(2.0))
+
+
+def test_common_sparse_features_keeps_top_terms():
+    docs = [{"a": 1.0, "b": 2.0}, {"a": 1.0}, {"a": 3.0, "c": 1.0}]
+    enc = CommonSparseFeatures(num_features=2).fit(docs)
+    assert set(enc.vocabulary) == {"a", "b"} or set(enc.vocabulary) == {"a", "c"}
+    X = enc(docs)
+    assert X.shape == (3, 2)
+    a_col = enc.index["a"]
+    np.testing.assert_allclose(X[:, a_col], [1.0, 1.0, 3.0])
+
+
+def test_word_frequency_encoder_counts():
+    docs = [["a", "b", "a"], ["b"]]
+    enc = WordFrequencyEncoder(num_words=2).fit(docs)
+    X = enc(docs)
+    np.testing.assert_allclose(X[:, enc.index["a"]], [2.0, 0.0])
+    np.testing.assert_allclose(X[:, enc.index["b"]], [1.0, 1.0])
+
+
+def test_binary_evaluator_and_auc():
+    pred = np.array([1, 1, 0, 0])
+    act = np.array([1, 0, 0, 1])
+    m = BinaryClassifierEvaluator.evaluate(pred, act)
+    assert (m.tp, m.fp, m.tn, m.fn) == (1, 1, 1, 1)
+    assert m.accuracy == 0.5
+    # perfect ranking → AUC 1; inverted → 0
+    assert BinaryClassifierEvaluator.auc([0.9, 0.8, 0.1], [1, 1, 0]) == 1.0
+    assert BinaryClassifierEvaluator.auc([0.1, 0.2, 0.9], [1, 1, 0]) == 0.0
+    # ties → 0.5
+    assert BinaryClassifierEvaluator.auc([0.5, 0.5], [1, 0]) == 0.5
+
+
+def test_newsgroups_pipeline_naive_bayes():
+    out = run_newsgroups(NewsgroupsConfig(synthetic_n=600, num_features=500))
+    assert out["test_accuracy"] > 0.9, out["summary"]
+
+
+def test_newsgroups_pipeline_logistic():
+    out = run_newsgroups(
+        NewsgroupsConfig(
+            synthetic_n=400, num_features=300, classifier="logistic"
+        )
+    )
+    assert out["test_accuracy"] > 0.9, out["summary"]
+
+
+def test_amazon_reviews_pipeline():
+    out = run_amazon(AmazonReviewsConfig(synthetic_n=600, num_features=500))
+    assert out["accuracy"] > 0.9, out["summary"]
+    assert out["auc"] > 0.95, out["summary"]
+
+
+def test_newsgroups_loader_aligns_test_classes(tmp_path):
+    from keystone_tpu.loaders.newsgroups import NewsgroupsDataLoader
+
+    for split, groups in [("train", ["alt", "hockey"]), ("test", ["hockey"])]:
+        for g in groups:
+            d = tmp_path / split / g
+            d.mkdir(parents=True)
+            (d / "1.txt").write_text(f"{g} words here")
+    train, classes = NewsgroupsDataLoader.load(str(tmp_path / "train"))
+    test, _ = NewsgroupsDataLoader.load(str(tmp_path / "test"), classes=classes)
+    # 'hockey' must keep index 1 even though it's the only test class.
+    assert test.labels.tolist() == [classes.index("hockey")]
+    # Unknown test class -> clear error, not silent misalignment.
+    extra = tmp_path / "test" / "zzz"
+    extra.mkdir()
+    (extra / "1.txt").write_text("x")
+    with pytest.raises(ValueError, match="not present in the training"):
+        NewsgroupsDataLoader.load(str(tmp_path / "test"), classes=classes)
