@@ -2,6 +2,8 @@ from keystone_tpu.evaluation.multiclass import (
     MulticlassClassifierEvaluator,
     MulticlassMetrics,
 )
+from keystone_tpu.evaluation.mean_average_precision import MeanAveragePrecisionEvaluator
+from keystone_tpu.evaluation.augmented import AugmentedExamplesEvaluator
 from keystone_tpu.evaluation.binary import (
     BinaryClassifierEvaluator,
     BinaryMetrics,
@@ -12,4 +14,6 @@ __all__ = [
     "MulticlassMetrics",
     "BinaryClassifierEvaluator",
     "BinaryMetrics",
+    "MeanAveragePrecisionEvaluator",
+    "AugmentedExamplesEvaluator",
 ]

@@ -1,0 +1,34 @@
+"""Evaluation over augmented views (test-time augmentation).
+
+Ref: src/main/scala/evaluation/AugmentedExamplesEvaluator.scala — averages
+the classifier scores over an image's augmented crops before ranking
+(ImageNet top-5; SURVEY.md §2.10) [unverified — name low confidence].
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class AugmentedExamplesEvaluator:
+    """Scores: (n·views, C) grouped per image (all views of image i
+    contiguous); labels: (n,)."""
+
+    def __init__(self, num_views: int):
+        self.num_views = num_views
+
+    def average_scores(self, scores) -> np.ndarray:
+        scores = np.asarray(scores)
+        n = scores.shape[0] // self.num_views
+        if scores.shape[0] != n * self.num_views:
+            raise ValueError(
+                f"{scores.shape[0]} rows not divisible by {self.num_views} views"
+            )
+        return scores.reshape(n, self.num_views, -1).mean(axis=1)
+
+    def top_k_error(self, scores, labels, k: int = 5) -> float:
+        avg = self.average_scores(scores)
+        labels = np.asarray(labels).ravel()
+        topk = np.argsort(-avg, axis=1)[:, :k]
+        correct = (topk == labels[:, None]).any(axis=1)
+        return float(1.0 - correct.mean())

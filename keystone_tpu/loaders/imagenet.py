@@ -1,0 +1,113 @@
+"""ImageNet loader: per-synset tars/dirs of JPEGs + label map.
+
+Ref: src/main/scala/loaders/ImageNetLoader.scala — reads JPEGs from tar
+archives (S3-friendly) with a synset→label map (SURVEY.md §2.9)
+[unverified]. Decode is a host thread pool feeding fixed-size NHWC
+batches; `synthetic` generates class-textured images for the no-network
+environment.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tarfile
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from keystone_tpu.config import config
+from keystone_tpu.loaders.labeled_data import LabeledData
+
+
+def _decode(buf: bytes, size: int) -> np.ndarray:
+    from PIL import Image
+
+    with Image.open(io.BytesIO(buf)) as im:
+        im = im.convert("RGB").resize((size, size))
+        return np.asarray(im, dtype=np.float32) / 255.0
+
+
+class ImageNetLoader:
+    @staticmethod
+    def load_label_map(path: str) -> Dict[str, int]:
+        """Lines of `<synset> <int label>`."""
+        out: Dict[str, int] = {}
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 2:
+                    out[parts[0]] = int(parts[1])
+        return out
+
+    @staticmethod
+    def load(
+        data_path: str,
+        label_map: Dict[str, int],
+        size: int = 256,
+        workers: int = 16,
+        limit: Optional[int] = None,
+    ) -> LabeledData:
+        """`data_path`: directory of `<synset>.tar` archives or of
+        `<synset>/` subdirectories of JPEGs."""
+        jobs: List[Tuple[bytes, int]] = []
+        for entry in sorted(os.listdir(data_path)):
+            synset = entry[:-4] if entry.endswith(".tar") else entry
+            label = label_map.get(synset)
+            if label is None:
+                continue
+            full = os.path.join(data_path, entry)
+            if entry.endswith(".tar"):
+                with tarfile.open(full) as tf:
+                    for member in tf.getmembers():
+                        if member.isfile():
+                            f = tf.extractfile(member)
+                            if f is not None:
+                                jobs.append((f.read(), label))
+            elif os.path.isdir(full):
+                for fname in sorted(os.listdir(full)):
+                    with open(os.path.join(full, fname), "rb") as f:
+                        jobs.append((f.read(), label))
+            if limit is not None and len(jobs) >= limit:
+                jobs = jobs[:limit]
+                break
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            images = list(pool.map(lambda j: _decode(j[0], size), jobs))
+        return LabeledData(
+            np.stack(images).astype(config.default_dtype),
+            np.asarray([label for _b, label in jobs], dtype=np.int32),
+        )
+
+    @staticmethod
+    def synthetic(
+        n: int = 512, num_classes: int = 16, size: int = 64, seed: int = 0
+    ) -> Tuple[LabeledData, LabeledData]:
+        """Class-textured images (distinct grating frequency/orientation per
+        class + noise)."""
+        yy, xx = np.mgrid[0:size, 0:size]
+        angles = np.linspace(0, np.pi, num_classes, endpoint=False)
+        freqs = 2 + (np.arange(num_classes) % 8)
+        textures = np.stack(
+            [
+                0.5
+                + 0.5
+                * np.sin(
+                    2 * np.pi * freqs[c] / size * (xx * np.cos(angles[c]) + yy * np.sin(angles[c]))
+                )
+                for c in range(num_classes)
+            ]
+        )
+
+        def make(count, off):
+            r = np.random.default_rng(seed + off)
+            y = r.integers(0, num_classes, size=count)
+            base = textures[y][..., None]  # (count, size, size, 1)
+            tint = 0.5 + 0.5 * r.uniform(size=(count, 1, 1, 3))
+            X = base * tint + 0.15 * r.normal(size=(count, size, size, 3))
+            return LabeledData(
+                np.clip(X, 0, 1).astype(config.default_dtype),
+                y.astype(np.int32),
+            )
+
+        return make(n, 1), make(max(n // 4, 128), 2)

@@ -1,0 +1,100 @@
+"""Pascal VOC loader: JPEG images + multi-label annotations.
+
+Ref: src/main/scala/loaders/VOCLoader.scala — VOC2007 images with
+20-class multi-label annotations (SURVEY.md §2.9) [unverified]. JPEG
+decode via PIL on a host thread pool (the javax.imageio analog);
+`synthetic` generates class-colored shape images for the no-network
+environment.
+"""
+
+from __future__ import annotations
+
+import os
+import xml.etree.ElementTree as ET
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from keystone_tpu.config import config
+from keystone_tpu.loaders.labeled_data import LabeledData
+
+VOC_CLASSES = [
+    "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
+    "chair", "cow", "diningtable", "dog", "horse", "motorbike", "person",
+    "pottedplant", "sheep", "sofa", "train", "tvmonitor",
+]
+
+
+def _decode_resize(path: str, size: int) -> np.ndarray:
+    from PIL import Image
+
+    with Image.open(path) as im:
+        im = im.convert("RGB").resize((size, size))
+        return np.asarray(im, dtype=np.float32) / 255.0
+
+
+class VOCLoader:
+    @staticmethod
+    def load(
+        image_dir: str,
+        annotation_dir: str,
+        size: int = 128,
+        workers: int = 16,
+        classes: Sequence[str] = tuple(VOC_CLASSES),
+    ) -> LabeledData:
+        """Returns LabeledData(NHWC images, (n, C) binary multilabels)."""
+        index = {c: i for i, c in enumerate(classes)}
+        names = sorted(
+            f[:-4] for f in os.listdir(annotation_dir) if f.endswith(".xml")
+        )
+        labels = np.zeros((len(names), len(classes)), dtype=np.int32)
+        paths: List[str] = []
+        for i, name in enumerate(names):
+            tree = ET.parse(os.path.join(annotation_dir, name + ".xml"))
+            for obj in tree.findall(".//object/name"):
+                ci = index.get(obj.text or "")
+                if ci is not None:
+                    labels[i, ci] = 1
+            paths.append(os.path.join(image_dir, name + ".jpg"))
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            images = list(pool.map(lambda p: _decode_resize(p, size), paths))
+        return LabeledData(
+            np.stack(images).astype(config.default_dtype), labels
+        )
+
+    @staticmethod
+    def synthetic(
+        n: int = 256, num_classes: int = 6, size: int = 64, seed: int = 0
+    ) -> Tuple[LabeledData, LabeledData]:
+        """Multi-label images: each present class adds its own textured
+        rectangle; labels are the class-presence vector."""
+        rng = np.random.default_rng(seed)
+        # Per-class texture: oriented gratings at distinct frequencies.
+        yy, xx = np.mgrid[0:size, 0:size]
+        textures = [
+            0.5 + 0.5 * np.sin(2 * np.pi * ((c + 2) / 16.0) * (xx * np.cos(a) + yy * np.sin(a)))
+            for c, a in zip(range(num_classes), np.linspace(0, np.pi, num_classes, endpoint=False))
+        ]
+
+        def make(count, off):
+            r = np.random.default_rng(seed + off)
+            X = 0.1 * r.uniform(size=(count, size, size, 3))
+            Y = np.zeros((count, num_classes), dtype=np.int32)
+            for i in range(count):
+                present = r.choice(
+                    num_classes, size=r.integers(1, 3), replace=False
+                )
+                for c in present:
+                    Y[i, c] = 1
+                    s = size // 2
+                    top = int(r.integers(0, size - s))
+                    left = int(r.integers(0, size - s))
+                    patch = textures[c][top : top + s, left : left + s]
+                    ch = c % 3
+                    X[i, top : top + s, left : left + s, ch] += patch
+            return LabeledData(
+                np.clip(X, 0, 1).astype(config.default_dtype), Y
+            )
+
+        return make(n, 1), make(max(n // 4, 64), 2)

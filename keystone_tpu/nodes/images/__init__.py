@@ -5,6 +5,7 @@ from keystone_tpu.nodes.images.patches import (
     RandomPatcher,
     Windower,
 )
+from keystone_tpu.nodes.images.lcs import LCSExtractor
 from keystone_tpu.nodes.images.pixels import (
     GrayScaler,
     ImageVectorizer,
@@ -18,6 +19,7 @@ __all__ = [
     "RandomPatcher",
     "CenterCornerPatcher",
     "Windower",
+    "LCSExtractor",
     "GrayScaler",
     "PixelScaler",
     "ImageVectorizer",

@@ -1,0 +1,7 @@
+from keystone_tpu.nodes.images.external.sift import SIFTExtractor
+from keystone_tpu.nodes.images.external.fisher_vector import (
+    FisherVector,
+    GMMFisherVectorEstimator,
+)
+
+__all__ = ["SIFTExtractor", "FisherVector", "GMMFisherVectorEstimator"]

@@ -1,0 +1,177 @@
+"""Fisher-vector encoding: native (EncEval-parity) and TPU backends.
+
+Ref: src/main/scala/nodes/images/external/FisherVector.scala and the
+GMM-fitting estimator around EncEval.{computeGMM, calcAndGetFVs}
+(SURVEY.md §2.5, §3.4) [unverified].
+
+Two backends with identical math:
+- "native": the C++ library (capability parity with the reference's
+  native path; OpenMP on the host).
+- "tpu": batched jnp — responsibilities and both gradient blocks are
+  einsums on the MXU, jitted and chunked over images. This is the
+  performance path (SURVEY.md §2.3 rebuild note).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu import native
+from keystone_tpu.config import config
+from keystone_tpu.workflow import Estimator, Transformer
+
+
+@partial(jax.jit, static_argnames=())
+def _fv_tpu(X, w, mu, var):
+    """X: (B, m, d) descriptor sets → (B, 2·k·d) raw Fisher vectors."""
+    B, m, d = X.shape
+    k = w.shape[0]
+    # Clamp like the native backend: a component EM starved to weight 0 must
+    # produce a zero block, not log(0)/1/sqrt(0) NaNs.
+    w = jnp.maximum(w, 1e-12)
+    inv = 1.0 / var  # (k, d)
+    # log N(x | mu_j, var_j) + log w_j, gemm-shaped.
+    quad = (
+        jnp.einsum("bmd,kd->bmk", X * X, inv)
+        - 2.0 * jnp.einsum("bmd,kd->bmk", X, mu * inv)
+        + jnp.sum(mu * mu * inv, axis=1)
+    )
+    log_norm = -0.5 * (d * jnp.log(2 * jnp.pi) + jnp.sum(jnp.log(var), axis=1))
+    log_r = jnp.log(w) + log_norm - 0.5 * quad  # (B, m, k)
+    r = jax.nn.softmax(log_r, axis=-1)
+    sigma = jnp.sqrt(var)  # (k, d)
+    # gmu_jt = Σ_i r_ij (x_it − mu_jt)/sigma_jt
+    rx = jnp.einsum("bmk,bmd->bkd", r, X)
+    rsum = jnp.sum(r, axis=1)  # (B, k)
+    gmu = (rx - rsum[..., None] * mu) / sigma
+    # gvar_jt = Σ_i r_ij ((x−mu)²/var − 1)
+    rx2 = jnp.einsum("bmk,bmd->bkd", r, X * X)
+    gvar = (
+        rx2 - 2.0 * mu * rx + rsum[..., None] * (mu * mu)
+    ) * inv - rsum[..., None]
+    cm = 1.0 / (m * jnp.sqrt(w))[:, None]  # (k, 1)
+    cv = 1.0 / (m * jnp.sqrt(2.0 * w))[:, None]
+    out = jnp.concatenate(
+        [(gmu * cm).reshape(B, -1), (gvar * cv).reshape(B, -1)], axis=-1
+    )
+    return out.astype(config.default_dtype)
+
+
+class FisherVector(Transformer):
+    """Encodes per-image descriptor sets (B, m, d) into (B, 2·k·d) FVs."""
+
+    def __init__(self, weights, means, variances, backend: str = "tpu"):
+        if backend not in ("tpu", "native"):
+            raise ValueError(f"unknown backend {backend!r}")
+        self.weights = np.asarray(weights, dtype=np.float32)
+        self.means = np.asarray(means, dtype=np.float32)
+        self.variances = np.asarray(variances, dtype=np.float32)
+        self.backend = backend
+        self.jittable = backend == "tpu"
+
+    def apply_batch(self, X):
+        if self.backend == "tpu":
+            return _fv_tpu(
+                jnp.asarray(X),
+                jnp.asarray(self.weights),
+                jnp.asarray(self.means),
+                jnp.asarray(self.variances),
+            )
+        X = np.asarray(X, dtype=np.float32)
+        return np.stack(
+            [
+                native.fisher_vector(x, self.weights, self.means, self.variances)
+                for x in X
+            ]
+        )
+
+
+def fit_fisher_featurizer(
+    front,
+    train_images,
+    pca_dims: int,
+    gmm_k: int,
+    em_iters: int = 20,
+    sample_size: int = 100_000,
+    backend: str = "tpu",
+    seed: int = 0,
+):
+    """Fit one descriptor branch: front → PCA → FV → signed sqrt → L2.
+
+    `front` is the descriptor extractor pipeline (SIFT or LCS); PCA and the
+    GMM are fit on a flat descriptor sample from `train_images`. Shared by
+    the VOC and ImageNet pipelines (their branches differ only in `front`).
+    """
+    import numpy as _np
+
+    from keystone_tpu.nodes.learning import PCAEstimator
+    from keystone_tpu.nodes.stats import SignedHellingerMapper
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+    from keystone_tpu.nodes.stats.samplers import sample_rows
+
+    descs = _np.asarray(front(train_images).get())  # (n, m, d)
+    flat = sample_rows(
+        descs.reshape(-1, descs.shape[-1]), sample_size, seed=seed
+    )
+    pca = PCAEstimator(dims=pca_dims).fit(flat)
+    reduced = _np.asarray(pca(descs.reshape(-1, descs.shape[-1]))).reshape(
+        descs.shape[0], descs.shape[1], pca_dims
+    )
+    fv = GMMFisherVectorEstimator(
+        k=gmm_k,
+        em_iters=em_iters,
+        sample_size=sample_size,
+        backend=backend,
+        seed=seed,
+    ).fit(reduced)
+    return (
+        front.and_then(pca)
+        .and_then(fv)
+        .and_then(SignedHellingerMapper())
+        .and_then(L2Normalizer())
+    )
+
+
+class GMMFisherVectorEstimator(Estimator):
+    """Fits the GMM (native EM over sampled descriptors) and returns the
+    FisherVector transformer.
+
+    fit() input: (B, m, d) descriptor sets; a flat descriptor sample is
+    drawn for the EM.
+    """
+
+    def __init__(
+        self,
+        k: int,
+        em_iters: int = 25,
+        sample_size: int = 100_000,
+        backend: str = "tpu",
+        seed: int = 0,
+    ):
+        self.k = k
+        self.em_iters = em_iters
+        self.sample_size = sample_size
+        self.backend = backend
+        self.seed = seed
+        if not native.available():
+            raise RuntimeError(
+                "native library unavailable "
+                f"(build error: {native.build_error()}); "
+                "run `make` in keystone_tpu/native"
+            )
+
+    def fit(self, descriptor_sets) -> FisherVector:
+        from keystone_tpu.nodes.stats.samplers import sample_rows
+
+        X = np.asarray(descriptor_sets, dtype=np.float32)
+        flat = sample_rows(
+            X.reshape(-1, X.shape[-1]), self.sample_size, seed=self.seed
+        )
+        w, mu, var = native.gmm_fit(
+            flat, k=self.k, iters=self.em_iters, seed=self.seed
+        )
+        return FisherVector(w, mu, var, backend=self.backend)

@@ -1,0 +1,41 @@
+"""Dense SIFT extractor node backed by the native C++ library.
+
+Ref: src/main/scala/nodes/images/external/SIFTExtractor.scala — the JNI
+wrapper transformer around VLFeat.getSIFTs (SURVEY.md §2.5, §3.4)
+[unverified]. Input NHWC (or NHW1) grayscale batch; output
+(n, num_keypoints, 128) descriptor sets — the dense grid is static per
+image shape, so downstream stages see fixed shapes (no ragged batching).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from keystone_tpu import native
+from keystone_tpu.workflow import Transformer
+
+
+class SIFTExtractor(Transformer):
+    jittable = False  # host/native compute; output feeds device stages
+
+    def __init__(self, step: int = 4, bin_size: int = 4, scale_factor: float = 1.0):
+        self.step = step
+        self.bin_size = bin_size
+        self.scale_factor = scale_factor
+        if not native.available():
+            raise RuntimeError(
+                "native library unavailable "
+                f"(build error: {native.build_error()}); "
+                "run `make` in keystone_tpu/native"
+            )
+
+    def apply_batch(self, X):
+        X = np.asarray(X, dtype=np.float32)
+        if X.ndim == 4:
+            if X.shape[-1] != 1:
+                raise ValueError("SIFTExtractor expects grayscale input")
+            X = X[..., 0]
+        descs = native.dense_sift(X, step=self.step, bin_size=self.bin_size)
+        if self.scale_factor != 1.0:
+            descs = descs * self.scale_factor
+        return descs

@@ -1,0 +1,70 @@
+"""Local color statistics (LCS) descriptors.
+
+Ref: src/main/scala/nodes/images/LCSExtractor.scala — the ImageNet
+pipeline's second descriptor channel: per keypoint, per 4×4 sub-cell, the
+mean and standard deviation of each color channel → 96-dim descriptors
+(4·4 cells × 3 channels × 2 statistics) (SURVEY.md §2.5, BASELINE.json)
+[unverified].
+
+TPU lowering: the per-cell sums are two reduce_window box filters (x and
+x²) computed once per image, then gathered at the dense keypoint grid —
+all jittable, same grid geometry as the SIFT extractor so the two branches
+stay keypoint-aligned.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from keystone_tpu.workflow import Transformer
+
+_CELLS = 4  # 4x4 sub-cells, matching the SIFT spatial grid
+
+
+class LCSExtractor(Transformer):
+    def __init__(self, step: int = 4, bin_size: int = 4, eps: float = 1e-8):
+        self.step = step
+        self.bin_size = bin_size
+        self.eps = eps
+
+    def num_keypoints(self, h: int, w: int) -> int:
+        span = _CELLS * self.bin_size
+        nx = (w - span) // self.step + 1 if w >= span else 0
+        ny = (h - span) // self.step + 1 if h >= span else 0
+        return nx * ny
+
+    def apply_batch(self, X):
+        n, h, w, c = X.shape
+        bs = self.bin_size
+        span = _CELLS * bs
+        if h < span or w < span:
+            raise ValueError(
+                f"image ({h}x{w}) smaller than the {span}px descriptor "
+                f"support (bin_size={bs} x {_CELLS} cells)"
+            )
+        ny = (h - span) // self.step + 1
+        nx = (w - span) // self.step + 1
+        # Box-filter sums of x and x² over bin_size windows, stride 1.
+        dims = (1, bs, bs, 1)
+        ones = (1, 1, 1, 1)
+        s1 = lax.reduce_window(X, 0.0, lax.add, dims, ones, "VALID")
+        s2 = lax.reduce_window(X * X, 0.0, lax.add, dims, ones, "VALID")
+        area = bs * bs
+        # Cell top-left corners for every keypoint and sub-cell.
+        ky = jnp.arange(ny) * self.step  # keypoint tops
+        kx = jnp.arange(nx) * self.step
+        cell = jnp.arange(_CELLS) * bs
+        rows = (ky[:, None] + cell[None, :]).reshape(-1)  # (ny*4,)
+        cols = (kx[:, None] + cell[None, :]).reshape(-1)  # (nx*4,)
+        # Gather: (n, ny*4, nx*4, c)
+        g1 = s1[:, rows][:, :, cols]
+        g2 = s2[:, rows][:, :, cols]
+        mean = g1 / area
+        var = jnp.maximum(g2 / area - mean * mean, 0.0)
+        std = jnp.sqrt(var + self.eps)
+        stats = jnp.concatenate([mean, std], axis=-1)  # (n, ny*4, nx*4, 2c)
+        # Regroup into per-keypoint descriptors: (n, ny, 4, nx, 4, 2c).
+        stats = stats.reshape(n, ny, _CELLS, nx, _CELLS, 2 * c)
+        stats = jnp.moveaxis(stats, 3, 2)  # (n, ny, nx, 4, 4, 2c)
+        return stats.reshape(n, ny * nx, _CELLS * _CELLS * 2 * c)

@@ -4,6 +4,7 @@ from keystone_tpu.nodes.stats.rectifier import LinearRectifier
 from keystone_tpu.nodes.stats.scalers import StandardScaler, StandardScalerModel
 from keystone_tpu.nodes.stats.random_features import CosineRandomFeatures
 from keystone_tpu.nodes.stats.hellinger import SignedHellingerMapper
+from keystone_tpu.nodes.stats.normalizer import L2Normalizer
 from keystone_tpu.nodes.stats.samplers import sample_rows, sample_columns
 
 __all__ = [
@@ -14,6 +15,7 @@ __all__ = [
     "StandardScalerModel",
     "CosineRandomFeatures",
     "SignedHellingerMapper",
+    "L2Normalizer",
     "sample_rows",
     "sample_columns",
 ]

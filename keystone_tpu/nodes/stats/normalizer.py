@@ -1,0 +1,21 @@
+"""Row L2 normalization (the FV normalization tail).
+
+Ref: the reference normalizes Fisher vectors with SignedHellingerMapper
+followed by an L2 normalizer inside the VOC/ImageNet pipelines
+(SURVEY.md §2.11, §3.4) [unverified].
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from keystone_tpu.workflow import Transformer
+
+
+class L2Normalizer(Transformer):
+    def __init__(self, eps: float = 1e-12):
+        self.eps = eps
+
+    def apply_batch(self, X):
+        norm = jnp.linalg.norm(X, axis=-1, keepdims=True)
+        return X / jnp.maximum(norm, self.eps)

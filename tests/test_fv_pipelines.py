@@ -1,0 +1,104 @@
+"""VOC and ImageNet pipeline integration tests + LCS/evaluator units."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu import native
+from keystone_tpu.evaluation.augmented import AugmentedExamplesEvaluator
+from keystone_tpu.evaluation.mean_average_precision import (
+    MeanAveragePrecisionEvaluator,
+)
+from keystone_tpu.nodes.images.lcs import LCSExtractor
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native lib unavailable"
+)
+
+
+def test_lcs_shapes_and_stats(rng):
+    X = rng.uniform(size=(2, 24, 24, 3)).astype(np.float32)
+    node = LCSExtractor(step=4, bin_size=4)
+    out = np.asarray(node(X))
+    assert out.shape == (2, node.num_keypoints(24, 24), 96)
+    # First keypoint, first cell stats == direct computation over the cell.
+    cell = X[0, :4, :4, :]
+    np.testing.assert_allclose(out[0, 0, :3], cell.mean(axis=(0, 1)), atol=1e-5)
+    np.testing.assert_allclose(
+        out[0, 0, 3:6], cell.std(axis=(0, 1)), atol=1e-3
+    )
+
+
+def test_map_evaluator_perfect_and_random():
+    scores = np.array([[0.9, 0.1], [0.8, 0.2], [0.1, 0.9]])
+    labels = np.array([[1, 0], [1, 0], [0, 1]])
+    ev = MeanAveragePrecisionEvaluator(2)
+    out = ev.evaluate(scores, labels)
+    assert out["map"] > 0.99
+    # Exact-AP variant too.
+    assert MeanAveragePrecisionEvaluator(2, eleven_point=False).evaluate(
+        scores, labels
+    )["map"] == pytest.approx(1.0)
+
+
+def test_map_evaluator_empty_class_is_nan():
+    ev = MeanAveragePrecisionEvaluator(2)
+    out = ev.evaluate(np.array([[0.5, 0.5]]), np.array([[1, 0]]))
+    assert np.isnan(out["per_class_ap"][1])
+    assert out["map"] == pytest.approx(out["per_class_ap"][0])
+
+
+def test_augmented_evaluator():
+    # 2 images x 2 views, 3 classes
+    scores = np.array(
+        [[1.0, 0, 0], [0.8, 0.2, 0], [0, 0, 1.0], [0, 0.4, 0.6]]
+    )
+    ev = AugmentedExamplesEvaluator(num_views=2)
+    avg = ev.average_scores(scores)
+    np.testing.assert_allclose(avg[0], [0.9, 0.1, 0.0])
+    assert ev.top_k_error(scores, [0, 2], k=1) == 0.0
+    with pytest.raises(ValueError, match="divisible"):
+        ev.average_scores(scores[:3])
+
+
+@needs_native
+def test_voc_sift_fisher_end_to_end():
+    from keystone_tpu.pipelines.images.voc_sift_fisher import (
+        VOCSIFTFisherConfig,
+        run,
+    )
+
+    out = run(
+        VOCSIFTFisherConfig(
+            synthetic_n=96,
+            synthetic_classes=4,
+            pca_dims=24,
+            gmm_k=4,
+            descriptor_sample=20_000,
+            num_iters=1,
+        )
+    )
+    # Multi-label textures are separable; mAP must beat the ~0.4 chance
+    # level of this synthetic set decisively.
+    assert out["map"] > 0.7, out["summary"]
+
+
+@needs_native
+def test_imagenet_sift_lcs_fv_end_to_end():
+    from keystone_tpu.pipelines.images.imagenet_sift_lcs_fv import (
+        ImageNetSiftLcsFVConfig,
+        run,
+    )
+
+    out = run(
+        ImageNetSiftLcsFVConfig(
+            synthetic_n=256,
+            synthetic_classes=8,
+            pca_dims=16,
+            gmm_k=4,
+            descriptor_sample=30_000,
+            num_iters=1,
+            top_k=5,
+        )
+    )
+    assert out["top_k_error"] < 0.1, out["summary"]
+    assert out["top_1_error"] < 0.5, out["summary"]

@@ -1,0 +1,124 @@
+"""Native C++ library tests (skip when the toolchain can't build it —
+mirroring the reference's native-lib-gated suites, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason=f"native lib unavailable: {native.build_error()}"
+)
+
+
+def test_sift_shapes_and_normalization(rng):
+    imgs = rng.uniform(size=(3, 40, 48)).astype(np.float32)
+    d = native.dense_sift(imgs, step=8, bin_size=4)
+    nkp = native.sift_num_keypoints(40, 48, 8, 4)
+    assert d.shape == (3, nkp, 128)
+    norms = np.linalg.norm(d, axis=2)
+    # descriptors are L2-normalized (or zero for flat patches)
+    assert np.all((np.abs(norms - 1.0) < 1e-3) | (norms < 1e-6))
+    assert d.min() >= 0.0  # gradient-magnitude histograms are nonnegative
+
+
+def test_sift_deterministic_and_translation_sensitive(rng):
+    img = rng.uniform(size=(1, 32, 32)).astype(np.float32)
+    a = native.dense_sift(img, step=4, bin_size=4)
+    b = native.dense_sift(img, step=4, bin_size=4)
+    np.testing.assert_array_equal(a, b)
+    # A constant image has zero gradients -> zero descriptors.
+    flat = np.full((1, 32, 32), 0.5, dtype=np.float32)
+    z = native.dense_sift(flat, step=4, bin_size=4)
+    np.testing.assert_allclose(z, 0.0)
+
+
+def test_sift_oriented_edges_hit_expected_bins():
+    # Vertical edge gradient (pointing +x) should concentrate energy in the
+    # orientation bin around theta = 0.
+    img = np.tile(
+        (np.arange(32, dtype=np.float32) / 31.0)[None, :], (32, 1)
+    )[None]
+    d = native.dense_sift(img, step=4, bin_size=4)
+    desc = d[0, 0].reshape(16, 8)
+    assert desc.sum() > 0
+    assert np.argmax(desc.sum(axis=0)) == 0  # bin 0 = theta ~ 0 (+x)
+
+
+def test_gmm_fit_recovers_mixture(rng):
+    X = np.concatenate(
+        [
+            rng.normal(-3, 0.5, (500, 4)),
+            rng.normal(3, 1.0, (1500, 4)),
+        ]
+    ).astype(np.float32)
+    w, mu, var = native.gmm_fit(X, k=2, iters=40, seed=1)
+    order = np.argsort(mu[:, 0])
+    np.testing.assert_allclose(w[order], [0.25, 0.75], atol=0.03)
+    np.testing.assert_allclose(mu[order][:, 0], [-3, 3], atol=0.2)
+    np.testing.assert_allclose(
+        var[order][:, 0], [0.25, 1.0], atol=0.15
+    )
+
+
+def test_native_gmm_matches_jnp_gmm(rng):
+    """Native EM and the TPU (jnp) EM should land on the same mixture."""
+    from keystone_tpu.nodes.learning import GaussianMixtureModelEstimator
+
+    X = np.concatenate(
+        [rng.normal(-2, 0.6, (400, 3)), rng.normal(2, 0.9, (600, 3))]
+    ).astype(np.float32)
+    w_n, mu_n, _ = native.gmm_fit(X, k=2, iters=50, seed=0)
+    jgmm = GaussianMixtureModelEstimator(k=2, max_iters=50, seed=0).fit(X)
+    order_n = np.argsort(mu_n[:, 0])
+    order_j = np.argsort(np.asarray(jgmm.means)[:, 0])
+    np.testing.assert_allclose(
+        mu_n[order_n], np.asarray(jgmm.means)[order_j], atol=0.1
+    )
+    np.testing.assert_allclose(
+        w_n[order_n], np.asarray(jgmm.weights)[order_j], atol=0.03
+    )
+
+
+def test_fisher_vector_native_matches_tpu(rng):
+    """The two FV backends implement the same math."""
+    from keystone_tpu.nodes.images.external import FisherVector
+
+    X = rng.normal(size=(2, 50, 6)).astype(np.float32)
+    w, mu, var = native.gmm_fit(
+        rng.normal(size=(500, 6)).astype(np.float32), k=3, iters=10, seed=0
+    )
+    fv_native = FisherVector(w, mu, var, backend="native")(X)
+    fv_tpu = np.asarray(FisherVector(w, mu, var, backend="tpu")(X))
+    assert fv_native.shape == fv_tpu.shape == (2, 2 * 3 * 6)
+    np.testing.assert_allclose(fv_native, fv_tpu, rtol=1e-3, atol=1e-4)
+
+
+def test_fisher_vector_oracle(rng):
+    """FV against a direct NumPy implementation of the formulas."""
+    n, d, k = 30, 4, 2
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    w = np.array([0.4, 0.6], dtype=np.float32)
+    mu = rng.normal(size=(k, d)).astype(np.float32)
+    var = rng.uniform(0.5, 1.5, size=(k, d)).astype(np.float32)
+    fv = native.fisher_vector(X, w, mu, var)
+    # NumPy oracle
+    log_r = np.zeros((n, k))
+    for j in range(k):
+        log_r[:, j] = (
+            np.log(w[j])
+            - 0.5 * (d * np.log(2 * np.pi) + np.sum(np.log(var[j])))
+            - 0.5 * np.sum((X - mu[j]) ** 2 / var[j], axis=1)
+        )
+    r = np.exp(log_r - log_r.max(axis=1, keepdims=True))
+    r /= r.sum(axis=1, keepdims=True)
+    gmu = np.zeros((k, d))
+    gvar = np.zeros((k, d))
+    for j in range(k):
+        u = (X - mu[j]) / np.sqrt(var[j])
+        gmu[j] = (r[:, j : j + 1] * u).sum(0) / (n * np.sqrt(w[j]))
+        gvar[j] = (r[:, j : j + 1] * (u**2 - 1)).sum(0) / (
+            n * np.sqrt(2 * w[j])
+        )
+    oracle = np.concatenate([gmu.ravel(), gvar.ravel()])
+    np.testing.assert_allclose(fv, oracle, rtol=1e-3, atol=1e-5)
