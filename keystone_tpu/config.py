@@ -14,6 +14,12 @@ import os
 from dataclasses import dataclass, field
 
 
+def env_flag(name: str) -> bool:
+    """True unless the var is unset or a falsy spelling ('', '0', 'false',
+    'no') — the one env-knob convention used across the framework."""
+    return os.environ.get(name, "").lower() not in ("", "0", "false", "no")
+
+
 @dataclass
 class Config:
     # Default dtype for dense compute (solvers, featurization).
@@ -32,12 +38,18 @@ class Config:
     # HBM budget (bytes) assumed by the auto-caching rule when no device is
     # queried. v5e = 16 GiB; leave headroom for XLA scratch.
     hbm_budget_bytes: int = 12 * (1 << 30)
+    # Whole-pipeline auto-caching (profile a sample run, persist the best
+    # time-saved-per-byte intermediates under a budget). Opt-in: profiling
+    # costs a sample execution per optimization.
+    auto_cache: bool = False
+    # Raise on NaNs inside jitted computations (jax debug_nans; the
+    # sanitizer analog — SURVEY.md §5 race-detection row).
+    debug_nans: bool = False
     # Whether executor fuses jittable transformer chains into one XLA program.
     # Disabled by KEYSTONE_NO_FUSE set to a truthy value (anything except
     # "", "0", "false", "no").
     fuse_chains: bool = field(
-        default_factory=lambda: os.environ.get("KEYSTONE_NO_FUSE", "").lower()
-        in ("", "0", "false", "no")
+        default_factory=lambda: not env_flag("KEYSTONE_NO_FUSE")
     )
 
 

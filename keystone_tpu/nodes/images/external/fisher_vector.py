@@ -118,16 +118,15 @@ def fit_fisher_featurizer(
         descs.reshape(-1, descs.shape[-1]), sample_size, seed=seed
     )
     pca = PCAEstimator(dims=pca_dims).fit(flat)
-    reduced = _np.asarray(pca(descs.reshape(-1, descs.shape[-1]))).reshape(
-        descs.shape[0], descs.shape[1], pca_dims
-    )
+    # The GMM only ever sees sample_size descriptors — PCA-transform the
+    # sample, never the full n·m descriptor set.
     fv = GMMFisherVectorEstimator(
         k=gmm_k,
         em_iters=em_iters,
         sample_size=sample_size,
         backend=backend,
         seed=seed,
-    ).fit(reduced)
+    ).fit(_np.asarray(pca(flat)))
     return (
         front.and_then(pca)
         .and_then(fv)
@@ -137,11 +136,15 @@ def fit_fisher_featurizer(
 
 
 class GMMFisherVectorEstimator(Estimator):
-    """Fits the GMM (native EM over sampled descriptors) and returns the
-    FisherVector transformer.
+    """Fits the GMM over sampled descriptors and returns the FisherVector
+    transformer.
 
-    fit() input: (B, m, d) descriptor sets; a flat descriptor sample is
-    drawn for the EM.
+    fit() input: (B, m, d) descriptor sets or an (n, d) flat descriptor
+    matrix; a flat descriptor sample is drawn for the EM.
+
+    gmm_backend: "native" (C++ EM), "tpu" (jnp EM), or "auto" — native when
+    the library built, otherwise the jnp twin (the two converge to the same
+    mixture; see tests/test_native.py).
     """
 
     def __init__(
@@ -150,6 +153,7 @@ class GMMFisherVectorEstimator(Estimator):
         em_iters: int = 25,
         sample_size: int = 100_000,
         backend: str = "tpu",
+        gmm_backend: str = "auto",
         seed: int = 0,
     ):
         self.k = k
@@ -157,12 +161,15 @@ class GMMFisherVectorEstimator(Estimator):
         self.sample_size = sample_size
         self.backend = backend
         self.seed = seed
-        if not native.available():
+        if gmm_backend == "auto":
+            gmm_backend = "native" if native.available() else "tpu"
+        if gmm_backend == "native" and not native.available():
             raise RuntimeError(
                 "native library unavailable "
                 f"(build error: {native.build_error()}); "
-                "run `make` in keystone_tpu/native"
+                "run `make` in keystone_tpu/native or use gmm_backend='tpu'"
             )
+        self.gmm_backend = gmm_backend
 
     def fit(self, descriptor_sets) -> FisherVector:
         from keystone_tpu.nodes.stats.samplers import sample_rows
@@ -171,7 +178,17 @@ class GMMFisherVectorEstimator(Estimator):
         flat = sample_rows(
             X.reshape(-1, X.shape[-1]), self.sample_size, seed=self.seed
         )
-        w, mu, var = native.gmm_fit(
-            flat, k=self.k, iters=self.em_iters, seed=self.seed
-        )
+        if self.gmm_backend == "native":
+            w, mu, var = native.gmm_fit(
+                flat, k=self.k, iters=self.em_iters, seed=self.seed
+            )
+        else:
+            from keystone_tpu.nodes.learning.gmm import (
+                GaussianMixtureModelEstimator,
+            )
+
+            gmm = GaussianMixtureModelEstimator(
+                k=self.k, max_iters=self.em_iters, seed=self.seed
+            ).fit(flat)
+            w, mu, var = gmm.weights, gmm.means, gmm.variances
         return FisherVector(w, mu, var, backend=self.backend)

@@ -63,11 +63,14 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         num_iters: int = 1,
         lam: float = 0.0,
         fit_intercept: bool = True,
+        checkpoint_dir: Optional[str] = None,
     ):
         self.block_size = block_size
         self.num_iters = num_iters
         self.lam = lam
         self.fit_intercept = fit_intercept
+        # Epoch-boundary solver checkpointing (orbax); resumes on refit.
+        self.checkpoint_dir = checkpoint_dir
 
     def _weights(self, Y: jnp.ndarray) -> Optional[jax.Array]:
         return None
@@ -97,6 +100,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             num_iters=self.num_iters,
             lam=self.lam,
             row_weights=weights,
+            checkpoint_dir=self.checkpoint_dir,
         )
         b = None
         if self.fit_intercept:

@@ -83,6 +83,27 @@ class LeastSquaresEstimator(LabelEstimator):
         self.hbm_budget_bytes = hbm_budget_bytes
         self.last_choice: SolverChoice | None = None
 
+    def optimize_node(self, data_shape, labels_shape=None):
+        """Node-level optimization hook (workflow.rules.NodeOptimizationRule):
+        commit to a concrete solver from the dataset shapes at graph-optimize
+        time. Returns self when shape info is insufficient (fit-time dispatch
+        then still applies)."""
+        if len(data_shape) != 2:
+            return self
+        n, d = int(data_shape[0]), int(data_shape[1])
+        if labels_shape is None:
+            return self  # label width unknown: defer to fit-time dispatch
+        k = int(labels_shape[1]) if len(labels_shape) > 1 else 1
+        choice = choose_solver(n, d, k, self.hbm_budget_bytes, self.block_size)
+        self.last_choice = choice
+        if choice.name == "local":
+            return LocalLeastSquaresEstimator(self.lam)
+        if choice.name == "normal":
+            return LinearMapEstimator(self.lam)
+        return BlockLeastSquaresEstimator(
+            block_size=self.block_size, num_iters=self.num_iters, lam=self.lam
+        )
+
     def fit(self, data, labels) -> Transformer:
         X = jnp.asarray(data)
         Y = jnp.asarray(labels)

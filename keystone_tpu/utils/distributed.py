@@ -1,0 +1,50 @@
+"""Multi-host bring-up over DCN.
+
+Ref: the reference inherits its control plane from Spark (driver/executor
+over Netty RPC; SURVEY.md §5 distributed-backend row). The TPU equivalent
+is single-controller-per-host JAX: each host process calls
+``jax.distributed.initialize`` (rendezvous over DCN), after which
+``jax.devices()`` spans every chip in the slice and the same
+mesh/collective code used on one host runs pod-wide — `psum`/`all_gather`
+ride ICI within a slice and DCN across slices, replacing treeAggregate 1:1.
+
+Single-host (or this sandbox's 1-chip / fake-CPU-mesh) callers skip
+initialization entirely; nothing else in the framework changes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-host rendezvous. Arguments default from the standard
+    env vars (KEYSTONE_COORDINATOR, KEYSTONE_NUM_PROCESSES,
+    KEYSTONE_PROCESS_ID) so `bin/run-pipeline.sh` can drive pod launches
+    with env knobs alone."""
+    coordinator_address = coordinator_address or os.environ.get(
+        "KEYSTONE_COORDINATOR"
+    )
+    if coordinator_address is None:
+        return  # single-host: nothing to do
+    num_processes = num_processes or int(os.environ["KEYSTONE_NUM_PROCESSES"])
+    process_id = process_id or int(os.environ["KEYSTONE_PROCESS_ID"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh():
+    """Mesh over every device in the (possibly multi-host) job."""
+    from keystone_tpu.utils.mesh import default_mesh
+
+    return default_mesh()

@@ -1,0 +1,61 @@
+"""Observability: stage timers and XLA cost introspection.
+
+Ref: the reference's `Logging` trait with per-stage wall times in pipeline
+mains + Spark metrics (SURVEY.md §5 metrics row) [unverified]. Here:
+structured stage timing plus FLOP/byte counts straight from the compiled
+HLO (`cost_analysis`), which is what per-chip TFLOPS reporting uses.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict
+
+import jax
+
+logger = logging.getLogger("keystone_tpu")
+
+
+@contextmanager
+def stage_timer(name: str, sink: Dict[str, float] | None = None):
+    """Logs (and optionally records) the wall time of a pipeline stage."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        logger.info("stage=%s seconds=%.4f", name, dt)
+        if sink is not None:
+            sink[name] = dt
+
+
+def cost_analysis(fn: Callable, *args) -> Dict[str, Any]:
+    """FLOPs / bytes-accessed of `fn` as XLA compiles it for these args."""
+    lowered = jax.jit(fn).lower(*args)
+    compiled = lowered.compile()
+    cost = compiled.cost_analysis() or {}
+    return {
+        "flops": float(cost.get("flops", 0.0)),
+        "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
+        "raw": dict(cost),
+    }
+
+
+def achieved_tflops(fn: Callable, *args, repeats: int = 3) -> Dict[str, float]:
+    """Compile, time, and convert to achieved TFLOPS (per process)."""
+    jitted = jax.jit(fn)
+    out = jitted(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        out = jitted(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / repeats
+    flops = cost_analysis(fn, *args)["flops"]
+    return {
+        "seconds": dt,
+        "flops": flops,
+        "tflops": flops / dt / 1e12 if dt > 0 else 0.0,
+    }

@@ -16,3 +16,13 @@ def setup_platform() -> None:
         import jax
 
         jax.config.update("jax_platforms", plat)
+    from keystone_tpu.config import config, env_flag
+
+    if config.debug_nans or env_flag("KEYSTONE_DEBUG_NANS"):
+        import jax
+
+        jax.config.update("jax_debug_nans", True)
+    # Multi-host rendezvous when the env knobs are present (no-op otherwise).
+    from keystone_tpu.utils import distributed
+
+    distributed.initialize()

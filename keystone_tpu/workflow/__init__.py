@@ -15,6 +15,7 @@ from keystone_tpu.workflow.optimizer import (
     Rule,
     default_optimizer,
 )
+from keystone_tpu.workflow.serialization import load_pipeline, save_pipeline
 
 __all__ = [
     "Graph",
@@ -34,4 +35,6 @@ __all__ = [
     "ChainFusionRule",
     "EquivalentNodeMergeRule",
     "default_optimizer",
+    "save_pipeline",
+    "load_pipeline",
 ]

@@ -58,6 +58,13 @@ class GraphExecutor:
             op = graph.operators[nid]
             if h in by_hash:
                 values[nid] = by_hash[h]
+                # A cache node hashes identically to its dependency (it's an
+                # identity), so it lands here — still persist its value.
+                if getattr(op, "persist", False) and h not in self.env.node_cache:
+                    self.env.node_cache[h] = (
+                        values[nid],
+                        self._prefix_pins(graph, nid),
+                    )
                 continue
             if isinstance(op, EstimatorOperator) and h in self.env.fit_cache:
                 values[nid] = by_hash[h] = self.env.fit_cache[h][0]
@@ -133,6 +140,13 @@ class GraphExecutor:
             op = graph.operators[nid]
             if isinstance(op, DelegatingOperator):
                 est_dep, input_dep = graph.dependencies[nid]
+                # See through identity cache nodes between estimator and
+                # delegating consumer.
+                while (
+                    est_dep in graph.operators
+                    and getattr(graph.operators[est_dep], "persist", False)
+                ):
+                    est_dep = graph.dependencies[est_dep][0]
                 if est_dep in fitted:
                     ops[nid] = TransformerOperator(fitted[est_dep])
                     dps[nid] = (input_dep,)

@@ -148,9 +148,13 @@ class Optimizer:
 
 
 def default_optimizer() -> Optimizer:
-    return Optimizer(
-        [
-            ("dedup", [EquivalentNodeMergeRule()], 3),
-            ("fusion", [ChainFusionRule()], 1),
-        ]
-    )
+    from keystone_tpu.workflow.rules import AutoCacheRule, NodeOptimizationRule
+
+    batches: List[Tuple[str, List[Rule], int]] = [
+        ("dedup", [EquivalentNodeMergeRule()], 3),
+        ("node-level", [NodeOptimizationRule()], 1),
+    ]
+    if config.auto_cache:
+        batches.append(("auto-cache", [AutoCacheRule()], 1))
+    batches.append(("fusion", [ChainFusionRule()], 1))
+    return Optimizer(batches)

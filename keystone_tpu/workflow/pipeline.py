@@ -290,6 +290,14 @@ class Pipeline:
         merged, out = merged.add(GatherOperator(), sinks)
         return Pipeline(merged.pruned([out]), source, out)
 
+    def cache(self) -> "Pipeline":
+        """Mark this pipeline's output for session-cache persistence (the
+        explicit Cacher; the auto-cache rule inserts these automatically)."""
+        from keystone_tpu.workflow.cache import CacheOperator
+
+        graph, nid = self.graph.add(CacheOperator(), [self.sink])
+        return Pipeline(graph, self.source, nid)
+
     # -- application -------------------------------------------------------
 
     def apply(self, data) -> "PipelineDataset":

@@ -1,0 +1,123 @@
+"""Whole-pipeline optimization rules: auto-caching and node-level solver
+selection.
+
+Ref: src/main/scala/workflow/{AutoCacheRule,NodeOptimizationRule}.scala
+(SURVEY.md §2.1, §3.5) [unverified].
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from keystone_tpu.config import config
+from keystone_tpu.workflow.cache import CacheOperator, NodeProfile, Profiler
+from keystone_tpu.workflow.graph import Graph, GraphId, NodeId
+from keystone_tpu.workflow.operators import (
+    DatasetOperator,
+    EstimatorOperator,
+    TransformerOperator,
+)
+from keystone_tpu.workflow.optimizer import Rule
+
+
+class NodeOptimizationRule(Rule):
+    """Swap optimizable estimators for concrete implementations chosen from
+    data statistics at optimization time.
+
+    An estimator opts in by defining ``optimize_node(self, data_shape) ->
+    estimator``; shapes are read from directly-attached dataset nodes (the
+    common with_data case). Estimators whose inputs are deeper subgraphs
+    keep their fit-time dispatch (e.g. LeastSquaresEstimator's cost model).
+    """
+
+    def apply(self, graph: Graph, targets: Sequence[GraphId]) -> Graph:
+        out = graph
+        for nid in graph.reachable(targets):
+            op = graph.operators[nid]
+            if not isinstance(op, EstimatorOperator):
+                continue
+            optimize = getattr(op.estimator, "optimize_node", None)
+            if optimize is None:
+                continue
+            shapes = []
+            for dep in graph.dependencies[nid]:
+                shape = None
+                if isinstance(dep, NodeId):
+                    dep_op = graph.operators.get(dep)
+                    if isinstance(dep_op, DatasetOperator):
+                        shape = getattr(dep_op.data, "shape", None)
+                shapes.append(shape)
+            if not shapes or shapes[0] is None:
+                continue
+            concrete = optimize(*shapes)
+            if concrete is not None and concrete is not op.estimator:
+                out = out.replace_node(
+                    nid, EstimatorOperator(concrete), graph.dependencies[nid]
+                )
+        return out
+
+
+class AutoCacheRule(Rule):
+    """Profile a sample run, then greedily insert cache nodes under a
+    memory budget, best time-saved-per-byte first.
+
+    The session cache persists values across executions (fit → later
+    applies, repeated gets over graph copies); within one execution the
+    structural-hash memo already dedups, so the win is cross-execution
+    recompute avoidance — the reference's cached-RDD reuse, with HBM/host
+    RAM as the budget.
+    """
+
+    def __init__(
+        self,
+        budget_bytes: int | None = None,
+        sample_rows: int = 64,
+        min_consumers: int = 1,
+    ):
+        self.budget_bytes = budget_bytes
+        self.sample_rows = sample_rows
+        self.min_consumers = min_consumers
+
+    def apply(self, graph: Graph, targets: Sequence[GraphId]) -> Graph:
+        budget = self.budget_bytes or config.hbm_budget_bytes // 4
+        profiles = Profiler(self.sample_rows).profile(graph, targets)
+        if not profiles:
+            return graph
+        cons = graph.consumers(targets)
+        targets_set = set(targets)
+        candidates: List[tuple[float, int, NodeId]] = []
+        for nid, prof in profiles.items():
+            op = graph.operators[nid]
+            if isinstance(op, (DatasetOperator, CacheOperator)):
+                continue  # data already lives in host memory; cache is cache
+            if isinstance(op, EstimatorOperator):
+                # Fits persist in the fit cache already, and a cache node
+                # between an estimator and its delegating consumer would
+                # hide the fitted transformer from Pipeline.fit's rewrite.
+                continue
+            if nid in targets_set or len(cons.get(nid, ())) < self.min_consumers:
+                continue
+            est_bytes = int(prof.bytes * prof.scale)
+            est_seconds = prof.seconds * prof.scale
+            if est_bytes <= 0 or est_seconds <= 0:
+                continue
+            candidates.append((est_seconds / est_bytes, est_bytes, nid))
+        candidates.sort(reverse=True)
+
+        ops = dict(graph.operators)
+        dps = dict(graph.dependencies)
+        spent = 0
+        for _ratio, nbytes, nid in candidates:
+            if spent + nbytes > budget:
+                continue
+            spent += nbytes
+            from keystone_tpu.workflow.graph import fresh_node_id
+
+            cache_id = fresh_node_id()
+            ops[cache_id] = CacheOperator()
+            dps[cache_id] = (nid,)
+            for consumer in cons.get(nid, ()):
+                dps[consumer] = tuple(
+                    cache_id if d == nid else d for d in dps[consumer]
+                )
+        return Graph(ops, dps)

@@ -1,0 +1,46 @@
+"""Fitted-pipeline serialization.
+
+Ref: the reference exports models by plain serialization of fitted
+transformers (SURVEY.md §5 checkpoint/resume row) [unverified]. A fitted
+pipeline here is transformer objects holding array pytrees; pickling works
+once per-instance jit caches are stripped (they rebuild lazily on first
+use after load).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any
+
+from keystone_tpu.workflow.pipeline import Pipeline, Transformer
+
+
+def _strip_jit(obj: Any) -> None:
+    if isinstance(obj, Transformer):
+        obj.__dict__.pop("_jit_cache", None)
+        for sub in getattr(obj, "stages", []):
+            _strip_jit(sub)
+
+
+def save_pipeline(pipeline: Pipeline, path: str) -> None:
+    """Persist a fitted (transformer-only) pipeline. Call .fit() first."""
+    from keystone_tpu.workflow.operators import (
+        EstimatorOperator,
+        TransformerOperator,
+    )
+
+    for op in pipeline.graph.operators.values():
+        if isinstance(op, EstimatorOperator):
+            raise ValueError(
+                "pipeline still contains unfitted estimators; call .fit() "
+                "before saving"
+            )
+        if isinstance(op, TransformerOperator):
+            _strip_jit(op.transformer)
+    with open(path, "wb") as f:
+        pickle.dump(pipeline, f)
+
+
+def load_pipeline(path: str) -> Pipeline:
+    with open(path, "rb") as f:
+        return pickle.load(f)
