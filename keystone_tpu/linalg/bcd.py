@@ -35,6 +35,50 @@ from keystone_tpu.linalg.row_matrix import RowMatrix, _precision
 
 
 @lru_cache(maxsize=None)
+def _gram_chol_fn(mesh: Mesh, axis: str, precision, weighted: bool):
+    """Per-block gram + Cholesky, computed once per block (epoch-invariant)."""
+
+    def local(a_b, lam, w_rows):
+        aw = a_b * w_rows[:, None] if weighted else a_b
+        gram = lax.psum(jnp.matmul(aw.T, a_b, precision=precision), axis)
+        b = a_b.shape[1]
+        return jnp.linalg.cholesky(gram + lam * jnp.eye(b, dtype=gram.dtype))
+
+    sm = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis), P(), P(axis)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sm)
+
+
+@lru_cache(maxsize=None)
+def _cached_block_update_fn(mesh: Mesh, axis: str, precision, weighted: bool):
+    """BCD block update reusing a precomputed Cholesky factor: only the
+    residual/rhs gemms and two triangular solves remain in the epoch loop —
+    the dominant 2·n·b² gram FLOPs drop out after the first epoch."""
+
+    def local(a_b, chol, r, w_b, w_rows):
+        r_plus = r + jnp.matmul(a_b, w_b, precision=precision)
+        aw = a_b * w_rows[:, None] if weighted else a_b
+        rhs = lax.psum(jnp.matmul(aw.T, r_plus, precision=precision), axis)
+        w_b_new = cho_solve((chol, True), rhs)
+        r_new = r_plus - jnp.matmul(a_b, w_b_new, precision=precision)
+        return r_new, w_b_new
+
+    sm = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis), P(), P(axis), P(), P(axis)),
+        out_specs=(P(axis), P()),
+        check_vma=False,
+    )
+    return jax.jit(sm)
+
+
+@lru_cache(maxsize=None)
 def _block_update_fn(mesh: Mesh, axis: str, precision, weighted: bool):
     """One BCD block update, jitted once per (mesh, shapes) and reused for
     every block and epoch — the hot loop of the whole framework."""
@@ -71,6 +115,7 @@ def block_coordinate_descent(
     lam: float = 0.0,
     row_weights: Optional[jax.Array] = None,
     checkpoint_dir: Optional[str] = None,
+    cache_grams: Optional[bool] = None,
 ) -> Tuple[List[jax.Array], List[Tuple[int, int]]]:
     """Solve min_W ||A W - B||² + lam ||W||² block-by-block.
 
@@ -83,6 +128,12 @@ def block_coordinate_descent(
     on restart — the fault-recovery analog of Spark's lineage recompute
     (SURVEY.md §5 failure-detection row): deterministic re-execution from
     the last epoch boundary instead of RDD lineage.
+
+    ``cache_grams`` (default: auto) precomputes each block's gram Cholesky
+    once — grams are epoch-invariant, so multi-epoch solves drop the
+    dominant 2·n·b² FLOPs from every epoch after the first. Auto enables it
+    when num_iters > 1 and the (num_blocks · b²) factors fit a quarter of
+    the HBM budget.
     """
     A._check_aligned(B)
     mesh, axis = A.mesh, config.data_axis
@@ -105,6 +156,10 @@ def block_coordinate_descent(
             w_rows, jax.sharding.NamedSharding(mesh, P(axis))
         )
 
+    if cache_grams is None:
+        itemsize = jnp.dtype(dtype).itemsize
+        factor_bytes = sum((e - s) ** 2 for s, e in blocks) * itemsize
+        cache_grams = num_iters > 1 and factor_bytes < config.hbm_budget_bytes // 4
     update = _block_update_fn(mesh, axis, _precision(), weighted)
     lam_arr = jnp.asarray(lam, dtype=dtype)
 
@@ -139,10 +194,42 @@ def block_coordinate_descent(
     # A (one extra A-sized copy in aggregate) and every epoch then reads them
     # without re-materializing slices in the hot loop. When feature blocks
     # stop fitting in HBM the estimator layer streams them from host instead.
+    # The CPU-emulated mesh's in-process all-reduce rendezvous can deadlock
+    # when many small collective programs are in flight concurrently (7/8
+    # threads arrive -> 40s timeout -> abort). Throttle dispatch per epoch
+    # on CPU only; TPU keeps full async pipelining.
+    throttle = jax.default_backend() == "cpu"
+
     a_blocks = [lax.slice_in_dim(A.data, s, e, axis=1) for s, e in blocks]
+    if cache_grams and start_epoch < num_iters:
+        gram_chol = _gram_chol_fn(mesh, axis, _precision(), weighted)
+        cached_update = _cached_block_update_fn(
+            mesh, axis, _precision(), weighted
+        )
+        chols = []
+        for a_b in a_blocks:
+            c = gram_chol(a_b, lam_arr, w_rows)
+            if throttle:
+                # The gram/Cholesky programs are mutually independent — an
+                # un-serialized burst is exactly the concurrent-collectives
+                # pattern that deadlocks the CPU rendezvous.
+                c.block_until_ready()
+            chols.append(c)
+        for epoch in range(start_epoch, num_iters):
+            for i in range(len(blocks)):
+                R, W[i] = cached_update(
+                    a_blocks[i], chols[i], R, W[i], w_rows
+                )
+            if throttle:
+                R.block_until_ready()
+            if checkpoint_dir is not None:
+                _save_epoch(checkpoint_dir, epoch + 1, W, R, fingerprint)
+        return W, blocks
     for epoch in range(start_epoch, num_iters):
         for i in range(len(blocks)):
             R, W[i] = update(a_blocks[i], R, W[i], lam_arr, w_rows)
+        if throttle:
+            R.block_until_ready()
         if checkpoint_dir is not None:
             _save_epoch(checkpoint_dir, epoch + 1, W, R, fingerprint)
     return W, blocks

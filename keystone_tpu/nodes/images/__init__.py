@@ -2,10 +2,13 @@ from keystone_tpu.nodes.images.convolver import Convolver
 from keystone_tpu.nodes.images.pooling import Pooler, SymmetricRectifier
 from keystone_tpu.nodes.images.patches import (
     CenterCornerPatcher,
+    Cropper,
     RandomPatcher,
     Windower,
 )
 from keystone_tpu.nodes.images.lcs import LCSExtractor
+from keystone_tpu.nodes.images.hog import HogExtractor
+from keystone_tpu.nodes.images.daisy import DaisyExtractor
 from keystone_tpu.nodes.images.pixels import (
     GrayScaler,
     ImageVectorizer,
@@ -18,8 +21,11 @@ __all__ = [
     "SymmetricRectifier",
     "RandomPatcher",
     "CenterCornerPatcher",
+    "Cropper",
     "Windower",
     "LCSExtractor",
+    "HogExtractor",
+    "DaisyExtractor",
     "GrayScaler",
     "PixelScaler",
     "ImageVectorizer",

@@ -63,6 +63,33 @@ class Windower(Transformer):
         return wins.reshape(n * out_h * out_w, p, p, c)
 
 
+class Cropper(Transformer):
+    """Fixed crop (Ref: nodes/images/Cropper.scala [unverified])."""
+
+    def __init__(self, top: int, left: int, height: int, width: int):
+        if min(top, left) < 0 or min(height, width) <= 0:
+            raise ValueError(
+                f"invalid crop (top={top}, left={left}, h={height}, w={width})"
+            )
+        self.top = top
+        self.left = left
+        self.height = height
+        self.width = width
+
+    def apply_batch(self, X):
+        if self.top + self.height > X.shape[1] or self.left + self.width > X.shape[2]:
+            raise ValueError(
+                f"crop {self.top}+{self.height} x {self.left}+{self.width} "
+                f"exceeds image {X.shape[1]}x{X.shape[2]}"
+            )
+        return X[
+            :,
+            self.top : self.top + self.height,
+            self.left : self.left + self.width,
+            :,
+        ]
+
+
 class CenterCornerPatcher(Transformer):
     """Center + four corner crops, optionally horizontally flipped — the
     test-time augmentation of the ImageNet pipeline. Emits (n·views, s, s, c)

@@ -1,6 +1,13 @@
 from keystone_tpu.nodes.util.labels import ClassLabelIndicators
 from keystone_tpu.nodes.util.classifiers import MaxClassifier, TopKClassifier
-from keystone_tpu.nodes.util.misc import Cast, Identity, VectorCombiner, VectorSplitter
+from keystone_tpu.nodes.util.misc import (
+    Cast,
+    Densify,
+    Identity,
+    Sparsify,
+    VectorCombiner,
+    VectorSplitter,
+)
 
 __all__ = [
     "ClassLabelIndicators",
@@ -10,4 +17,6 @@ __all__ = [
     "Identity",
     "VectorSplitter",
     "VectorCombiner",
+    "Densify",
+    "Sparsify",
 ]

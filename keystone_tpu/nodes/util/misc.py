@@ -57,3 +57,49 @@ class VectorCombiner(Transformer):
 
     def apply_batch(self, blocks):
         return jnp.concatenate(blocks, axis=-1)
+
+
+class Densify(Transformer):
+    """(index → value) mappings → dense rows of a fixed dimension.
+
+    Ref: nodes/util/Densify.scala [unverified]. On TPU every downstream
+    consumer wants dense batches; this is the boundary node.
+    """
+
+    jittable = False
+
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def apply_batch(self, docs):
+        import numpy as np
+
+        from keystone_tpu.config import config
+
+        out = np.zeros((len(docs), self.dim), dtype=config.default_dtype)
+        for i, doc in enumerate(docs):
+            items = doc.items() if hasattr(doc, "items") else doc
+            for j, v in items:
+                j = int(j)
+                if not 0 <= j < self.dim:
+                    raise ValueError(
+                        f"feature index {j} out of range [0, {self.dim})"
+                    )
+                out[i, j] = v
+        return out
+
+
+class Sparsify(Transformer):
+    """Dense rows → (index → value) dicts of the nonzero entries
+    (Ref: nodes/util/SparseFeatureVectorizer direction [unverified])."""
+
+    jittable = False
+
+    def apply_batch(self, X):
+        import numpy as np
+
+        X = np.asarray(X)
+        return [
+            {int(j): float(X[i, j]) for j in np.flatnonzero(X[i])}
+            for i in range(X.shape[0])
+        ]

@@ -60,3 +60,33 @@ def map_pixels(batch, fn):
 def vectorize(batch):
     """NHWC → (N, H·W·C) row vectors."""
     return batch.reshape(batch.shape[0], -1)
+
+
+def clamped_gradients(g):
+    """Central differences with edge-clamped borders for (n, h, w) images —
+    no wrap-around mixing opposite edges into border gradients."""
+    gp = jnp.pad(g, ((0, 0), (1, 1), (1, 1)), mode="edge")
+    gx = 0.5 * (gp[:, 1:-1, 2:] - gp[:, 1:-1, :-2])
+    gy = 0.5 * (gp[:, 2:, 1:-1] - gp[:, :-2, 1:-1])
+    return gx, gy
+
+
+def orientation_maps(g, num_bins: int, signed: bool):
+    """Soft-binned gradient-orientation channel maps for (n, h, w) images.
+
+    Returns (n, h, w, num_bins): per pixel, the gradient magnitude split
+    linearly between the two orientation bins bracketing its angle —
+    unsigned ([0, π), HOG-style) or signed ([0, 2π), DAISY/SIFT-style).
+    Shared by the HOG and DAISY extractors.
+    """
+    gx, gy = clamped_gradients(g)
+    mag = jnp.sqrt(gx * gx + gy * gy)
+    period = 2 * jnp.pi if signed else jnp.pi
+    theta = jnp.mod(jnp.arctan2(gy, gx), period)
+    fbin = theta * num_bins / period
+    b0 = jnp.floor(fbin).astype(jnp.int32) % num_bins
+    w1 = fbin - jnp.floor(fbin)
+    bins = jnp.arange(num_bins)
+    return (b0[..., None] == bins) * (mag * (1.0 - w1))[..., None] + (
+        ((b0 + 1) % num_bins)[..., None] == bins
+    ) * (mag * w1)[..., None]

@@ -1,0 +1,89 @@
+"""HOG / DAISY / Cropper / Densify-Sparsify unit tests."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.nodes.images import Cropper, DaisyExtractor, HogExtractor
+from keystone_tpu.nodes.util import Densify, Sparsify
+
+
+def test_hog_shapes_and_orientation(rng):
+    X = rng.uniform(size=(2, 32, 32, 1)).astype(np.float32)
+    out = np.asarray(HogExtractor(cell_size=8, num_bins=9)(X))
+    # 4x4 cells -> 3x3 blocks of 4*9 values.
+    assert out.shape == (2, 3 * 3 * 36)
+    # L2-hys: nonnegative, renormalized after the 0.2 clip (so entries can
+    # exceed 0.2 but each block stays unit-or-less norm).
+    assert np.all(out >= 0) and np.all(out <= 1.0 + 1e-5)
+    # A pure vertical ramp (gradient along y) must put its energy in the
+    # bin containing theta = pi/2.
+    ramp = np.tile(
+        (np.arange(32, dtype=np.float32) / 31.0)[:, None], (1, 32)
+    )[None, ..., None]
+    desc = np.asarray(HogExtractor(cell_size=8, num_bins=9)(ramp))
+    per_bin = desc.reshape(-1, 9).sum(axis=0)
+    assert np.argmax(per_bin) == 4  # bin 4 of 9 covers [4pi/9, 5pi/9) ∋ pi/2
+
+
+def test_hog_handles_rgb(rng):
+    X = rng.uniform(size=(1, 16, 16, 3)).astype(np.float32)
+    out = np.asarray(HogExtractor(cell_size=8)(X))
+    assert out.shape[0] == 1 and np.isfinite(out).all()
+
+
+def test_daisy_shapes_and_normalization(rng):
+    X = rng.uniform(size=(2, 48, 48, 1)).astype(np.float32)
+    node = DaisyExtractor(step=16, radius=8, rings=2, ring_points=4)
+    out = np.asarray(node(X))
+    assert out.shape[0] == 2 and out.shape[2] == node.descriptor_dim
+    # Each histogram sample is L2-normalized (or zero).
+    hist = out.reshape(2, out.shape[1], -1, node.num_bins)
+    norms = np.linalg.norm(hist, axis=-1)
+    assert np.all((np.abs(norms - 1.0) < 1e-3) | (norms < 1e-6))
+
+
+def test_daisy_rejects_tiny_images(rng):
+    X = rng.uniform(size=(1, 10, 10, 1)).astype(np.float32)
+    with pytest.raises(ValueError, match="smaller than the DAISY radius"):
+        DaisyExtractor(radius=12)(X)
+
+
+def test_cropper(rng):
+    X = rng.uniform(size=(2, 8, 8, 3)).astype(np.float32)
+    out = np.asarray(Cropper(2, 3, 4, 5)(X))
+    np.testing.assert_allclose(out, X[:, 2:6, 3:8, :])
+
+
+def test_densify_sparsify_roundtrip(rng):
+    X = (rng.uniform(size=(3, 6)) > 0.5).astype(np.float32) * rng.uniform(
+        size=(3, 6)
+    ).astype(np.float32)
+    docs = Sparsify()(X)
+    back = Densify(6)(docs)
+    np.testing.assert_allclose(back, X, atol=1e-6)
+
+
+def test_gradients_edge_clamped():
+    # A bright right edge must not leak into left-border gradients.
+    import jax.numpy as jnp
+
+    from keystone_tpu.utils.image import clamped_gradients
+
+    g = np.zeros((1, 8, 8), dtype=np.float32)
+    g[0, :, -1] = 10.0
+    gx, _ = clamped_gradients(jnp.asarray(g))
+    np.testing.assert_allclose(np.asarray(gx)[0, :, 0], 0.0)
+    assert np.all(np.asarray(gx)[0, :, -2] > 0)
+
+
+def test_cropper_rejects_out_of_bounds(rng):
+    X = rng.uniform(size=(1, 8, 8, 1)).astype(np.float32)
+    with pytest.raises(ValueError, match="exceeds image"):
+        Cropper(0, 0, 16, 16)(X)
+    with pytest.raises(ValueError, match="invalid crop"):
+        Cropper(-1, 0, 4, 4)
+
+
+def test_densify_rejects_bad_index():
+    with pytest.raises(ValueError, match="out of range"):
+        Densify(4)([{-1: 3.0}])

@@ -158,3 +158,37 @@ def test_alignment_errors(rng):
     Mb = RowMatrix.from_array(rng.normal(size=(24, 3)))
     with pytest.raises(ValueError, match="share n"):
         Ma.atb(Mb)
+
+
+def test_bcd_cached_grams_matches_uncached(rng):
+    A, B, _ = _problem(rng, n=240, d=24)
+    Ma, Mb = RowMatrix.from_array(A), RowMatrix.from_array(B)
+    W_cached, blocks = block_coordinate_descent(
+        Ma, Mb, block_size=8, num_iters=5, lam=0.2, cache_grams=True
+    )
+    W_plain, _ = block_coordinate_descent(
+        Ma, Mb, block_size=8, num_iters=5, lam=0.2, cache_grams=False
+    )
+    from keystone_tpu.linalg.bcd import assemble_blocks
+
+    np.testing.assert_allclose(
+        assemble_blocks(W_cached, blocks),
+        assemble_blocks(W_plain, blocks),
+        rtol=1e-4,
+        atol=1e-4,
+    )
+
+
+def test_bcd_cached_grams_weighted(rng):
+    A, B, _ = _problem(rng)
+    w = rng.uniform(0.5, 2.0, size=A.shape[0]).astype(np.float32)
+    Ma, Mb = RowMatrix.from_array(A), RowMatrix.from_array(B)
+    kwargs = dict(block_size=8, num_iters=3, lam=0.2, row_weights=w)
+    W_c, blocks = block_coordinate_descent(Ma, Mb, cache_grams=True, **kwargs)
+    W_p, _ = block_coordinate_descent(Ma, Mb, cache_grams=False, **kwargs)
+    from keystone_tpu.linalg.bcd import assemble_blocks
+
+    np.testing.assert_allclose(
+        assemble_blocks(W_c, blocks), assemble_blocks(W_p, blocks),
+        rtol=1e-4, atol=1e-4,
+    )
