@@ -13,7 +13,10 @@ Cholesky/QR solves become replicated on-device solves.
 from keystone_tpu.linalg.row_matrix import RowMatrix
 from keystone_tpu.linalg.normal_equations import solve_least_squares_normal
 from keystone_tpu.linalg.tsqr import tsqr_r, solve_least_squares_tsqr
-from keystone_tpu.linalg.bcd import block_coordinate_descent
+from keystone_tpu.linalg.bcd import (
+    block_coordinate_descent,
+    block_coordinate_descent_streamed,
+)
 
 __all__ = [
     "RowMatrix",
@@ -21,4 +24,5 @@ __all__ = [
     "tsqr_r",
     "solve_least_squares_tsqr",
     "block_coordinate_descent",
+    "block_coordinate_descent_streamed",
 ]

@@ -34,15 +34,34 @@ from keystone_tpu.config import config
 from keystone_tpu.linalg.row_matrix import RowMatrix, _precision
 
 
+# -- shared per-shard solver math (single source for every shard_map body) --
+
+
+def _local_weighted(a_b, w_rows, weighted: bool):
+    return a_b * w_rows[:, None] if weighted else a_b
+
+
+def _local_gram_chol(a_b, aw, lam, precision, axis):
+    gram = lax.psum(jnp.matmul(aw.T, a_b, precision=precision), axis)
+    b = a_b.shape[1]
+    return jnp.linalg.cholesky(gram + lam * jnp.eye(b, dtype=gram.dtype))
+
+
+def _local_solve_update(a_b, aw, chol, r, w_b, precision, axis):
+    r_plus = r + jnp.matmul(a_b, w_b, precision=precision)
+    rhs = lax.psum(jnp.matmul(aw.T, r_plus, precision=precision), axis)
+    w_b_new = cho_solve((chol, True), rhs)
+    r_new = r_plus - jnp.matmul(a_b, w_b_new, precision=precision)
+    return r_new, w_b_new
+
+
 @lru_cache(maxsize=None)
 def _gram_chol_fn(mesh: Mesh, axis: str, precision, weighted: bool):
     """Per-block gram + Cholesky, computed once per block (epoch-invariant)."""
 
     def local(a_b, lam, w_rows):
-        aw = a_b * w_rows[:, None] if weighted else a_b
-        gram = lax.psum(jnp.matmul(aw.T, a_b, precision=precision), axis)
-        b = a_b.shape[1]
-        return jnp.linalg.cholesky(gram + lam * jnp.eye(b, dtype=gram.dtype))
+        aw = _local_weighted(a_b, w_rows, weighted)
+        return _local_gram_chol(a_b, aw, lam, precision, axis)
 
     sm = shard_map(
         local,
@@ -61,18 +80,38 @@ def _cached_block_update_fn(mesh: Mesh, axis: str, precision, weighted: bool):
     the dominant 2·n·b² gram FLOPs drop out after the first epoch."""
 
     def local(a_b, chol, r, w_b, w_rows):
-        r_plus = r + jnp.matmul(a_b, w_b, precision=precision)
-        aw = a_b * w_rows[:, None] if weighted else a_b
-        rhs = lax.psum(jnp.matmul(aw.T, r_plus, precision=precision), axis)
-        w_b_new = cho_solve((chol, True), rhs)
-        r_new = r_plus - jnp.matmul(a_b, w_b_new, precision=precision)
-        return r_new, w_b_new
+        aw = _local_weighted(a_b, w_rows, weighted)
+        return _local_solve_update(a_b, aw, chol, r, w_b, precision, axis)
 
     sm = shard_map(
         local,
         mesh=mesh,
         in_specs=(P(axis), P(), P(axis), P(), P(axis)),
         out_specs=(P(axis), P()),
+        check_vma=False,
+    )
+    return jax.jit(sm)
+
+
+@lru_cache(maxsize=None)
+def _first_epoch_update_fn(mesh: Mesh, axis: str, precision, weighted: bool):
+    """Fused block update that also emits the gram Cholesky — the streamed
+    path's first epoch. Fusion keeps a_b in one XLA program so the block is
+    read from HBM once for gram + update instead of twice."""
+
+    def local(a_b, r, w_b, lam, w_rows):
+        aw = _local_weighted(a_b, w_rows, weighted)
+        chol = _local_gram_chol(a_b, aw, lam, precision, axis)
+        r_new, w_b_new = _local_solve_update(
+            a_b, aw, chol, r, w_b, precision, axis
+        )
+        return r_new, w_b_new, chol
+
+    sm = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(), P(), P(axis)),
+        out_specs=(P(axis), P(), P()),
         check_vma=False,
     )
     return jax.jit(sm)
@@ -300,3 +339,100 @@ def _restore_latest(ckpt_dir: str, fingerprint):
 def assemble_blocks(W: List[jax.Array], blocks: List[Tuple[int, int]]) -> jax.Array:
     """Concatenate per-block solutions into the full (d, k) matrix."""
     return jnp.concatenate(W, axis=0)
+
+
+def block_coordinate_descent_streamed(
+    A_host: np.ndarray,
+    B: RowMatrix,
+    block_size: int,
+    num_iters: int,
+    lam: float = 0.0,
+    row_weights: Optional[jax.Array] = None,
+    checkpoint_dir: Optional[str] = None,
+) -> Tuple[List[jax.Array], List[Tuple[int, int]]]:
+    """BCD for feature matrices that exceed HBM: A stays in host RAM and
+    column blocks stream to the device double-buffered — the transfer of
+    block b+1 overlaps the MXU work on block b (SURVEY.md §7 hard part 1:
+    the replacement for Spark's cached-RDD block access).
+
+    The first epoch fuses gram+Cholesky into each block update and keeps
+    the small (b, b) factors resident, so later epochs run the cheap
+    cached update while still streaming only one block of A at a time.
+    """
+    mesh, axis = B.mesh, config.data_axis
+    if A_host.shape[0] != B.n:
+        raise ValueError(
+            f"A rows ({A_host.shape[0]}) must match B rows ({B.n})"
+        )
+    d = A_host.shape[1]
+    k = B.data.shape[1]
+    dtype = jnp.dtype(config.default_dtype)
+    blocks = [(s, min(s + block_size, d)) for s in range(0, d, block_size)]
+    nb = len(blocks)
+    pad = B.padded_rows - A_host.shape[0]
+    sharding = jax.sharding.NamedSharding(mesh, P(axis))
+
+    def put(i: int) -> jax.Array:
+        s, e = blocks[i]
+        block = np.ascontiguousarray(A_host[:, s:e], dtype=dtype)
+        if pad:
+            block = np.pad(block, ((0, pad), (0, 0)))
+        return jax.device_put(block, sharding)
+
+    weighted = row_weights is not None
+    if weighted:
+        w_rows = jnp.asarray(row_weights, dtype=dtype)
+        if w_rows.shape[0] != B.padded_rows:
+            w_rows = jnp.pad(w_rows, (0, B.padded_rows - w_rows.shape[0]))
+    else:
+        w_rows = jnp.zeros((B.padded_rows,), dtype=dtype)
+    w_rows = jax.device_put(w_rows, sharding)
+
+    first = _first_epoch_update_fn(mesh, axis, _precision(), weighted)
+    cached = _cached_block_update_fn(mesh, axis, _precision(), weighted)
+    lam_arr = jnp.asarray(lam, dtype=dtype)
+    throttle = jax.default_backend() == "cpu"
+
+    W = [jnp.zeros((e - s, k), dtype=dtype) for s, e in blocks]
+    chols: List[Optional[jax.Array]] = [None] * nb
+    R = B.data.astype(dtype)
+    start_epoch = 0
+    fingerprint = None
+    if checkpoint_dir is not None:
+        fingerprint = {
+            "rows": B.padded_rows,
+            "n": B.n,
+            "d": d,
+            "k": k,
+            "block_size": block_size,
+            "lam": float(lam),
+            "weighted": weighted,
+            "a_probe": float(A_host[0].sum() + A_host[-1].sum()),
+            "b_probe": float(jnp.sum(B.data[0]) + jnp.sum(B.data[-1])),
+        }
+        restored = _restore_latest(checkpoint_dir, fingerprint)
+        if restored is not None:
+            start_epoch, W_np, R_np = restored
+            W = [jnp.asarray(w) for w in W_np]
+            R = jax.device_put(jnp.asarray(R_np), sharding)
+            # Cholesky factors rebuild lazily: the `first` update at the
+            # resumed epoch recomputes them as part of a normal update.
+    if start_epoch >= num_iters:
+        return W, blocks
+    next_buf = put(0)
+    for epoch in range(start_epoch, num_iters):
+        for i in range(nb):
+            cur = next_buf
+            # Prefetch the next block while this one computes (double
+            # buffering): H2D DMA overlaps the MXU work.
+            if epoch + 1 < num_iters or i + 1 < nb:
+                next_buf = put((i + 1) % nb)
+            if chols[i] is None:
+                R, W[i], chols[i] = first(cur, R, W[i], lam_arr, w_rows)
+            else:
+                R, W[i] = cached(cur, chols[i], R, W[i], w_rows)
+            if throttle:
+                R.block_until_ready()
+        if checkpoint_dir is not None:
+            _save_epoch(checkpoint_dir, epoch + 1, W, R, fingerprint)
+    return W, blocks

@@ -91,8 +91,8 @@ class DaisyExtractor(Transformer):
             rad = self.radius * r / self.rings
             for p in range(self.ring_points):
                 ang = 2 * np.pi * p / self.ring_points
-                oy = np.clip((ky + rad * np.sin(ang)).astype(int), 0, h - 1)
-                ox = np.clip((kx + rad * np.cos(ang)).astype(int), 0, w - 1)
+                oy = np.clip(np.round(ky + rad * np.sin(ang)).astype(int), 0, h - 1)
+                ox = np.clip(np.round(kx + rad * np.cos(ang)).astype(int), 0, w - 1)
                 samples.append(scales[r][:, oy, ox, :])
         desc = jnp.stack(samples, axis=2)  # (n, K, points, bins)
         norm = jnp.linalg.norm(desc, axis=-1, keepdims=True)

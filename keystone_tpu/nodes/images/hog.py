@@ -43,6 +43,12 @@ class HogExtractor(Transformer):
         )  # (n, ch, cw, bins)
         # 2x2-cell block normalization with clipping (L2-hys).
         n, ch, cw, nb = cells.shape
+        if ch < 2 or cw < 2:
+            raise ValueError(
+                f"image too small for HOG: {X.shape[1]}x{X.shape[2]} gives a "
+                f"{ch}x{cw} cell grid (need >= 2x2 at cell_size="
+                f"{self.cell_size})"
+            )
         blocks = jnp.concatenate(
             [
                 cells[:, :-1, :-1],

@@ -64,6 +64,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         lam: float = 0.0,
         fit_intercept: bool = True,
         checkpoint_dir: Optional[str] = None,
+        stream: Optional[bool] = None,
     ):
         self.block_size = block_size
         self.num_iters = num_iters
@@ -71,11 +72,64 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         self.fit_intercept = fit_intercept
         # Epoch-boundary solver checkpointing (orbax); resumes on refit.
         self.checkpoint_dir = checkpoint_dir
+        # Host-streamed feature blocks (double-buffered H2D) for feature
+        # matrices that exceed HBM; None = auto by size.
+        self.stream = stream
 
     def _weights(self, Y: jnp.ndarray) -> Optional[jax.Array]:
         return None
 
     def fit(self, data, labels) -> BlockLinearMapper:
+        import numpy as np
+
+        from keystone_tpu.config import config
+        from keystone_tpu.linalg import block_coordinate_descent_streamed
+
+        stream = self.stream
+        itemsize = jnp.dtype(config.default_dtype).itemsize
+        if stream is None:
+            a_bytes = int(np.prod(np.shape(data))) * itemsize
+            stream = a_bytes > config.hbm_budget_bytes // 2
+
+        if stream:
+            # Features stay in host RAM; center there, stream blocks down.
+            X_host = np.array(data, dtype=config.default_dtype, copy=True)
+            Y = jnp.asarray(labels)
+            weights = self._weights(Y)
+            x_mean = y_mean = None
+            if self.fit_intercept:
+                # Same math and guard as the device path below (weighted
+                # means with a wsum floor), computed host-side.
+                if weights is None:
+                    x_mean = X_host.mean(axis=0, dtype=X_host.dtype)
+                    y_mean = Y.mean(axis=0)
+                else:
+                    w_np = np.asarray(weights, dtype=X_host.dtype)
+                    wsum = max(float(w_np.sum()), 1e-12)
+                    x_mean = (w_np[:, None] * X_host).sum(0) / wsum
+                    y_mean = (weights[:, None] * Y).sum(0) / jnp.maximum(
+                        weights.sum(), 1e-12
+                    )
+                X_host -= x_mean.astype(X_host.dtype)
+                Y = Y - y_mean
+            B = RowMatrix.from_array(Y)
+            W_blocks, blocks = block_coordinate_descent_streamed(
+                X_host,
+                B,
+                block_size=self.block_size,
+                num_iters=self.num_iters,
+                lam=self.lam,
+                row_weights=weights,
+                checkpoint_dir=self.checkpoint_dir,
+            )
+            b = None
+            if self.fit_intercept:
+                W = jnp.concatenate(W_blocks, axis=0)
+                b = jnp.asarray(y_mean) - jnp.asarray(
+                    x_mean, dtype=W.dtype
+                ) @ W
+            return BlockLinearMapper(W_blocks, blocks, b)
+
         X = jnp.asarray(data)
         Y = jnp.asarray(labels)
         weights = self._weights(Y)
@@ -127,8 +181,12 @@ class BlockWeightedLeastSquaresEstimator(BlockLeastSquaresEstimator):
         lam: float = 0.0,
         mixture_weight: float = 0.5,
         fit_intercept: bool = True,
+        checkpoint_dir: Optional[str] = None,
+        stream: Optional[bool] = None,
     ):
-        super().__init__(block_size, num_iters, lam, fit_intercept)
+        super().__init__(
+            block_size, num_iters, lam, fit_intercept, checkpoint_dir, stream
+        )
         self.mixture_weight = mixture_weight
 
     def _weights(self, Y: jnp.ndarray) -> Optional[jax.Array]:

@@ -87,3 +87,9 @@ def test_cropper_rejects_out_of_bounds(rng):
 def test_densify_rejects_bad_index():
     with pytest.raises(ValueError, match="out of range"):
         Densify(4)([{-1: 3.0}])
+
+
+def test_hog_rejects_tiny_images(rng):
+    X = rng.uniform(size=(1, 12, 12, 1)).astype(np.float32)
+    with pytest.raises(ValueError, match="too small for HOG"):
+        HogExtractor(cell_size=8)(X)

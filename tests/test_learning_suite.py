@@ -278,3 +278,23 @@ def test_block_weighted_matches_weighted_ridge_oracle(rng):
     b = ym - xm @ W
     np.testing.assert_allclose(np.asarray(model.W), W, rtol=1e-3, atol=1e-3)
     np.testing.assert_allclose(np.asarray(model.b), b, rtol=1e-3, atol=1e-3)
+
+
+def test_block_ls_streaming_matches_device_path(rng):
+    X = rng.normal(size=(300, 24)).astype(np.float32) + 0.5
+    Y = rng.normal(size=(300, 4)).astype(np.float32)
+    dev = BlockLeastSquaresEstimator(block_size=8, num_iters=3, lam=0.1, stream=False).fit(X, Y)
+    str_ = BlockLeastSquaresEstimator(block_size=8, num_iters=3, lam=0.1, stream=True).fit(X, Y)
+    np.testing.assert_allclose(np.asarray(str_.W), np.asarray(dev.W), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(str_.b), np.asarray(dev.b), rtol=1e-4, atol=1e-4)
+
+
+def test_block_weighted_streaming_matches_device_path(rng):
+    X = rng.normal(size=(240, 16)).astype(np.float32)
+    y = (rng.uniform(size=240) < 0.2).astype(int)
+    Y = (2 * np.eye(2)[y] - 1).astype(np.float32)
+    kw = dict(block_size=8, num_iters=2, lam=0.2, mixture_weight=1.0)
+    dev = BlockWeightedLeastSquaresEstimator(stream=False, **kw).fit(X, Y)
+    str_ = BlockWeightedLeastSquaresEstimator(stream=True, **kw).fit(X, Y)
+    np.testing.assert_allclose(np.asarray(str_.W), np.asarray(dev.W), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(str_.b), np.asarray(dev.b), rtol=1e-4, atol=1e-4)
