@@ -11,7 +11,10 @@ Cholesky/QR solves become replicated on-device solves.
 """
 
 from keystone_tpu.linalg.row_matrix import RowMatrix
-from keystone_tpu.linalg.normal_equations import solve_least_squares_normal
+from keystone_tpu.linalg.normal_equations import (
+    solve_least_squares_chunked,
+    solve_least_squares_normal,
+)
 from keystone_tpu.linalg.tsqr import tsqr_r, solve_least_squares_tsqr
 from keystone_tpu.linalg.bcd import (
     block_coordinate_descent,
@@ -21,6 +24,7 @@ from keystone_tpu.linalg.bcd import (
 __all__ = [
     "RowMatrix",
     "solve_least_squares_normal",
+    "solve_least_squares_chunked",
     "tsqr_r",
     "solve_least_squares_tsqr",
     "block_coordinate_descent",

@@ -204,31 +204,16 @@ def block_coordinate_descent(
 
     W = [jnp.zeros((e - s, k), dtype=dtype) for s, e in blocks]
     R = B.data.astype(dtype)
-    start_epoch = 0
+    sharding = jax.sharding.NamedSharding(mesh, P(axis))
     fingerprint = None
     if checkpoint_dir is not None:
-        # Bind checkpoints to this exact problem: shapes, hyperparameters,
-        # and a cheap content probe of A and B. A stale dir from a different
-        # solve is ignored (fresh start) instead of silently resuming.
-        fingerprint = {
-            "rows": A.padded_rows,
-            "n": A.n,
-            "d": d,
-            "k": k,
-            "block_size": block_size,
-            "lam": float(lam),
-            "weighted": weighted,
-            "a_probe": float(jnp.sum(A.data[0]) + jnp.sum(A.data[-1])),
-            "b_probe": float(jnp.sum(B.data[0]) + jnp.sum(B.data[-1])),
-        }
-        restored = _restore_latest(checkpoint_dir, fingerprint)
-        if restored is not None:
-            start_epoch, W_np, R_np = restored
-            W = [jnp.asarray(w) for w in W_np]
-            R = jax.device_put(
-                jnp.asarray(R_np),
-                jax.sharding.NamedSharding(mesh, P(axis)),
-            )
+        fingerprint = _make_fingerprint(
+            B, d, block_size, lam, weighted,
+            a_probe=float(jnp.sum(A.data[0]) + jnp.sum(A.data[A.n - 1])),
+        )
+    start_epoch, W, R = _resume_or_default(
+        checkpoint_dir, fingerprint, W, R, sharding
+    )
     # Slice each column block once, not once per epoch: the blocks partition
     # A (one extra A-sized copy in aggregate) and every epoch then reads them
     # without re-materializing slices in the hot loop. When feature blocks
@@ -272,6 +257,38 @@ def block_coordinate_descent(
         if checkpoint_dir is not None:
             _save_epoch(checkpoint_dir, epoch + 1, W, R, fingerprint)
     return W, blocks
+
+
+def _make_fingerprint(
+    B: RowMatrix, d: int, block_size: int, lam, weighted: bool, a_probe: float
+) -> dict:
+    """Problem identity for checkpoint binding. Probes use LOGICAL rows
+    (first and last real row), so the device-resident and host-streamed
+    paths produce identical fingerprints and can resume each other."""
+    return {
+        "rows": B.padded_rows,
+        "n": B.n,
+        "d": d,
+        "k": B.data.shape[1],
+        "block_size": block_size,
+        "lam": float(lam),
+        "weighted": weighted,
+        "a_probe": a_probe,
+        "b_probe": float(jnp.sum(B.data[0]) + jnp.sum(B.data[B.n - 1])),
+    }
+
+
+def _resume_or_default(checkpoint_dir, fingerprint, W, R, sharding):
+    """Restore (epoch, W, R) from a matching checkpoint, else the defaults."""
+    if checkpoint_dir is None:
+        return 0, W, R
+    restored = _restore_latest(checkpoint_dir, fingerprint)
+    if restored is None:
+        return 0, W, R
+    epoch, W_np, R_np = restored
+    W = [jnp.asarray(w) for w in W_np]
+    R = jax.device_put(jnp.asarray(R_np), sharding)
+    return epoch, W, R
 
 
 def _save_epoch(ckpt_dir: str, epoch: int, W, R, fingerprint) -> None:
@@ -396,27 +413,17 @@ def block_coordinate_descent_streamed(
     W = [jnp.zeros((e - s, k), dtype=dtype) for s, e in blocks]
     chols: List[Optional[jax.Array]] = [None] * nb
     R = B.data.astype(dtype)
-    start_epoch = 0
     fingerprint = None
     if checkpoint_dir is not None:
-        fingerprint = {
-            "rows": B.padded_rows,
-            "n": B.n,
-            "d": d,
-            "k": k,
-            "block_size": block_size,
-            "lam": float(lam),
-            "weighted": weighted,
-            "a_probe": float(A_host[0].sum() + A_host[-1].sum()),
-            "b_probe": float(jnp.sum(B.data[0]) + jnp.sum(B.data[-1])),
-        }
-        restored = _restore_latest(checkpoint_dir, fingerprint)
-        if restored is not None:
-            start_epoch, W_np, R_np = restored
-            W = [jnp.asarray(w) for w in W_np]
-            R = jax.device_put(jnp.asarray(R_np), sharding)
-            # Cholesky factors rebuild lazily: the `first` update at the
-            # resumed epoch recomputes them as part of a normal update.
+        fingerprint = _make_fingerprint(
+            B, d, block_size, lam, weighted,
+            a_probe=float(A_host[0].sum() + A_host[-1].sum()),
+        )
+    # On resume, Cholesky factors rebuild lazily: the `first` update at the
+    # resumed epoch recomputes them as part of a normal update.
+    start_epoch, W, R = _resume_or_default(
+        checkpoint_dir, fingerprint, W, R, sharding
+    )
     if start_epoch >= num_iters:
         return W, blocks
     next_buf = put(0)

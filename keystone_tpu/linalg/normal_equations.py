@@ -43,3 +43,32 @@ def solve_least_squares_normal(
     return _chol_solve(
         gram, atb, jnp.asarray(lam, dtype=gram.dtype), refine_steps
     )
+
+
+def solve_least_squares_chunked(
+    batches, lam: float = 0.0, refine_steps: int = 1
+) -> jax.Array:
+    """Normal-equation solve over an out-of-core row stream.
+
+    ``batches`` yields (X_chunk, Y_chunk) row batches (see
+    loaders.stream.BatchIterator); AᵀA and AᵀB accumulate chunk by chunk —
+    the same additive decomposition the reference exploits with
+    ``treeAggregate`` over RDD partitions, so n is bounded only by the
+    source, not by host or device memory. Each chunk's gram rides the
+    mesh's psum; the accumulator stays replicated on-device.
+    """
+    gram = None
+    atb = None
+    for X_chunk, Y_chunk in batches:
+        if Y_chunk is None:
+            raise ValueError("chunked solve needs labeled batches")
+        A = RowMatrix.from_array(X_chunk)
+        B = RowMatrix.from_array(Y_chunk)
+        g, ab = A.gram_and_atb(B)  # fused: one read of the chunk
+        gram = g if gram is None else gram + g
+        atb = ab if atb is None else atb + ab
+    if gram is None:
+        raise ValueError("empty batch stream")
+    return _chol_solve(
+        gram, atb, jnp.asarray(lam, dtype=gram.dtype), refine_steps
+    )

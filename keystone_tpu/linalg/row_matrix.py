@@ -51,6 +51,20 @@ def _atb_fn(mesh: Mesh, axis: str, precision):
 
 
 @lru_cache(maxsize=None)
+def _gram_and_atb_fn(mesh: Mesh, axis: str, precision):
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=(P(), P()))
+    def gram_and_atb(a, b):
+        # One program: a is read from HBM once for both reductions.
+        return (
+            lax.psum(jnp.matmul(a.T, a, precision=precision), axis),
+            lax.psum(jnp.matmul(a.T, b, precision=precision), axis),
+        )
+
+    return gram_and_atb
+
+
+@lru_cache(maxsize=None)
 def _matmul_fn(mesh: Mesh, axis: str, precision):
     @jax.jit
     @partial(shard_map, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(axis))
@@ -120,6 +134,13 @@ class RowMatrix:
         """AᵀB for a row-aligned B."""
         self._check_aligned(other)
         return _atb_fn(self.mesh, config.data_axis, _precision())(
+            self.data, other.data
+        )
+
+    def gram_and_atb(self, other: "RowMatrix"):
+        """(AᵀA, AᵀB) in one fused program — A is read once."""
+        self._check_aligned(other)
+        return _gram_and_atb_fn(self.mesh, config.data_axis, _precision())(
             self.data, other.data
         )
 

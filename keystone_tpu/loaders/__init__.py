@@ -1,5 +1,6 @@
 from keystone_tpu.loaders.labeled_data import LabeledData
 from keystone_tpu.loaders.csv_loader import CsvDataLoader
 from keystone_tpu.loaders.mnist import MnistLoader
+from keystone_tpu.loaders.stream import BatchIterator
 
-__all__ = ["LabeledData", "CsvDataLoader", "MnistLoader"]
+__all__ = ["LabeledData", "CsvDataLoader", "MnistLoader", "BatchIterator"]

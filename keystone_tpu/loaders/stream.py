@@ -1,0 +1,97 @@
+"""Out-of-core row-batch ingestion — the data-feeder seam.
+
+The reference's north star keeps Spark as the data loader in front of the
+TPU compute (BASELINE.json). This module is that seam: any source that can
+yield (features, labels) row batches — a CSV reader, a Spark/Beam job
+writing a socket or files, a tf.data/grain pipeline — plugs in as a
+``BatchIterator``, and the chunk-accumulating solvers (see
+linalg.normal_equations.solve_least_squares_chunked) train on datasets
+whose row count exceeds host memory.
+
+Ref: loaders/* running on Spark RDD partitions (SURVEY.md §2.9, §5
+distributed-backend row) [unverified].
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Iterator, Optional, Tuple
+
+import numpy as np
+
+from keystone_tpu.config import config
+
+Batch = Tuple[np.ndarray, Optional[np.ndarray]]
+
+
+class BatchIterator:
+    """Re-iterable source of (features, labels-or-None) row batches."""
+
+    def __init__(self, factory: Callable[[], Iterable[Batch]]):
+        self._factory = factory
+
+    def __iter__(self) -> Iterator[Batch]:
+        return iter(self._factory())
+
+    @staticmethod
+    def from_arrays(X, y=None, batch_rows: int = 4096) -> "BatchIterator":
+        X = np.asarray(X)
+        y_arr = None if y is None else np.asarray(y)
+
+        def gen():
+            for s in range(0, X.shape[0], batch_rows):
+                e = min(s + batch_rows, X.shape[0])
+                yield X[s:e], None if y_arr is None else y_arr[s:e]
+
+        return BatchIterator(gen)
+
+    @staticmethod
+    def from_csv(
+        path: str,
+        label_col: Optional[int] = 0,
+        batch_rows: int = 4096,
+        label_dtype=np.int32,
+    ) -> "BatchIterator":
+        """Stream a CSV in row chunks without loading it whole.
+
+        ``label_dtype`` defaults to int32 (class labels); pass a float
+        dtype for regression targets — int truncation of real-valued
+        targets would silently corrupt the solve.
+        """
+
+        def gen():
+            rows, labels = [], []
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    vals = [float(v) for v in line.split(",")]
+                    if label_col is not None:
+                        labels.append(vals.pop(label_col))
+                    rows.append(vals)
+                    if len(rows) == batch_rows:
+                        yield _emit(rows, labels, label_col)
+                        rows, labels = [], []
+            if rows:
+                yield _emit(rows, labels, label_col)
+
+        def _emit(rows, labels, label_col):
+            X = np.asarray(rows, dtype=config.default_dtype)
+            y = (
+                None
+                if label_col is None
+                else np.asarray(labels, dtype=label_dtype)
+            )
+            return X, y
+
+        return BatchIterator(gen)
+
+    def map_batches(self, fn: Callable[[np.ndarray], np.ndarray]) -> "BatchIterator":
+        """Apply a featurization function to every feature batch (e.g. a
+        fitted pipeline's transformer chain)."""
+
+        def gen():
+            for X, y in self:
+                yield fn(X), y
+
+        return BatchIterator(gen)

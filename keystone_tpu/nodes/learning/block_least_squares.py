@@ -20,7 +20,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from keystone_tpu.linalg import RowMatrix, block_coordinate_descent
+from keystone_tpu.config import config
+from keystone_tpu.linalg import (
+    RowMatrix,
+    block_coordinate_descent,
+    block_coordinate_descent_streamed,
+)
 from keystone_tpu.workflow import LabelEstimator, Transformer
 
 
@@ -80,11 +85,6 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         return None
 
     def fit(self, data, labels) -> BlockLinearMapper:
-        import numpy as np
-
-        from keystone_tpu.config import config
-        from keystone_tpu.linalg import block_coordinate_descent_streamed
-
         stream = self.stream
         itemsize = jnp.dtype(config.default_dtype).itemsize
         if stream is None:
@@ -106,7 +106,9 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                 else:
                     w_np = np.asarray(weights, dtype=X_host.dtype)
                     wsum = max(float(w_np.sum()), 1e-12)
-                    x_mean = (w_np[:, None] * X_host).sum(0) / wsum
+                    # matvec, not (w[:,None] * X).sum(0): no X-sized temporary
+                    # on the path that exists because X barely fits in RAM.
+                    x_mean = (w_np @ X_host) / wsum
                     y_mean = (weights[:, None] * Y).sum(0) / jnp.maximum(
                         weights.sum(), 1e-12
                     )
