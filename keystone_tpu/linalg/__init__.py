@@ -20,6 +20,7 @@ from keystone_tpu.linalg.bcd import (
     block_coordinate_descent,
     block_coordinate_descent_streamed,
 )
+from keystone_tpu.linalg.ring_bcd import block_coordinate_descent_ring
 
 __all__ = [
     "RowMatrix",
@@ -29,4 +30,5 @@ __all__ = [
     "solve_least_squares_tsqr",
     "block_coordinate_descent",
     "block_coordinate_descent_streamed",
+    "block_coordinate_descent_ring",
 ]

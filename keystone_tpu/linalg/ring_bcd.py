@@ -1,0 +1,136 @@
+"""Ring-parallel block coordinate descent — d-axis model parallelism.
+
+The reference has no sequence/attention machinery; SURVEY.md §5 identifies
+the feature dimension (64k–256k, ≫ single-node memory) as this workload's
+"long axis" and prescribes exactly this design: shard the d-axis across
+the ICI mesh into per-chip feature blocks and pass residuals around a ring
+— the collective-matmul / ring-attention scheduling idea applied to
+blocked least squares (PAPERS.md arXiv:2112.09017 family).
+
+Layout and schedule:
+
+- chip c owns feature block A_c (n × d/P columns, rows replicated) and its
+  weights W_c — the model axis is sharded, nothing is all-gathered;
+- B's columns split into P chunks; chunk c starts on chip c as its
+  residual R_c (different B columns are independent least-squares
+  problems sharing A);
+- each step, every chip runs one BCD block update of ITS block against the
+  residual chunk it currently holds, then `ppermute`s the chunk to the
+  next chip. After P steps each chunk has visited every block once (one
+  full Gauss-Seidel sweep, block order rotated per chunk — an equally
+  valid sweep order), and all P chips were busy every step.
+
+Per-chip per-epoch communication is exactly n·k/P · P = n·k values over
+ICI neighbor links — no psum trees, no gathers; per-chip grams are local
+(columns live on one chip) and their Cholesky factors are computed once.
+Compare the data-parallel path (bcd.py): that shards n and psums b×b
+grams; this shards d and rings n×k/P residuals — the right trade when d
+dwarfs n·k, i.e. the reference's high-dimensional featurized regime.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.scipy.linalg import cho_solve
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from keystone_tpu.config import config
+from keystone_tpu.linalg.row_matrix import _precision
+
+
+@lru_cache(maxsize=None)
+def _ring_solve_fn(mesh: Mesh, axis: str, precision):
+    nshards = mesh.shape[axis]
+
+    # num_steps is a dynamic operand (fori_loop takes traced bounds, lowering
+    # to while_loop), so different iteration counts share one compilation.
+    def local(a_loc, b_chunk, lam, num_steps):
+        # a_loc: (n, d_loc) — this chip's feature block (rows replicated)
+        # b_chunk: (n, kc) — the residual chunk starting on this chip
+        d_loc = a_loc.shape[1]
+        kc = b_chunk.shape[1]
+        gram = jnp.matmul(a_loc.T, a_loc, precision=precision)
+        chol = jnp.linalg.cholesky(
+            gram + lam * jnp.eye(d_loc, dtype=gram.dtype)
+        )
+        idx = lax.axis_index(axis)
+        w0 = jnp.zeros((d_loc, nshards * kc), dtype=a_loc.dtype)
+
+        def step(s, carry):
+            r, w = carry
+            # Which chunk this chip holds at step s (chunks move +1/step).
+            j = jnp.mod(idx - s, nshards)
+            w_old = lax.dynamic_slice(w, (0, j * kc), (d_loc, kc))
+            r_plus = r + jnp.matmul(a_loc, w_old, precision=precision)
+            rhs = jnp.matmul(a_loc.T, r_plus, precision=precision)
+            w_new = cho_solve((chol, True), rhs)
+            r_new = r_plus - jnp.matmul(a_loc, w_new, precision=precision)
+            w = lax.dynamic_update_slice(w, w_new, (0, j * kc))
+            r_next = lax.ppermute(
+                r_new, axis, [(p, (p + 1) % nshards) for p in range(nshards)]
+            )
+            return r_next, w
+
+        _r, w = lax.fori_loop(0, num_steps, step, (b_chunk, w0))
+        return w  # (d_loc, k) — concatenates to the full W over the axis
+
+    sm = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis), P(), P()),
+        out_specs=P(axis, None),
+        check_vma=False,
+    )
+    return jax.jit(sm)
+
+
+def block_coordinate_descent_ring(
+    A,
+    B,
+    num_iters: int,
+    lam: float = 0.0,
+    mesh: Mesh | None = None,
+) -> jax.Array:
+    """Solve min_W ||A W − B||² + lam ||W||² with d-sharded ring BCD.
+
+    A: (n, d), B: (n, k) — host or device arrays; columns of A and B are
+    padded to multiples of the mesh size and sharded across it. Returns the
+    full (d, k) solution (model-sharded on device; slice is unpadded).
+    """
+    from keystone_tpu.utils.mesh import default_mesh
+
+    mesh = mesh or default_mesh()
+    axis = mesh.axis_names[0]
+    nshards = mesh.shape[axis]
+    dtype = jnp.dtype(config.default_dtype)
+    A = np.asarray(A, dtype=dtype)
+    B = np.asarray(B, dtype=dtype)
+    n, d = A.shape
+    k = B.shape[1]
+    pad_d = (-d) % nshards
+    pad_k = (-k) % nshards
+    if pad_d and lam <= 0.0:
+        raise ValueError(
+            f"d={d} is not a multiple of the {nshards}-chip mesh; the "
+            "zero-padded feature columns make the per-chip gram singular — "
+            "pass lam > 0 or pad the features yourself"
+        )
+    if pad_d:
+        A = np.pad(A, ((0, 0), (0, pad_d)))
+    if pad_k:
+        B = np.pad(B, ((0, 0), (0, pad_k)))
+    A_dev = jax.device_put(A, NamedSharding(mesh, P(None, axis)))
+    B_dev = jax.device_put(B, NamedSharding(mesh, P(None, axis)))
+    solve = _ring_solve_fn(mesh, axis, _precision())
+    W = solve(
+        A_dev,
+        B_dev,
+        jnp.asarray(lam, dtype=dtype),
+        jnp.int32(num_iters * nshards),
+    )
+    return W[:d, :k]
