@@ -44,46 +44,54 @@ from keystone_tpu.linalg.row_matrix import _precision
 
 
 @lru_cache(maxsize=None)
-def _ring_solve_fn(mesh: Mesh, axis: str, precision):
-    nshards = mesh.shape[axis]
+def _ring_solve_fn(mesh: Mesh, model_axis: str, data_axis, precision):
+    """2-D-capable ring solver: columns sharded over ``model_axis`` (the
+    ring); rows optionally sharded over ``data_axis`` (grams/gradients then
+    psum across it — the dp×mp composition)."""
+    nshards = mesh.shape[model_axis]
+
+    def maybe_psum(x):
+        return lax.psum(x, data_axis) if data_axis is not None else x
 
     # num_steps is a dynamic operand (fori_loop takes traced bounds, lowering
     # to while_loop), so different iteration counts share one compilation.
     def local(a_loc, b_chunk, lam, num_steps):
-        # a_loc: (n, d_loc) — this chip's feature block (rows replicated)
-        # b_chunk: (n, kc) — the residual chunk starting on this chip
+        # a_loc: (n_loc, d_loc) — this chip's (row shard ×) feature block
+        # b_chunk: (n_loc, kc) — its shard of the chunk starting on this ring slot
         d_loc = a_loc.shape[1]
         kc = b_chunk.shape[1]
-        gram = jnp.matmul(a_loc.T, a_loc, precision=precision)
+        gram = maybe_psum(jnp.matmul(a_loc.T, a_loc, precision=precision))
         chol = jnp.linalg.cholesky(
             gram + lam * jnp.eye(d_loc, dtype=gram.dtype)
         )
-        idx = lax.axis_index(axis)
+        idx = lax.axis_index(model_axis)
         w0 = jnp.zeros((d_loc, nshards * kc), dtype=a_loc.dtype)
 
         def step(s, carry):
             r, w = carry
-            # Which chunk this chip holds at step s (chunks move +1/step).
+            # Which chunk this ring slot holds at step s (chunks move +1/step).
             j = jnp.mod(idx - s, nshards)
             w_old = lax.dynamic_slice(w, (0, j * kc), (d_loc, kc))
             r_plus = r + jnp.matmul(a_loc, w_old, precision=precision)
-            rhs = jnp.matmul(a_loc.T, r_plus, precision=precision)
+            rhs = maybe_psum(jnp.matmul(a_loc.T, r_plus, precision=precision))
             w_new = cho_solve((chol, True), rhs)
             r_new = r_plus - jnp.matmul(a_loc, w_new, precision=precision)
             w = lax.dynamic_update_slice(w, w_new, (0, j * kc))
             r_next = lax.ppermute(
-                r_new, axis, [(p, (p + 1) % nshards) for p in range(nshards)]
+                r_new,
+                model_axis,
+                [(p, (p + 1) % nshards) for p in range(nshards)],
             )
             return r_next, w
 
         _r, w = lax.fori_loop(0, num_steps, step, (b_chunk, w0))
-        return w  # (d_loc, k) — concatenates to the full W over the axis
+        return w  # (d_loc, k) — concatenates to the full W over model axis
 
     sm = shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(None, axis), P(None, axis), P(), P()),
-        out_specs=P(axis, None),
+        in_specs=(P(data_axis, model_axis), P(data_axis, model_axis), P(), P()),
+        out_specs=P(model_axis, None),
         check_vma=False,
     )
     return jax.jit(sm)
@@ -99,13 +107,24 @@ def block_coordinate_descent_ring(
     """Solve min_W ||A W − B||² + lam ||W||² with d-sharded ring BCD.
 
     A: (n, d), B: (n, k) — host or device arrays; columns of A and B are
-    padded to multiples of the mesh size and sharded across it. Returns the
-    full (d, k) solution (model-sharded on device; slice is unpadded).
+    padded to multiples of the ring size and sharded across it. Returns
+    the full (d, k) solution (model-sharded on device; slice is unpadded).
+
+    Mesh shapes: a 1-D mesh rings over its only axis with rows replicated;
+    a 2-D mesh named (data_axis, model_axis) additionally shards rows over
+    the data axis and psums grams/gradients across it — data and model
+    parallelism composed, the full pod-slice layout.
     """
     from keystone_tpu.utils.mesh import default_mesh
 
     mesh = mesh or default_mesh()
-    axis = mesh.axis_names[0]
+    if len(mesh.axis_names) == 1:
+        axis = mesh.axis_names[0]
+        data_axis = None
+        row_shards = 1
+    else:
+        data_axis, axis = mesh.axis_names[:2]
+        row_shards = mesh.shape[data_axis]
     nshards = mesh.shape[axis]
     dtype = jnp.dtype(config.default_dtype)
     A = np.asarray(A, dtype=dtype)
@@ -114,9 +133,10 @@ def block_coordinate_descent_ring(
     k = B.shape[1]
     pad_d = (-d) % nshards
     pad_k = (-k) % nshards
+    pad_n = (-n) % row_shards
     if pad_d and lam <= 0.0:
         raise ValueError(
-            f"d={d} is not a multiple of the {nshards}-chip mesh; the "
+            f"d={d} is not a multiple of the {nshards}-chip ring; the "
             "zero-padded feature columns make the per-chip gram singular — "
             "pass lam > 0 or pad the features yourself"
         )
@@ -124,9 +144,12 @@ def block_coordinate_descent_ring(
         A = np.pad(A, ((0, 0), (0, pad_d)))
     if pad_k:
         B = np.pad(B, ((0, 0), (0, pad_k)))
-    A_dev = jax.device_put(A, NamedSharding(mesh, P(None, axis)))
-    B_dev = jax.device_put(B, NamedSharding(mesh, P(None, axis)))
-    solve = _ring_solve_fn(mesh, axis, _precision())
+    if pad_n:
+        A = np.pad(A, ((0, pad_n), (0, 0)))
+        B = np.pad(B, ((0, pad_n), (0, 0)))
+    A_dev = jax.device_put(A, NamedSharding(mesh, P(data_axis, axis)))
+    B_dev = jax.device_put(B, NamedSharding(mesh, P(data_axis, axis)))
+    solve = _ring_solve_fn(mesh, axis, data_axis, _precision())
     W = solve(
         A_dev,
         B_dev,

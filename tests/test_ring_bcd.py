@@ -64,3 +64,38 @@ def test_ring_bcd_rejects_padded_d_without_ridge(rng):
     np.testing.assert_allclose(
         W, _ridge_oracle(A, B, 0.5), rtol=5e-2, atol=5e-2
     )
+
+
+def test_ring_bcd_2d_mesh_dp_times_mp(rng):
+    """Rows sharded over 'data', columns ringed over 'model' — composed
+    parallelism on a 4x2 and a 2x4 mesh must both match the oracle."""
+    import jax
+    from jax.sharding import Mesh
+
+    n, d, k = 384, 32, 4
+    A = rng.normal(size=(n, d)).astype(np.float32)
+    B = rng.normal(size=(n, k)).astype(np.float32)
+    lam = 0.2
+    oracle = _ridge_oracle(A, B, lam)
+    devices = np.asarray(jax.devices()[:8])
+    for shape in [(4, 2), (2, 4)]:
+        mesh = Mesh(devices.reshape(shape), ("data", "model"))
+        W = np.asarray(
+            block_coordinate_descent_ring(A, B, num_iters=30, lam=lam, mesh=mesh)
+        )
+        np.testing.assert_allclose(W, oracle, rtol=2e-2, atol=2e-2)
+
+
+def test_ring_bcd_2d_mesh_row_padding(rng):
+    import jax
+    from jax.sharding import Mesh
+
+    # n=250 not divisible by 4 data shards: zero row padding must be inert.
+    n, d, k = 250, 16, 2
+    A = rng.normal(size=(n, d)).astype(np.float32)
+    B = rng.normal(size=(n, k)).astype(np.float32)
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(4, 2), ("data", "model"))
+    W = np.asarray(
+        block_coordinate_descent_ring(A, B, num_iters=25, lam=0.3, mesh=mesh)
+    )
+    np.testing.assert_allclose(W, _ridge_oracle(A, B, 0.3), rtol=2e-2, atol=2e-2)
