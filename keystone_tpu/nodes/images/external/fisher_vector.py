@@ -28,20 +28,18 @@ from keystone_tpu.workflow import Estimator, Transformer
 @partial(jax.jit, static_argnames=())
 def _fv_tpu(X, w, mu, var):
     """X: (B, m, d) descriptor sets → (B, 2·k·d) raw Fisher vectors."""
+    from keystone_tpu.ops.fv_common import fv_constants
+
     B, m, d = X.shape
     k = w.shape[0]
-    # Clamp like the native backend: a component EM starved to weight 0 must
-    # produce a zero block, not log(0)/1/sqrt(0) NaNs.
-    w = jnp.maximum(w, 1e-12)
-    inv = 1.0 / var  # (k, d)
+    w, inv, logw_norm, cm, cv = fv_constants(w, mu, var, m)
     # log N(x | mu_j, var_j) + log w_j, gemm-shaped.
     quad = (
         jnp.einsum("bmd,kd->bmk", X * X, inv)
         - 2.0 * jnp.einsum("bmd,kd->bmk", X, mu * inv)
         + jnp.sum(mu * mu * inv, axis=1)
     )
-    log_norm = -0.5 * (d * jnp.log(2 * jnp.pi) + jnp.sum(jnp.log(var), axis=1))
-    log_r = jnp.log(w) + log_norm - 0.5 * quad  # (B, m, k)
+    log_r = logw_norm - 0.5 * quad  # (B, m, k)
     r = jax.nn.softmax(log_r, axis=-1)
     sigma = jnp.sqrt(var)  # (k, d)
     # gmu_jt = Σ_i r_ij (x_it − mu_jt)/sigma_jt
@@ -53,8 +51,6 @@ def _fv_tpu(X, w, mu, var):
     gvar = (
         rx2 - 2.0 * mu * rx + rsum[..., None] * (mu * mu)
     ) * inv - rsum[..., None]
-    cm = 1.0 / (m * jnp.sqrt(w))[:, None]  # (k, 1)
-    cv = 1.0 / (m * jnp.sqrt(2.0 * w))[:, None]
     out = jnp.concatenate(
         [(gmu * cm).reshape(B, -1), (gvar * cv).reshape(B, -1)], axis=-1
     )
@@ -62,18 +58,29 @@ def _fv_tpu(X, w, mu, var):
 
 
 class FisherVector(Transformer):
-    """Encodes per-image descriptor sets (B, m, d) into (B, 2·k·d) FVs."""
+    """Encodes per-image descriptor sets (B, m, d) into (B, 2·k·d) FVs.
+
+    Backends: "tpu" (XLA einsums), "pallas" (fused kernel keeping the
+    responsibilities in VMEM — see keystone_tpu/ops/fisher_vector_pallas),
+    "native" (C++ EncEval-parity path).
+    """
 
     def __init__(self, weights, means, variances, backend: str = "tpu"):
-        if backend not in ("tpu", "native"):
+        if backend not in ("tpu", "pallas", "native"):
             raise ValueError(f"unknown backend {backend!r}")
         self.weights = np.asarray(weights, dtype=np.float32)
         self.means = np.asarray(means, dtype=np.float32)
         self.variances = np.asarray(variances, dtype=np.float32)
         self.backend = backend
-        self.jittable = backend == "tpu"
+        self.jittable = backend in ("tpu", "pallas")
 
     def apply_batch(self, X):
+        if self.backend == "pallas":
+            from keystone_tpu.ops import fisher_vectors_pallas
+
+            return fisher_vectors_pallas(
+                X, self.weights, self.means, self.variances
+            )
         if self.backend == "tpu":
             return _fv_tpu(
                 jnp.asarray(X),

@@ -1,0 +1,158 @@
+"""Fused Fisher-vector encoding as a Pallas TPU kernel.
+
+The XLA path (nodes/images/external/fisher_vector._fv_tpu) materializes the
+responsibility tensor r of shape (B, m, k) in HBM between the softmax and
+the two gradient einsums. This kernel tiles the descriptor axis: each
+(image, m-tile) program computes its responsibilities in VMEM, immediately
+contracts them into the (k, d) gradient accumulators, and never writes r
+out — saving a full (B·m·k) HBM round trip per encode (≈2 MB/image at the
+ImageNet configuration k=256, m≈2000).
+
+Math identical to the XLA/native backends (cross-checked in tests):
+
+  gmu_j  = Σ_i r_ij (x_i − μ_j)/σ_j · 1/(m√w_j)
+  gvar_j = Σ_i r_ij ((x_i − μ_j)²/var_j − 1) · 1/(m√(2w_j))
+
+accumulated per tile via the expanded forms rᵀx and rᵀx² so every
+contraction is an MXU matmul with f32 accumulation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from keystone_tpu.config import config
+
+
+def _fv_kernel(
+    x_ref,  # (1, Tm, d) descriptor tile
+    logw_norm_ref,  # (1, k) log w_j + log-normalizer
+    mu_ref,  # (k, d)
+    inv_ref,  # (k, d)   1/var
+    mu_inv_ref,  # (k, d) mu/var
+    sigma_ref,  # (k, d)  sqrt(var)
+    c2_ref,  # (1, k)  Σ_d mu² / var
+    m_real_ref,  # (1, 1)  logical descriptor count (pre-padding)
+    gmu_ref,  # (1, k, d) out accumulator
+    gvar_ref,  # (1, k, d) out accumulator
+    *,
+    tile_m: int,
+):
+    t = pl.program_id(1)
+    x = x_ref[0]  # (Tm, d)
+    # log p(x|j) + log w_j, gemm-shaped.
+    quad = (
+        jnp.dot(x * x, inv_ref[:].T, preferred_element_type=jnp.float32)
+        - 2.0 * jnp.dot(x, mu_inv_ref[:].T, preferred_element_type=jnp.float32)
+        + c2_ref[0][None, :]
+    )
+    logits = logw_norm_ref[0][None, :] - 0.5 * quad  # (Tm, k)
+    r = jax.nn.softmax(logits, axis=-1)
+    # Mask rows beyond the logical descriptor count (zero-padded tiles).
+    row = t * tile_m + jax.lax.broadcasted_iota(jnp.int32, (tile_m, 1), 0)
+    r = jnp.where(row < m_real_ref[0, 0], r, 0.0)
+
+    rs = jnp.sum(r, axis=0)  # (k,)
+    t1 = jnp.dot(r.T, x, preferred_element_type=jnp.float32)  # (k, d)
+    t2 = jnp.dot(r.T, x * x, preferred_element_type=jnp.float32)  # (k, d)
+    mu = mu_ref[:]
+    inv = inv_ref[:]
+    gmu_tile = (t1 - rs[:, None] * mu) / sigma_ref[:]
+    gvar_tile = (t2 - 2.0 * mu * t1 + rs[:, None] * (mu * mu)) * inv - rs[
+        :, None
+    ]
+
+    @pl.when(t == 0)
+    def _():
+        gmu_ref[0] = jnp.zeros_like(gmu_ref[0])
+        gvar_ref[0] = jnp.zeros_like(gvar_ref[0])
+
+    gmu_ref[0] += gmu_tile
+    gvar_ref[0] += gvar_tile
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tile_m", "interpret")
+)
+def _fv_pallas(X, w, mu, var, tile_m: int, interpret: bool):
+    B, m, d = X.shape
+    k = w.shape[0]
+    m_pad = (-m) % tile_m
+    if m_pad:
+        X = jnp.pad(X, ((0, 0), (0, m_pad), (0, 0)))
+    tiles = (m + m_pad) // tile_m
+
+    from keystone_tpu.ops.fv_common import fv_constants
+
+    w, inv, logw_norm_vec, cm, cv = fv_constants(w, mu, var, m)
+    logw_norm = logw_norm_vec[None, :]  # (1, k)
+    c2 = jnp.sum(mu * mu * inv, axis=1)[None, :]  # (1, k)
+    m_real = jnp.full((1, 1), m, dtype=jnp.int32)
+
+    gmu, gvar = pl.pallas_call(
+        functools.partial(_fv_kernel, tile_m=tile_m),
+        grid=(B, tiles),
+        in_specs=[
+            pl.BlockSpec((1, tile_m, d), lambda b, t: (b, t, 0)),
+            pl.BlockSpec((1, k), lambda b, t: (0, 0)),
+            pl.BlockSpec((k, d), lambda b, t: (0, 0)),
+            pl.BlockSpec((k, d), lambda b, t: (0, 0)),
+            pl.BlockSpec((k, d), lambda b, t: (0, 0)),
+            pl.BlockSpec((k, d), lambda b, t: (0, 0)),
+            pl.BlockSpec((1, k), lambda b, t: (0, 0)),
+            pl.BlockSpec((1, 1), lambda b, t: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, k, d), lambda b, t: (b, 0, 0)),
+            pl.BlockSpec((1, k, d), lambda b, t: (b, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, k, d), jnp.float32),
+            jax.ShapeDtypeStruct((B, k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        X,
+        logw_norm,
+        mu,
+        inv,
+        mu * inv,
+        jnp.sqrt(var),
+        c2,
+        m_real,
+    )
+    out = jnp.concatenate(
+        [(gmu * cm).reshape(B, -1), (gvar * cv).reshape(B, -1)], axis=-1
+    )
+    return out.astype(config.default_dtype)
+
+
+def fisher_vectors_pallas(
+    X,
+    weights,
+    means,
+    variances,
+    tile_m: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """(B, m, d) descriptor sets → (B, 2·k·d) raw Fisher vectors.
+
+    ``interpret`` defaults to True off-TPU (CPU tests run the kernel logic
+    through the Pallas interpreter) and False on TPU (Mosaic lowering).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    X = jnp.asarray(X, dtype=jnp.float32)
+    return _fv_pallas(
+        X,
+        jnp.asarray(weights, dtype=jnp.float32),
+        jnp.asarray(means, dtype=jnp.float32),
+        jnp.asarray(variances, dtype=jnp.float32),
+        tile_m=min(tile_m, X.shape[1]),
+        interpret=interpret,
+    )

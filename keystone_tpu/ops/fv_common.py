@@ -1,0 +1,27 @@
+"""Shared Fisher-vector math constants.
+
+Single source for the quantities every FV backend (XLA einsum, Pallas
+kernel, native C++) must agree on: the starved-component weight clamp, the
+Gaussian log-normalizers, and the improved-FV gradient scalings. The C++
+path mirrors these in gmm_fv.cpp; the two Python backends import them.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+WEIGHT_FLOOR = 1e-12  # starved components yield zero blocks, not NaNs
+
+
+def fv_constants(w, mu, var, m: int):
+    """Returns (w, inv_var, logw_norm (k,), cm (k,1), cv (k,1))."""
+    w = jnp.maximum(w, WEIGHT_FLOOR)
+    d = mu.shape[1]
+    inv = 1.0 / var
+    log_norm = -0.5 * (
+        d * jnp.log(2 * jnp.pi) + jnp.sum(jnp.log(var), axis=1)
+    )
+    logw_norm = jnp.log(w) + log_norm
+    cm = (1.0 / (m * jnp.sqrt(w)))[:, None]
+    cv = (1.0 / (m * jnp.sqrt(2.0 * w)))[:, None]
+    return w, inv, logw_norm, cm, cv

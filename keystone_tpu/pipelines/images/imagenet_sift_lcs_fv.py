@@ -136,7 +136,7 @@ def main(argv=None):
     p.add_argument("--lam", type=float, default=1e-3)
     p.add_argument("--mixture-weight", type=float, default=0.5)
     p.add_argument("--top-k", type=int, default=5)
-    p.add_argument("--fv-backend", choices=["tpu", "native"], default="tpu")
+    p.add_argument("--fv-backend", choices=["tpu", "pallas", "native"], default="tpu")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--synthetic-n", type=int, default=512)
     p.add_argument("--synthetic-classes", type=int, default=16)

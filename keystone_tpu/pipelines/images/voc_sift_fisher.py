@@ -121,7 +121,7 @@ def main(argv=None):
     p.add_argument("--pca-dims", type=int, default=64)
     p.add_argument("--gmm-k", type=int, default=16)
     p.add_argument("--lam", type=float, default=1e-3)
-    p.add_argument("--fv-backend", choices=["tpu", "native"], default="tpu")
+    p.add_argument("--fv-backend", choices=["tpu", "pallas", "native"], default="tpu")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--synthetic-n", type=int, default=192)
     a = p.parse_args(argv)
