@@ -1,6 +1,7 @@
 from keystone_tpu.nodes.util.labels import ClassLabelIndicators
 from keystone_tpu.nodes.util.classifiers import MaxClassifier, TopKClassifier
 from keystone_tpu.nodes.util.misc import (
+    Cacher,
     Cast,
     Densify,
     Identity,
@@ -14,6 +15,7 @@ __all__ = [
     "MaxClassifier",
     "TopKClassifier",
     "Cast",
+    "Cacher",
     "Identity",
     "VectorSplitter",
     "VectorCombiner",

@@ -19,6 +19,27 @@ class Identity(Transformer):
         return X
 
 
+class Cacher(Transformer):
+    """API-parity alias for the reference's Cacher node: composing
+    ``pipeline.and_then(Cacher())`` persists the value in the session cache
+    exactly like ``pipeline.cache()`` (Ref: workflow/Cacher.scala
+    [unverified])."""
+
+    jittable = False
+
+    def to_pipeline(self):
+        from keystone_tpu.workflow.cache import CacheOperator
+        from keystone_tpu.workflow.graph import Graph, fresh_source_id
+        from keystone_tpu.workflow.pipeline import Pipeline
+
+        source = fresh_source_id()
+        graph, nid = Graph().add(CacheOperator(), [source])
+        return Pipeline(graph, source, nid)
+
+    def apply_batch(self, X):  # direct eager use: identity
+        return X
+
+
 class Cast(Transformer):
     def __init__(self, dtype):
         self.dtype = jnp.dtype(dtype)

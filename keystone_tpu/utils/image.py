@@ -62,6 +62,31 @@ def vectorize(batch):
     return batch.reshape(batch.shape[0], -1)
 
 
+def from_pil(img, size: int | None = None):
+    """PIL image → HWC float32 in [0, 1] (ImageConversions analog,
+    Ref: utils/ImageConversions.scala BufferedImage↔Image [unverified])."""
+    import numpy as np
+
+    # Convert before resizing: palette/bilevel modes force NEAREST resampling.
+    img = img.convert("RGB")
+    if size is not None:
+        img = img.resize((size, size))
+    return np.asarray(img, dtype=np.float32) / 255.0
+
+
+def to_pil(array):
+    """HWC float array in [0, 1] → PIL image."""
+    import numpy as np
+    from PIL import Image as PILImage
+
+    arr = np.asarray(array)
+    if arr.ndim == 3 and arr.shape[-1] == 1:
+        arr = arr[..., 0]
+    return PILImage.fromarray(
+        np.rint(np.clip(arr, 0.0, 1.0) * 255.0).astype(np.uint8)
+    )
+
+
 def clamped_gradients(g):
     """Central differences with edge-clamped borders for (n, h, w) images —
     no wrap-around mixing opposite edges into border gradients."""

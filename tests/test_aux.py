@@ -233,3 +233,25 @@ def test_gmm_fisher_estimator_tpu_backend_without_native(rng):
     np.testing.assert_allclose(means, [-2, 2], atol=0.3)
     out = np.asarray(fv(rng.normal(size=(3, 20, 4)).astype(np.float32)))
     assert out.shape == (3, 2 * 2 * 4)
+
+
+def test_cacher_node_parity(rng):
+    from keystone_tpu.nodes.util import Cacher
+
+    host = CountingHost()
+    X = rng.normal(size=(4, 2)).astype(np.float32)
+    p = host.to_pipeline().and_then(Cacher())
+    p(X).get()
+    p(X).get()
+    assert host.calls == 1  # identical to pipeline.cache()
+
+
+def test_pil_conversions(rng):
+    from keystone_tpu.utils.image import from_pil, to_pil
+
+    arr = rng.uniform(size=(10, 12, 3)).astype(np.float32)
+    back = from_pil(to_pil(arr))
+    assert back.shape == (10, 12, 3)
+    np.testing.assert_allclose(back, arr, atol=0.5 / 255 + 1e-6)
+    resized = from_pil(to_pil(arr), size=6)
+    assert resized.shape == (6, 6, 3)
