@@ -38,6 +38,13 @@ class Config:
     # HBM budget (bytes) assumed by the auto-caching rule when no device is
     # queried. v5e = 16 GiB; leave headroom for XLA scratch.
     hbm_budget_bytes: int = 12 * (1 << 30)
+    # Row-shard array batches over the mesh when they enter the graph (the
+    # RDD-partitioning analog): featurization chains then run data-parallel
+    # across chips via sharding propagation, not just the solvers. Batches
+    # whose row count doesn't divide the mesh stay single-device.
+    shard_data_batches: bool = True
+    # Minimum rows before sharding is worth the placement overhead.
+    shard_min_rows: int = 64
     # Whole-pipeline auto-caching (profile a sample run, persist the best
     # time-saved-per-byte intermediates under a budget). Opt-in: profiling
     # costs a sample execution per optimization.

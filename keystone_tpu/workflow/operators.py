@@ -39,13 +39,53 @@ class Operator:
 
 
 class DatasetOperator(Operator):
-    """A constant batch of data spliced into the graph (the RDD analog)."""
+    """A constant batch of data spliced into the graph (the RDD analog).
+
+    Array batches are row-sharded over the default mesh on execution (when
+    the row count divides it), so the jittable transformer chain downstream
+    runs data-parallel across chips by sharding propagation — the
+    per-partition map of the reference, done by GSPMD.
+    """
 
     def __init__(self, data: Any):
         self.data = data
 
     def execute(self, deps):
-        return self.data
+        import logging
+
+        import jax
+        import numpy as np
+
+        from keystone_tpu.config import config
+
+        data = self.data
+        if not config.shard_data_batches:
+            return data
+        # Only host numpy batches are auto-placed; a jax.Array already has a
+        # placement (explicit or default) that we must not override, and
+        # non-numeric arrays (strings/objects) belong to host transformers.
+        if (
+            not isinstance(data, np.ndarray)
+            or data.ndim < 1
+            or data.dtype.kind not in "biufc"
+        ):
+            return data
+        from keystone_tpu.utils.mesh import data_sharding, num_data_shards
+
+        shards = num_data_shards()
+        if shards <= 1 or data.shape[0] < config.shard_min_rows:
+            return data
+        if data.shape[0] % shards != 0:
+            # Padding would change the row count the rest of the graph (and
+            # the evaluators) see, so fall back — but say so.
+            logging.getLogger("keystone_tpu").info(
+                "batch of %d rows does not divide the %d-device mesh; "
+                "running this dataset single-device",
+                data.shape[0],
+                shards,
+            )
+            return data
+        return jax.device_put(data, data_sharding())
 
     def signature(self):
         return ("dataset", id(self.data))

@@ -290,3 +290,26 @@ def test_estimator_with_labels_rejected():
     est = MeanShift()
     with pytest.raises(TypeError, match="LabelEstimator"):
         Plus(1.0).and_then(est, np.ones((2, 1)), np.ones((2, 1)))
+
+
+def test_dataset_sharding_respects_placement_and_dtype():
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.utils.mesh import replicated_sharding
+    from keystone_tpu.workflow.operators import DatasetOperator
+
+    # Host numpy numeric batch: sharded over the mesh.
+    X = np.ones((64, 4), dtype=np.float32)
+    out = DatasetOperator(X).execute([])
+    assert len(out.sharding.device_set) == len(jax.devices())
+    # Explicitly replicated device array: placement preserved.
+    rep = jax.device_put(jnp.ones((64, 4)), replicated_sharding())
+    out2 = DatasetOperator(rep).execute([])
+    assert out2.sharding == rep.sharding
+    # String array: untouched (host transformer input).
+    s = np.asarray(["a"] * 64)
+    assert DatasetOperator(s).execute([]) is s
+    # Non-divisible rows: single-device fallback, data unchanged.
+    odd = np.ones((65, 4), dtype=np.float32)
+    assert DatasetOperator(odd).execute([]) is odd
