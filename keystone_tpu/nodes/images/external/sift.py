@@ -29,6 +29,9 @@ class SIFTExtractor(Transformer):
                 "run `make` in keystone_tpu/native"
             )
 
+    def signature(self):
+        return self.stable_signature(self.step, self.bin_size, self.scale_factor)
+
     def apply_batch(self, X):
         X = np.asarray(X, dtype=np.float32)
         if X.ndim == 4:

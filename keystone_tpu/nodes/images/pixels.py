@@ -11,6 +11,9 @@ from keystone_tpu.workflow import Transformer
 
 
 class GrayScaler(Transformer):
+    def signature(self):
+        return self.stable_signature()
+
     def apply_batch(self, X):
         return grayscale(X)
 
@@ -21,10 +24,16 @@ class PixelScaler(Transformer):
     def __init__(self, scale: float = 255.0):
         self.scale = scale
 
+    def signature(self):
+        return self.stable_signature(self.scale)
+
     def apply_batch(self, X):
         return X / self.scale
 
 
 class ImageVectorizer(Transformer):
+    def signature(self):
+        return self.stable_signature()
+
     def apply_batch(self, X):
         return vectorize(X)

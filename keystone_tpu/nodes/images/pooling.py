@@ -20,6 +20,9 @@ class SymmetricRectifier(Transformer):
         self.alpha = alpha
         self.max_val = max_val
 
+    def signature(self):
+        return self.stable_signature(self.alpha, self.max_val)
+
     def apply_batch(self, X):
         pos = jnp.maximum(X - self.alpha, self.max_val)
         neg = jnp.maximum(-X - self.alpha, self.max_val)
@@ -38,6 +41,9 @@ class Pooler(Transformer):
         self.stride = stride
         self.pool_size = pool_size
         self.mode = mode
+
+    def signature(self):
+        return self.stable_signature(self.stride, self.pool_size, self.mode)
 
     def apply_batch(self, X):
         dims = (1, self.pool_size, self.pool_size, 1)

@@ -12,5 +12,8 @@ from keystone_tpu.workflow import Transformer
 
 
 class SignedHellingerMapper(Transformer):
+    def signature(self):
+        return self.stable_signature()
+
     def apply_batch(self, X):
         return jnp.sign(X) * jnp.sqrt(jnp.abs(X))

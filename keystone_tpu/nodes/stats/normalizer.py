@@ -16,6 +16,9 @@ class L2Normalizer(Transformer):
     def __init__(self, eps: float = 1e-12):
         self.eps = eps
 
+    def signature(self):
+        return self.stable_signature(self.eps)
+
     def apply_batch(self, X):
         norm = jnp.linalg.norm(X, axis=-1, keepdims=True)
         return X / jnp.maximum(norm, self.eps)

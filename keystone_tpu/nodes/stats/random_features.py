@@ -42,7 +42,12 @@ class CosineRandomFeatures(Transformer):
         b = jax.random.uniform(
             kb, (num_features,), minval=0.0, maxval=2 * np.pi, dtype=dtype
         )
-        return cls(W * gamma, b)
+        node = cls(W * gamma, b)
+        # dtype is part of the identity: the drawn W/b values depend on it.
+        node._sig = node.stable_signature(
+            input_dim, num_features, gamma, distribution, seed, str(dtype)
+        )
+        return node
 
     def apply_batch(self, X):
         return jnp.cos(X @ self.W + self.b)

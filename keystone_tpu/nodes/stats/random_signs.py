@@ -21,7 +21,9 @@ class RandomSignNode(Transformer):
     def create(cls, dim: int, seed: int = 0) -> "RandomSignNode":
         key = jax.random.PRNGKey(seed)
         signs = jax.random.rademacher(key, (dim,), dtype=jnp.float32)
-        return cls(signs)
+        node = cls(signs)
+        node._sig = node.stable_signature(dim, seed)
+        return node
 
     def apply_batch(self, X):
         return X * self.signs

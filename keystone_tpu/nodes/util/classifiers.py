@@ -13,6 +13,9 @@ from keystone_tpu.workflow import Transformer
 
 
 class MaxClassifier(Transformer):
+    def signature(self):
+        return self.stable_signature()
+
     def apply_batch(self, scores):
         return jnp.argmax(scores, axis=-1).astype(jnp.int32)
 
@@ -22,6 +25,9 @@ class TopKClassifier(Transformer):
 
     def __init__(self, k: int):
         self.k = k
+
+    def signature(self):
+        return self.stable_signature(self.k)
 
     def apply_batch(self, scores):
         _, idx = jax.lax.top_k(scores, self.k)

@@ -18,6 +18,9 @@ class ClassLabelIndicators(Transformer):
     def __init__(self, num_classes: int):
         self.num_classes = num_classes
 
+    def signature(self):
+        return self.stable_signature(self.num_classes)
+
     def apply_batch(self, y):
         y = jnp.asarray(y).astype(jnp.int32)
         onehot = jnp.zeros(

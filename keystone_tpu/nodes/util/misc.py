@@ -15,6 +15,9 @@ from keystone_tpu.workflow import Transformer
 
 
 class Identity(Transformer):
+    def signature(self):
+        return self.stable_signature()
+
     def apply_batch(self, X):
         return X
 

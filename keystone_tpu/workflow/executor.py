@@ -45,16 +45,51 @@ class GraphExecutor:
     def execute_many(
         self, graph: Graph, targets: Sequence[GraphId]
     ) -> Dict[GraphId, Any]:
-        """Evaluate all targets in one pass with shared memoization."""
+        """Evaluate all targets in one pass with shared memoization.
+
+        The walk CUTS at persistent-cache hits: a node whose structural hash
+        is already in the fit/node cache becomes a leaf and its upstream
+        subgraph is never visited — cached values short-circuit
+        recomputation, not just value storage.
+        """
         for t in targets:
             if isinstance(t, SourceId):
                 _no_sources(t)
         hmemo: Dict[GraphId, int] = {}
+
+        def h_of(nid: GraphId) -> int:
+            return structural_hash(graph, nid, _no_sources, hmemo)
+
         values: Dict[GraphId, Any] = {}
         by_hash: Dict[int, Any] = {}
-        order = graph.reachable(targets)
+        order: List[GraphId] = []
+        seen = set()
+        stack: List[tuple] = [(t, False) for t in targets]
+        while stack:
+            gid, processed = stack.pop()
+            if processed:
+                order.append(gid)
+                continue
+            if gid in seen or isinstance(gid, SourceId):
+                continue
+            seen.add(gid)
+            op = graph.operators[gid]
+            h = h_of(gid)
+            hit = None
+            if isinstance(op, EstimatorOperator) and h in self.env.fit_cache:
+                hit = self.env.fit_cache[h][0]
+            elif h in self.env.node_cache:
+                hit = self.env.node_cache[h][0]
+            if hit is not None:
+                values[gid] = by_hash[h] = hit
+                continue  # leaf: do not descend into its dependencies
+            stack.append((gid, True))
+            for dep in graph.dependencies[gid]:
+                if dep not in seen and isinstance(dep, NodeId):
+                    stack.append((dep, False))
+
         for nid in order:
-            h = structural_hash(graph, nid, _no_sources, hmemo)
+            h = h_of(nid)
             op = graph.operators[nid]
             if h in by_hash:
                 values[nid] = by_hash[h]
@@ -65,12 +100,6 @@ class GraphExecutor:
                         values[nid],
                         self._prefix_pins(graph, nid),
                     )
-                continue
-            if isinstance(op, EstimatorOperator) and h in self.env.fit_cache:
-                values[nid] = by_hash[h] = self.env.fit_cache[h][0]
-                continue
-            if h in self.env.node_cache:
-                values[nid] = by_hash[h] = self.env.node_cache[h][0]
                 continue
             deps = [values[d] for d in graph.dependencies[nid]]
             out = op.execute(deps)

@@ -100,8 +100,21 @@ class Transformer:
         return fn
 
     def signature(self) -> Any:
-        """Key for structural prefix hashing; object identity by default."""
-        return id(self)
+        """Key for structural prefix hashing; object identity by default.
+
+        Deterministic nodes either override this to build a
+        ``stable_signature`` from their current parameters, or (factory-
+        created nodes) install one on ``self._sig`` — then two separately-
+        constructed-but-identical nodes hash (and cache) alike, including
+        across pipeline rebuilds in one session.
+        """
+        return getattr(self, "_sig", id(self))
+
+    def stable_signature(self, *params) -> tuple:
+        """Content-based signature: concrete class + constructor params.
+        The class OBJECT is part of the key (not its name), so two distinct
+        classes — even same-named locals — can never collide."""
+        return (type(self),) + params
 
     def chain_hash(self, h_in: int) -> int:
         """Prefix hash of applying this transformer to an input with hash

@@ -102,3 +102,32 @@ def test_imagenet_sift_lcs_fv_end_to_end():
     )
     assert out["top_k_error"] < 0.1, out["summary"]
     assert out["top_1_error"] < 0.5, out["summary"]
+
+
+@needs_native
+def test_fitted_native_pipeline_save_load(tmp_path):
+    import numpy as np
+
+    from keystone_tpu.loaders.voc import VOCLoader
+    from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
+    from keystone_tpu.pipelines.images.voc_sift_fisher import (
+        VOCSIFTFisherConfig,
+        build_featurizer,
+    )
+    from keystone_tpu.workflow import load_pipeline, save_pipeline
+
+    train, test = VOCLoader.synthetic(n=48, num_classes=4)
+    conf = VOCSIFTFisherConfig(pca_dims=16, gmm_k=4, descriptor_sample=10000)
+    feat = build_featurizer(conf, train.data)
+    targets = (2.0 * train.labels - 1.0).astype(np.float32)
+    p = feat.and_then(
+        BlockLeastSquaresEstimator(block_size=128, num_iters=1, lam=1e-3),
+        train.data,
+        targets,
+    ).fit()
+    path = str(tmp_path / "voc.pkl")
+    save_pipeline(p, path)
+    lp = load_pipeline(path)
+    np.testing.assert_array_equal(
+        np.asarray(p(test.data).get()), np.asarray(lp(test.data).get())
+    )

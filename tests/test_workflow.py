@@ -313,3 +313,53 @@ def test_dataset_sharding_respects_placement_and_dtype():
     # Non-divisible rows: single-device fallback, data unchanged.
     odd = np.ones((65, 4), dtype=np.float32)
     assert DatasetOperator(odd).execute([]) is odd
+
+
+def test_stable_signatures_dedupe_rebuilt_pipelines():
+    from keystone_tpu.nodes.stats import PaddedFFT, RandomSignNode
+    from keystone_tpu.nodes.util import Cacher
+
+    calls = []
+
+    class CountingRectifier(Transformer):
+        """Stable-signature host stage so recomputation is observable."""
+
+        jittable = False
+
+        def signature(self):
+            return self.stable_signature()
+
+        def apply_batch(self, X):
+            calls.append(1)
+            return np.maximum(np.asarray(X), 0.0)
+
+    X = np.random.default_rng(0).normal(size=(64, 32)).astype(np.float32)
+
+    def build():
+        # Two separately-constructed but identical featurizers.
+        return (
+            RandomSignNode.create(32, seed=5)
+            .and_then(PaddedFFT())
+            .and_then(CountingRectifier())
+            .and_then(Cacher())
+        )
+
+    a, b = build(), build()
+    out_a = np.asarray(a(X).get())
+    out_b = np.asarray(b(X).get())  # session-cache hit via stable signatures
+    np.testing.assert_array_equal(out_a, out_b)
+    from keystone_tpu.workflow import PipelineEnv
+
+    assert len(PipelineEnv.get().node_cache) == 1  # one shared entry
+    # The cache hit must CUT the second execution: upstream never reruns.
+    assert calls == [1]
+
+
+def test_stable_signature_subclass_never_collides():
+    from keystone_tpu.nodes.util import Identity
+
+    class Shifted(Identity):
+        def apply_batch(self, X):
+            return X + 1.0
+
+    assert Identity().signature() != Shifted().signature()
