@@ -106,6 +106,8 @@ class CenterCornerPatcher(Transformer):
     def apply_batch(self, X):
         n, h, w, _c = X.shape
         s = self.crop_size
+        if s > h or s > w:
+            raise ValueError(f"crop {s} exceeds image {h}x{w}")
         ct, cl = (h - s) // 2, (w - s) // 2
         crops = [
             X[:, :s, :s, :],

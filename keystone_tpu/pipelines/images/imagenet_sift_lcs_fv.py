@@ -53,6 +53,10 @@ class ImageNetSiftLcsFVConfig:
     block_size: int = 4096
     num_iters: int = 2
     top_k: int = 5
+    # Test-time augmentation: score center+corner crops (flipped too) per
+    # image and average (Ref: AugmentedExamplesEvaluator, SURVEY.md §2.10).
+    augment: bool = False
+    augment_crop: int = 0  # 0 = 7/8 of the image side
     fv_backend: str = "tpu"
     seed: int = 0
     synthetic_n: int = 512
@@ -104,8 +108,20 @@ def run(conf: ImageNetSiftLcsFVConfig) -> dict:
         mixture_weight=conf.mixture_weight,
     )
     scored = featurizer.and_then(solver, train.data, targets)
-    pipeline = scored.and_then(TopKClassifier(conf.top_k))
-    topk = np.asarray(pipeline(test.data).get())  # (n, top_k)
+    if conf.augment:
+        from keystone_tpu.evaluation.augmented import AugmentedExamplesEvaluator
+        from keystone_tpu.nodes.images import CenterCornerPatcher
+
+        crop = conf.augment_crop or (test.data.shape[1] * 7) // 8
+        patcher = CenterCornerPatcher(crop_size=crop, with_flips=True)
+        view_scores = np.asarray(scored(patcher(test.data)).get())
+        avg = AugmentedExamplesEvaluator(patcher.num_views).average_scores(
+            view_scores
+        )
+        topk = np.asarray(TopKClassifier(conf.top_k)(avg))
+    else:
+        pipeline = scored.and_then(TopKClassifier(conf.top_k))
+        topk = np.asarray(pipeline(test.data).get())  # (n, top_k)
     elapsed = time.time() - t0
 
     correct = (topk == test.labels[:, None]).any(axis=1)
@@ -136,6 +152,10 @@ def main(argv=None):
     p.add_argument("--lam", type=float, default=1e-3)
     p.add_argument("--mixture-weight", type=float, default=0.5)
     p.add_argument("--top-k", type=int, default=5)
+    p.add_argument("--augment", action="store_true",
+                   help="test-time augmentation over center+corner crops")
+    p.add_argument("--augment-crop", type=int, default=0,
+                   help="crop side in pixels (0 = 7/8 of the image side)")
     p.add_argument("--fv-backend", choices=["tpu", "pallas", "native"], default="tpu")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--synthetic-n", type=int, default=512)
@@ -151,6 +171,8 @@ def main(argv=None):
             lam=a.lam,
             mixture_weight=a.mixture_weight,
             top_k=a.top_k,
+            augment=a.augment,
+            augment_crop=a.augment_crop,
             fv_backend=a.fv_backend,
             seed=a.seed,
             synthetic_n=a.synthetic_n,

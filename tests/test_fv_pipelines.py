@@ -131,3 +131,27 @@ def test_fitted_native_pipeline_save_load(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(p(test.data).get()), np.asarray(lp(test.data).get())
     )
+
+
+@needs_native
+def test_imagenet_with_test_time_augmentation():
+    from keystone_tpu.pipelines.images.imagenet_sift_lcs_fv import (
+        ImageNetSiftLcsFVConfig,
+        run,
+    )
+
+    out = run(
+        ImageNetSiftLcsFVConfig(
+            synthetic_n=160,
+            synthetic_classes=6,
+            pca_dims=16,
+            gmm_k=4,
+            descriptor_sample=20_000,
+            num_iters=1,
+            augment=True,
+        )
+    )
+    # top-1 carries the signal: 6-class chance is 0.83 top-1 error; the
+    # top-5 floor (1/6) is too close to the threshold to be meaningful.
+    assert out["top_1_error"] < 0.3, out["summary"]
+    assert out["top_k_error"] < 0.1, out["summary"]
