@@ -1,0 +1,21 @@
+# Developer entry points. Pipelines launch via bin/run-pipeline.sh.
+
+.PHONY: test native bench dryrun clean
+
+test:
+	python -m pytest tests/ -q
+
+native:
+	$(MAKE) -C keystone_tpu/native
+
+bench:
+	python bench.py
+
+dryrun:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 python -c \
+	  "import jax; jax.config.update('jax_platforms','cpu'); \
+	   import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+clean:
+	$(MAKE) -C keystone_tpu/native clean
+	find . -name __pycache__ -type d -exec rm -rf {} +
