@@ -12,7 +12,8 @@ bench:
 	python bench.py
 
 dryrun:
-	XLA_FLAGS=--xla_force_host_platform_device_count=8 python -c \
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  python -c \
 	  "import jax; jax.config.update('jax_platforms','cpu'); \
 	   import __graft_entry__ as g; g.dryrun_multichip(8)"
 

@@ -1,4 +1,4 @@
-"""Benchmark driver — prints ONE JSON line.
+"""Benchmark driver — prints ONE JSON line, no matter what.
 
 Headline metric (BASELINE.md north star #2): solver TFLOPS/chip of the
 block-least-squares inner loop — per-chip MXU gemms (residual update, gram,
@@ -9,16 +9,39 @@ vs_baseline compares against a nominal 0.3 TFLOPS/node — the dgemm-class
 throughput of one of the reference's EC2 r3.4xlarge CPU nodes (16 vcpus;
 BASELINE.md has no published per-node figure, so this is a documented
 engineering estimate for a sustained f64→f32-class BLAS3 workload).
+
+Robustness contract (the round-1 gate failure was rc=1 with no output):
+the orchestrator probes TPU liveness in a short-timeout subprocess first,
+runs the measurement itself in a subprocess with a hard timeout, falls back
+to a scaled-down CPU-mesh measurement when the TPU is dead/hung, and — if
+even that fails — emits a parseable JSON error line. Timing through the
+TPU relay has lied before (impossible TFLOPS readings), so the timed loop
+forces a device-to-host fetch each rep and the result carries a residual
+check; `suspect_timing` flags a value above the chip's plausible peak.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 BASELINE_NODE_TFLOPS = 0.3
+# v5e peak: ~197 bf16 / ~99 f32 TFLOPS per chip. Anything measured above
+# this is a transport lie, not a fast program.
+PLAUSIBLE_PEAK_TFLOPS = {"bf16": 200.0, "f32": 100.0}
+
+# (n, d, k, block, iters) per backend class — CPU emulation gets a smaller
+# problem so the gate finishes; the FLOP formula keeps the metric honest.
+SCALE = {
+    "tpu": dict(n=32768, d=8192, k=16, block=2048, iters=2),
+    "cpu": dict(n=8192, d=2048, k=16, block=512, iters=2),
+}
 
 
 def bcd_flops(n: int, d: int, k: int, block: int, iters: int) -> float:
@@ -36,12 +59,19 @@ def bcd_flops(n: int, d: int, k: int, block: int, iters: int) -> float:
     return nb * (once + per_epoch * iters)
 
 
-def main():
+def worker(scale_key: str, dtype: str) -> None:
+    """Runs one measurement on this process's default backend and prints the
+    JSON line. Platform selection already happened (env / config)."""
+    from keystone_tpu.utils.platform import env_forces_cpu, force_cpu
+
+    if env_forces_cpu():
+        force_cpu()
     import jax
 
     from keystone_tpu.linalg import RowMatrix, block_coordinate_descent
 
-    n, d, k, block, iters = 32768, 8192, 16, 2048, 2
+    p = SCALE[scale_key]
+    n, d, k, block, iters = p["n"], p["d"], p["k"], p["block"], p["iters"]
     rng = np.random.default_rng(0)
     A = rng.normal(size=(n, d)).astype(np.float32)
     W_true = rng.normal(size=(d, k)).astype(np.float32)
@@ -58,11 +88,13 @@ def main():
         )
         for w in W:
             w.block_until_ready()
+        # Force a real device→host round trip: block_until_ready through a
+        # flaky transport has returned early before; a fetch cannot.
+        np.asarray(W[-1][-1, -1])
         return W
 
     W = run()  # warmup + compile
-    # Validity check: timing through flaky transports can lie; a wrong or
-    # unconverged solve would make the TFLOPS number meaningless.
+    # Validity check: a wrong or unconverged solve makes TFLOPS meaningless.
     West = np.concatenate([np.asarray(w) for w in W], axis=0)
     resid = float(np.linalg.norm(A @ West - B) / np.linalg.norm(B))
     # Two epochs cut the residual ~92% on this problem; anything worse means
@@ -79,24 +111,122 @@ def main():
     dt = total / reps
 
     n_dev = len(jax.devices())
+    backend = jax.default_backend()
     tflops_per_chip = bcd_flops(n, d, k, block, iters) / dt / 1e12 / n_dev
+    peak = PLAUSIBLE_PEAK_TFLOPS["bf16" if dtype == "bf16" else "f32"]
+    line = {
+        "metric": "bcd_solver_tflops_per_chip",
+        "value": round(tflops_per_chip, 3),
+        "unit": "TFLOPS/chip",
+        "vs_baseline": round(tflops_per_chip / BASELINE_NODE_TFLOPS, 2),
+        "backend": backend,
+        "detail": {
+            "n": n,
+            "d": d,
+            "k": k,
+            "block": block,
+            "epochs": iters,
+            "dtype": dtype,
+            "seconds_per_solve": round(dt, 4),
+            "relative_residual": round(resid, 6),
+            "devices": n_dev,
+        },
+    }
+    if backend != "cpu" and tflops_per_chip > peak:
+        line["suspect_timing"] = True
+    print(json.dumps(line), flush=True)
+
+
+def _run_worker(env: dict, scale_key: str, dtype: str, timeout: float):
+    """Run the worker in a subprocess; return its parsed JSON line or None.
+    Failures tail the worker's stderr to our stderr so the gate log is
+    diagnosable (the round-1 failure mode was rc=1 with no diagnostics)."""
+    cmd = [
+        sys.executable, os.path.abspath(__file__),
+        "--worker", "--scale", scale_key, "--dtype", dtype,
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, env=env, capture_output=True, text=True, timeout=timeout
+        )
+    except subprocess.TimeoutExpired as e:
+        tail = (e.stderr or b"")
+        if isinstance(tail, bytes):
+            tail = tail.decode(errors="replace")
+        print(f"bench worker timed out; stderr tail:\n{tail[-2000:]}", file=sys.stderr)
+        return None
+    except OSError as e:
+        print(f"bench worker failed to launch: {e}", file=sys.stderr)
+        return None
+    from keystone_tpu.utils.platform import parse_json_line
+
+    parsed = parse_json_line(proc.stdout)
+    if parsed is not None and "metric" in parsed:
+        return parsed
+    print(
+        f"bench worker rc={proc.returncode}, no JSON line; stderr tail:\n"
+        f"{(proc.stderr or '')[-2000:]}",
+        file=sys.stderr,
+    )
+    return None
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", action="store_true")
+    # --scale default None = pick by backend (tpu scale on a live chip,
+    # cpu scale on the fallback); an explicit value wins everywhere.
+    ap.add_argument("--scale", choices=list(SCALE), default=None)
+    # bf16 storage / f32 accumulate lands with the solver dtype mode; until
+    # then only f32 exists so the flag can't mislabel a measurement.
+    ap.add_argument("--dtype", choices=["f32"], default="f32")
+    ap.add_argument("--probe-timeout", type=float, default=75.0)
+    ap.add_argument("--run-timeout", type=float, default=900.0)
+    args = ap.parse_args()
+
+    if args.worker:
+        worker(args.scale or "tpu", args.dtype)
+        return
+
+    from keystone_tpu.utils.platform import (
+        cpu_mesh_env,
+        env_forces_cpu,
+        probe_backend,
+    )
+
+    error = None
+    if not env_forces_cpu():
+        # An explicit CPU request skips the probe — no point waking the TPU
+        # only to force the worker onto CPU anyway.
+        info = probe_backend(timeout=args.probe_timeout)
+        if info is not None and info.get("platform") != "cpu":
+            result = _run_worker(
+                dict(os.environ), args.scale or "tpu", args.dtype, args.run_timeout
+            )
+            if result is not None:
+                print(json.dumps(result))
+                return
+            error = "tpu_run_failed_or_hung"
+        elif info is None:
+            error = "backend_init_dead_or_hung"
+
+    # CPU-mesh fallback: a real measurement, honestly labelled.
+    env = cpu_mesh_env(8)
+    result = _run_worker(env, args.scale or "cpu", args.dtype, args.run_timeout)
+    if result is not None:
+        if error:
+            result["backend_error"] = error
+        print(json.dumps(result))
+        return
+
     print(
         json.dumps(
             {
                 "metric": "bcd_solver_tflops_per_chip",
-                "value": round(tflops_per_chip, 3),
+                "value": None,
                 "unit": "TFLOPS/chip",
-                "vs_baseline": round(tflops_per_chip / BASELINE_NODE_TFLOPS, 2),
-                "detail": {
-                    "n": n,
-                    "d": d,
-                    "k": k,
-                    "block": block,
-                    "epochs": iters,
-                    "seconds_per_solve": round(dt, 4),
-                    "relative_residual": round(resid, 6),
-                    "devices": n_dev,
-                },
+                "vs_baseline": None,
+                "error": error or "cpu_fallback_failed",
             }
         )
     )

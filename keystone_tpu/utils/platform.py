@@ -7,7 +7,114 @@ JAX_PLATFORMS; config.update after import is the reliable switch.
 
 from __future__ import annotations
 
+import json
 import os
+import subprocess
+import sys
+from typing import Optional
+
+
+def force_cpu() -> None:
+    """Pin this process to the CPU platform. config.update (not env) because
+    the sitecustomize-registered TPU plugin ignores JAX_PLATFORMS."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def env_forces_cpu() -> bool:
+    """True when the ambient env asks for CPU (either spelling)."""
+    return (
+        os.environ.get("KEYSTONE_PLATFORM") == "cpu"
+        or os.environ.get("JAX_PLATFORMS") == "cpu"
+    )
+
+
+def parse_json_line(text: str) -> Optional[dict]:
+    """Last parseable JSON object line of ``text`` (subprocess stdout may
+    carry log noise around the one structured line)."""
+    for line in reversed(text.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(parsed, dict):
+            return parsed
+    return None
+
+
+def probe_backend(timeout: float = 60.0) -> Optional[dict]:
+    """Check the ambient default JAX backend is *alive* without risking a hang.
+
+    The TPU tunnel in this environment can die mid-session, after which any
+    device op (even backend init) blocks forever. Running a tiny jitted op in
+    a subprocess with a hard timeout is the only safe liveness test — the
+    parent process never touches the suspect backend.
+
+    Returns ``{"platform": str, "n": int}`` on success, ``None`` when the
+    backend is dead, hung, or errors out.
+    """
+    code = (
+        "import json, jax, jax.numpy as jnp\n"
+        "x = (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()\n"
+        "d = jax.devices()\n"
+        "print(json.dumps({'platform': d[0].platform, 'n': len(d)}))\n"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+    if out.returncode != 0:
+        return None
+    info = parse_json_line(out.stdout)
+    return info if info is not None and "platform" in info else None
+
+
+def ensure_live_backend(timeout: float = 60.0) -> str:
+    """Probe the ambient backend; fall back to CPU if it is dead or hung.
+
+    Must run before this process initializes any JAX backend (config.update
+    has no effect afterwards). Returns the platform this process will use.
+    """
+    if env_forces_cpu():
+        force_cpu()
+        return "cpu"
+    info = probe_backend(timeout=timeout)
+    if info is None:
+        force_cpu()
+        return "cpu"
+    return str(info["platform"])
+
+
+def cpu_mesh_env(n_devices: int, base: Optional[dict] = None) -> dict:
+    """Env for a subprocess that must see ``n_devices`` virtual CPU devices.
+
+    XLA_FLAGS must precede backend init, hence a fresh env rather than
+    in-process mutation; KEYSTONE_PLATFORM=cpu makes the child's own
+    config.update defeat the sitecustomize-forced TPU plugin.
+    """
+    import re
+
+    env = dict(base if base is not None else os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    # Replace any existing count with max(existing, n_devices) — keeping a
+    # smaller leftover count would hand the child too few devices.
+    pat = r"--xla_force_host_platform_device_count=(\d+)"
+    m = re.search(pat, flags)
+    if m:
+        count = max(int(m.group(1)), n_devices)
+        flags = re.sub(pat, f"--xla_force_host_platform_device_count={count}", flags)
+    else:
+        flags = (flags + f" --xla_force_host_platform_device_count={n_devices}").strip()
+    env["XLA_FLAGS"] = flags
+    env["JAX_PLATFORMS"] = "cpu"
+    env["KEYSTONE_PLATFORM"] = "cpu"
+    return env
 
 
 def setup_platform() -> None:
