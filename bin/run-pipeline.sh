@@ -7,6 +7,10 @@
 #   KEYSTONE_PLATFORM=cpu|axon     force the JAX platform (default: auto)
 #   KEYSTONE_NUM_DEVICES=N         virtual CPU device count (testing meshes)
 #   KEYSTONE_NO_FUSE=1             disable chain fusion (debugging)
+#   KEYSTONE_CACHE_DIR=path        fitted-prefix store; a rerun with the same
+#                                  data + hyperparams skips refits entirely
+#                                  (default: .keystone_cache next to the repo;
+#                                  set empty to disable)
 set -euo pipefail
 
 if [[ $# -lt 1 ]]; then
@@ -33,6 +37,7 @@ esac
 
 REPO_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 export PYTHONPATH="${REPO_DIR}${PYTHONPATH:+:$PYTHONPATH}"
+export KEYSTONE_CACHE_DIR="${KEYSTONE_CACHE_DIR-${REPO_DIR}/.keystone_cache}"
 
 if [[ ! -f "${REPO_DIR}/${MOD//.//}.py" ]]; then
   echo "pipeline $PIPELINE is not implemented yet (module $MOD missing)" >&2

@@ -52,6 +52,10 @@ class Config:
     # Raise on NaNs inside jitted computations (jax debug_nans; the
     # sanitizer analog — SURVEY.md §5 race-detection row).
     debug_nans: bool = False
+    # Directory for the cross-process fitted-prefix store (None = disabled;
+    # the KEYSTONE_CACHE_DIR env var takes precedence). Content-addressed, so
+    # it never serves stale fits — see workflow/disk_cache.py.
+    cache_dir: str | None = None
     # Whether executor fuses jittable transformer chains into one XLA program.
     # Disabled by KEYSTONE_NO_FUSE set to a truthy value (anything except
     # "", "0", "false", "no").

@@ -70,6 +70,10 @@ class LeastSquaresEstimator(LabelEstimator):
     `num_iters`/`block_size` only apply when the block solver is chosen.
     """
 
+    # Fit-time diagnostic, not identity — mutating it must not change the
+    # content signature between executions.
+    _signature_exclude = ("last_choice",)
+
     def __init__(
         self,
         lam: float = 0.0,

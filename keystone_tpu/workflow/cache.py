@@ -44,6 +44,11 @@ class CacheOperator(Operator):
     def prefix_hash(self, dep_hashes):
         return dep_hashes[0]
 
+    def prefix_digest(self, dep_digests):
+        # Same transparency cross-process: cache placement is a profiling
+        # decision and must not perturb content keys.
+        return dep_digests[0]
+
     def label(self):
         return "Cache"
 

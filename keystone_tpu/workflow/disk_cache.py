@@ -1,0 +1,71 @@
+"""On-disk fitted-prefix store — prefix-state reuse across processes.
+
+The reference's fitted pipelines persist their prefix state so a rerun skips
+refits (SURVEY.md §2.1 auto-caching + §5 checkpoint/resume rows [unverified]).
+Here the store is content-addressed: the key is the structural digest of the
+estimator node's prefix (class + hyperparams + data fingerprints, see
+workflow/fingerprint.py), so a hit is byte-level evidence the same fit would
+recompute the same transformer — no invalidation logic needed, stale entries
+are simply never addressed.
+
+Enabled by ``KEYSTONE_CACHE_DIR`` (or ``config.cache_dir``); corrupt or
+unreadable entries degrade to cache misses, never errors.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import tempfile
+from typing import Any, Optional
+
+logger = logging.getLogger("keystone_tpu")
+
+
+class DiskFitCache:
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, f"{key}.fit.pkl")
+
+    def get(self, key: str) -> Optional[Any]:
+        path = self._path(key)
+        try:
+            with open(path, "rb") as f:
+                fitted = pickle.load(f)
+        except FileNotFoundError:
+            return None
+        except Exception as e:  # corrupt/unpicklable entry: miss, don't die
+            logger.warning("disk fit cache: dropping unreadable %s (%s)", path, e)
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return None
+        logger.info("disk fit cache: hit %s", key)
+        return fitted
+
+    def put(self, key: str, fitted: Any) -> None:
+        # Transformer.__getstate__ drops jit caches during pickling, so the
+        # live object (still in the session cache / user's hands) keeps its
+        # warm compilation.
+        path = self._path(key)
+        if os.path.exists(path):
+            return
+        try:
+            fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    pickle.dump(fitted, f)
+                os.replace(tmp, path)  # atomic: concurrent writers race safely
+            except BaseException:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                raise
+        except Exception as e:  # persistence is best-effort
+            logger.warning("disk fit cache: could not persist %s (%s)", key, e)

@@ -19,7 +19,14 @@ from __future__ import annotations
 
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
-from keystone_tpu.workflow.graph import Graph, GraphId, NodeId, SourceId, structural_hash
+from keystone_tpu.workflow.graph import (
+    Graph,
+    GraphId,
+    NodeId,
+    SourceId,
+    structural_digest,
+    structural_hash,
+)
 from keystone_tpu.workflow.operators import (
     DelegatingOperator,
     EstimatorOperator,
@@ -56,9 +63,34 @@ class GraphExecutor:
             if isinstance(t, SourceId):
                 _no_sources(t)
         hmemo: Dict[GraphId, int] = {}
+        dmemo: Dict[GraphId, Any] = {}
 
         def h_of(nid: GraphId) -> int:
             return structural_hash(graph, nid, _no_sources, hmemo)
+
+        def d_of(nid: GraphId):
+            if self.env.disk_cache is None:
+                return None
+            dk = structural_digest(graph, nid, dmemo)
+            if dk is None:
+                return None
+            # Salt with the numeric regime: a fit computed under different
+            # dtype/precision settings is a different artifact. Platform is
+            # deliberately NOT included — CPU/TPU runs are treated as
+            # numerically equivalent the way the reference treats local[n]
+            # vs cluster (SURVEY.md §4 [unverified]).
+            from keystone_tpu.config import config
+            from keystone_tpu.workflow.fingerprint import digest_tree
+
+            return digest_tree(
+                (
+                    "v1",
+                    dk,
+                    config.default_dtype,
+                    config.accum_dtype,
+                    config.solver_precision,
+                )
+            )
 
         values: Dict[GraphId, Any] = {}
         by_hash: Dict[int, Any] = {}
@@ -78,6 +110,12 @@ class GraphExecutor:
             hit = None
             if isinstance(op, EstimatorOperator) and h in self.env.fit_cache:
                 hit = self.env.fit_cache[h][0]
+            elif isinstance(op, EstimatorOperator):
+                dk = d_of(gid)
+                if dk is not None:
+                    hit = self.env.disk_cache.get(dk)
+                    if hit is not None:  # promote to the session cache too
+                        self._cache_fit(graph, gid, h, op, hit)
             elif h in self.env.node_cache:
                 hit = self.env.node_cache[h][0]
             if hit is not None:
@@ -106,6 +144,9 @@ class GraphExecutor:
             values[nid] = by_hash[h] = out
             if isinstance(op, EstimatorOperator):
                 self._cache_fit(graph, nid, h, op, out)
+                dk = d_of(nid)
+                if dk is not None:
+                    self.env.disk_cache.put(dk, out)
             if getattr(op, "persist", False):
                 self.env.node_cache[h] = (out, self._prefix_pins(graph, nid))
         return values
@@ -194,6 +235,9 @@ class PipelineEnv:
     _instance: Optional["PipelineEnv"] = None
 
     def __init__(self):
+        import os
+
+        from keystone_tpu.config import config
         from keystone_tpu.workflow.optimizer import default_optimizer
 
         self.optimizer = default_optimizer()
@@ -202,6 +246,19 @@ class PipelineEnv:
         self.fit_cache: Dict[int, Any] = {}
         # structural hash -> persisted value (auto-cache rule / Cacher nodes)
         self.node_cache: Dict[int, Any] = {}
+        # Cross-process fitted-prefix store, keyed by content digest.
+        # Env presence (not truthiness) decides precedence: an exported
+        # empty var explicitly disables the store.
+        if "KEYSTONE_CACHE_DIR" in os.environ:
+            cache_dir = os.environ["KEYSTONE_CACHE_DIR"]
+        else:
+            cache_dir = config.cache_dir
+        if cache_dir:
+            from keystone_tpu.workflow.disk_cache import DiskFitCache
+
+            self.disk_cache: Optional["DiskFitCache"] = DiskFitCache(cache_dir)
+        else:
+            self.disk_cache = None
 
     @classmethod
     def get(cls) -> "PipelineEnv":

@@ -1,0 +1,145 @@
+"""Content-stable signatures and digests — cross-process cache keys.
+
+The in-process prefix memoization (workflow/graph.py structural_hash) keys on
+Python ``hash()`` of signature trees, which is per-process (string hashing is
+salted, and id()-based fallbacks are only meaningful while the object lives).
+To persist fitted prefixes ACROSS processes — the reference's prefix-state
+reuse surviving reruns (SURVEY.md §2.1 auto-caching + §5 checkpoint rows
+[unverified]) — we need keys derived purely from content.
+
+Two pieces:
+
+- ``stable_value`` canonicalizes an arbitrary hyperparameter tree into
+  primitives. Values it cannot stabilize become ``("unstable", id(v),
+  UNSTABLE)`` — still unique in-process (so the session cache keeps working)
+  but *poisoned* for persistence.
+- ``digest_tree`` folds a canonical tree into a hex blake2b digest, returning
+  ``None`` when the tree is poisoned. Operators fold dependency digests
+  through ``prefix_digest`` exactly the way ``prefix_hash`` folds hashes, so
+  fused and unfused chains produce identical digests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Any, Optional
+
+import numpy as np
+
+
+class _Unstable:
+    """Singleton marking a signature subtree that has no content identity."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:
+        return "<UNSTABLE>"
+
+
+UNSTABLE = _Unstable()
+
+
+def _is_jax_array(v: Any) -> bool:
+    # Lazy import keeps fingerprinting usable before any backend exists.
+    jax = __import__("jax")
+    return isinstance(v, jax.Array)
+
+
+def array_fingerprint(a: np.ndarray) -> tuple:
+    """Content identity of a numeric array: shape, dtype, blake2b of bytes."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(repr(a.shape).encode())
+    h.update(str(a.dtype).encode())
+    c = np.ascontiguousarray(a)
+    h.update(memoryview(c).cast("B"))
+    return ("ndarray", a.shape, str(a.dtype), h.hexdigest())
+
+
+def stable_value(v: Any) -> Any:
+    """Canonicalize ``v`` into a tree of primitives; unknown objects keep
+    their id (in-process uniqueness) but carry the UNSTABLE poison."""
+    if v is None or isinstance(v, (bool, int, float, str, bytes, _Unstable)):
+        return v
+    if isinstance(v, type):
+        return ("class", v.__module__, v.__qualname__)
+    if isinstance(v, (tuple, list)):
+        return ("seq", tuple(stable_value(x) for x in v))
+    if isinstance(v, dict):
+        if not all(isinstance(k, str) for k in v):
+            return ("unstable", id(v), UNSTABLE)
+        return (
+            "dict",
+            tuple((k, stable_value(v[k])) for k in sorted(v)),
+        )
+    if _is_jax_array(v):
+        v = np.asarray(v)  # one host fetch, then content-addressed like numpy
+    if isinstance(v, np.ndarray):
+        if v.dtype.kind in "biufc":
+            return array_fingerprint(v)
+        return ("unstable", id(v), UNSTABLE)
+    if isinstance(v, (np.integer, np.floating, np.bool_)):
+        return ("npscalar", str(v.dtype), v.item())
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return (
+            "dataclass",
+            stable_value(type(v)),
+            tuple(
+                (f.name, stable_value(getattr(v, f.name)))
+                for f in dataclasses.fields(v)
+            ),
+        )
+    return ("unstable", id(v), UNSTABLE)
+
+
+def is_stable(tree: Any) -> bool:
+    if isinstance(tree, _Unstable):
+        return False
+    if isinstance(tree, tuple):
+        return all(is_stable(x) for x in tree)
+    return True
+
+
+def _encode(v: Any, h) -> bool:
+    """Fold ``v`` into hasher ``h`` with type tags; False when poisoned."""
+    if isinstance(v, _Unstable):
+        return False
+    if v is None:
+        h.update(b"N")
+    elif isinstance(v, bool):
+        h.update(b"b1" if v else b"b0")
+    elif isinstance(v, int):
+        h.update(b"i" + str(v).encode())
+    elif isinstance(v, float):
+        h.update(b"f" + repr(v).encode())
+    elif isinstance(v, str):
+        b = v.encode()
+        h.update(b"s" + str(len(b)).encode() + b":" + b)
+    elif isinstance(v, bytes):
+        h.update(b"y" + str(len(v)).encode() + b":" + v)
+    elif isinstance(v, tuple):
+        h.update(b"T" + str(len(v)).encode() + b":")
+        for x in v:
+            if not _encode(x, h):
+                return False
+    elif isinstance(v, type):
+        return _encode(stable_value(v), h)
+    elif isinstance(v, np.ndarray):
+        return _encode(array_fingerprint(v), h)
+    elif isinstance(v, (np.integer, np.floating, np.bool_)):
+        return _encode(stable_value(v), h)
+    else:
+        # Raw signature trees may carry objects stable_value knows about.
+        sv = stable_value(v)
+        if isinstance(sv, tuple) and sv and sv[0] == "unstable":
+            return False
+        return _encode(sv, h)
+    return True
+
+
+def digest_tree(tree: Any) -> Optional[str]:
+    """Hex digest of a canonical tree, or None if any part is unstable."""
+    h = hashlib.blake2b(digest_size=20)
+    if not _encode(tree, h):
+        return None
+    return h.hexdigest()

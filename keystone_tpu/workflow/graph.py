@@ -203,3 +203,28 @@ def structural_hash(
         return h
 
     return rec(target)
+
+
+def structural_digest(
+    graph: Graph,
+    target: GraphId,
+    _memo: Dict[GraphId, Any] | None = None,
+) -> str | None:
+    """Content-stable prefix digest of ``target`` — the cross-process cache
+    key. None when any operator in the prefix lacks content identity, or the
+    prefix reaches a free source (an unbound input has no content)."""
+    memo: Dict[GraphId, Any] = {} if _memo is None else _memo
+
+    def rec(gid: GraphId):
+        if gid in memo:
+            return memo[gid]
+        if isinstance(gid, SourceId):
+            d = None
+        else:
+            op = graph.operators[gid]
+            dep_d = tuple(rec(x) for x in graph.dependencies[gid])
+            d = op.prefix_digest(dep_d)
+        memo[gid] = d
+        return d
+
+    return rec(target)

@@ -21,12 +21,25 @@ class Operator:
         raise NotImplementedError
 
     def signature(self) -> Any:
-        """Identity key used for structural prefix hashing."""
-        return ("op", id(self))
+        """Identity key used for structural prefix hashing. The id fallback
+        carries the UNSTABLE poison so heap addresses can never leak into a
+        cross-process digest (a recycled id must not produce a disk hit)."""
+        from keystone_tpu.workflow.fingerprint import UNSTABLE
+
+        return ("op", id(self), UNSTABLE)
 
     def prefix_hash(self, dep_hashes) -> int:
         """Structural hash of this node given its dependency prefix hashes."""
         return hash((self.signature(), tuple(dep_hashes)))
+
+    def prefix_digest(self, dep_digests):
+        """Content-stable digest of this node's prefix (the cross-process
+        analog of ``prefix_hash``), or None when any part is id-based."""
+        from keystone_tpu.workflow.fingerprint import digest_tree
+
+        if any(d is None for d in dep_digests):
+            return None
+        return digest_tree((self.signature(), tuple(dep_digests)))
 
     def pinned_objects(self):
         """Objects whose id() feeds this operator's signature. Cache entries
@@ -88,7 +101,29 @@ class DatasetOperator(Operator):
         return jax.device_put(data, data_sharding())
 
     def signature(self):
-        return ("dataset", id(self.data))
+        """Content fingerprint for numeric host arrays (hashed once per
+        operator), id fallback otherwise. Content identity means a rerun —
+        or another process — that splices byte-identical data shares cached
+        fits downstream."""
+        sig = getattr(self, "_sig_cache", None)
+        if sig is None:
+            import jax
+            import numpy as np
+
+            from keystone_tpu.workflow.fingerprint import (
+                UNSTABLE,
+                array_fingerprint,
+            )
+
+            data = self.data
+            if isinstance(data, jax.Array):
+                data = np.asarray(data)
+            if isinstance(data, np.ndarray) and data.dtype.kind in "biufc":
+                sig = ("dataset", array_fingerprint(data))
+            else:
+                sig = ("dataset", id(self.data), UNSTABLE)
+            self._sig_cache = sig
+        return sig
 
     def pinned_objects(self):
         return (self.data,)
@@ -107,7 +142,9 @@ class DatumOperator(Operator):
         return self.datum
 
     def signature(self):
-        return ("datum", id(self.datum))
+        from keystone_tpu.workflow.fingerprint import UNSTABLE
+
+        return ("datum", id(self.datum), UNSTABLE)
 
     def pinned_objects(self):
         return (self.datum,)
@@ -133,6 +170,11 @@ class TransformerOperator(Operator):
         # chain it replaced (FusedTransformer folds stage-by-stage).
         return self.transformer.chain_hash(dep_hashes[0])
 
+    def prefix_digest(self, dep_digests):
+        if dep_digests[0] is None:
+            return None
+        return self.transformer.chain_digest(dep_digests[0])
+
     def pinned_objects(self):
         return (self.transformer,)
 
@@ -151,7 +193,24 @@ class EstimatorOperator(Operator):
         return self.estimator.fit(*deps)
 
     def signature(self):
-        return ("estimator", id(self.estimator))
+        """Content-stable when the estimator's signature is (class +
+        hyperparams, see pipeline.Estimator.signature); id-keyed otherwise.
+        Memoized at first use so an estimator that mutates its own fields
+        while fitting keeps one identity for this node — otherwise the
+        post-fit signature could never hit the entry cached under the
+        pre-fit one. The estimator stays pinned either way, so id-based
+        fields can never alias across its lifetime."""
+        sig = getattr(self, "_sig_cache", None)
+        if sig is None:
+            sig_fn = getattr(self.estimator, "signature", None)
+            if sig_fn is not None:
+                sig = ("estimator", sig_fn())
+            else:
+                from keystone_tpu.workflow.fingerprint import UNSTABLE
+
+                sig = ("estimator", id(self.estimator), UNSTABLE)
+            self._sig_cache = sig
+        return sig
 
     def pinned_objects(self):
         return (self.estimator,)

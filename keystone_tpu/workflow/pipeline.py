@@ -99,6 +99,14 @@ class Transformer:
             object.__setattr__(self, "_jit_cache", fn)
         return fn
 
+    def __getstate__(self):
+        """Pickle without the per-instance jit cache (jitted callables are
+        unpicklable; they rebuild lazily after load). Non-mutating, so
+        persisting a live fitted transformer keeps its warm compilation."""
+        state = dict(self.__dict__)
+        state.pop("_jit_cache", None)
+        return state
+
     def signature(self) -> Any:
         """Key for structural prefix hashing; object identity by default.
 
@@ -106,9 +114,15 @@ class Transformer:
         ``stable_signature`` from their current parameters, or (factory-
         created nodes) install one on ``self._sig`` — then two separately-
         constructed-but-identical nodes hash (and cache) alike, including
-        across pipeline rebuilds in one session.
+        across pipeline rebuilds in one session. The id fallback carries the
+        UNSTABLE poison so it can never masquerade as persistable content.
         """
-        return getattr(self, "_sig", id(self))
+        sig = getattr(self, "_sig", None)
+        if sig is not None:
+            return sig
+        from keystone_tpu.workflow.fingerprint import UNSTABLE
+
+        return ("t-id", id(self), UNSTABLE)
 
     def stable_signature(self, *params) -> tuple:
         """Content-based signature: concrete class + constructor params.
@@ -120,6 +134,14 @@ class Transformer:
         """Prefix hash of applying this transformer to an input with hash
         ``h_in``. FusedTransformer folds so fusion never changes hashes."""
         return hash((("transformer", self.signature()), (h_in,)))
+
+    def chain_digest(self, d_in):
+        """Content-stable fold mirroring ``chain_hash`` (None = unstable)."""
+        if d_in is None:
+            return None
+        from keystone_tpu.workflow.fingerprint import digest_tree
+
+        return digest_tree((("transformer", self.signature()), (d_in,)))
 
     # -- composition sugar -------------------------------------------------
 
@@ -177,6 +199,11 @@ class FusedTransformer(Transformer):
             h_in = s.chain_hash(h_in)
         return h_in
 
+    def chain_digest(self, d_in):
+        for s in self.stages:
+            d_in = s.chain_digest(d_in)
+        return d_in
+
     def __repr__(self):
         return "Fused(" + " | ".join(type(s).__name__ for s in self.stages) + ")"
 
@@ -197,8 +224,30 @@ def _splice_data(graph: Graph, data: Any):
     return g, nid
 
 
+def _estimator_signature(est) -> tuple:
+    """Content signature: class + public hyperparameter fields.
+
+    Fields starting with ``_`` and names in ``_signature_exclude`` (mutable
+    outputs like diagnostics set at fit time) are skipped. Values without a
+    content identity poison the tree — the in-process cache still works via
+    their ids, but nothing gets persisted under an unstable key.
+    """
+    from keystone_tpu.workflow.fingerprint import stable_value
+
+    exclude = set(getattr(est, "_signature_exclude", ()))
+    fields = {
+        k: v
+        for k, v in est.__dict__.items()
+        if not k.startswith("_") and k not in exclude
+    }
+    return ("est", stable_value(type(est)), stable_value(fields))
+
+
 class Estimator:
     """``fit(data) -> Transformer``. Ref: workflow/Estimator.scala [unverified]."""
+
+    def signature(self) -> tuple:
+        return _estimator_signature(self)
 
     def fit(self, data) -> Transformer:
         raise NotImplementedError
@@ -223,6 +272,9 @@ class LabelEstimator:
 
     Ref: workflow/LabelEstimator.scala [unverified].
     """
+
+    def signature(self) -> tuple:
+        return _estimator_signature(self)
 
     def fit(self, data, labels) -> Transformer:
         raise NotImplementedError

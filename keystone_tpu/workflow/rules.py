@@ -7,6 +7,7 @@ Ref: src/main/scala/workflow/{AutoCacheRule,NodeOptimizationRule}.scala
 
 from __future__ import annotations
 
+import weakref
 from typing import Dict, List, Sequence
 
 from keystone_tpu.config import config
@@ -28,7 +29,18 @@ class NodeOptimizationRule(Rule):
     estimator``; shapes are read from directly-attached dataset nodes (the
     common with_data case). Estimators whose inputs are deeper subgraphs
     keep their fit-time dispatch (e.g. LeastSquaresEstimator's cost model).
+
+    The concrete replacement is memoized per (estimator, shapes): every
+    optimizer pass over any copy of the graph swaps in the SAME concrete
+    instance, so the replaced node's structural hash — and therefore its fit
+    cache entry — is stable across executions.
     """
+
+    def __init__(self):
+        self._memo: Dict[tuple, tuple] = {}
+
+    def clear_cache(self) -> None:
+        self._memo.clear()
 
     def apply(self, graph: Graph, targets: Sequence[GraphId]) -> Graph:
         out = graph
@@ -49,7 +61,27 @@ class NodeOptimizationRule(Rule):
                 shapes.append(shape)
             if not shapes or shapes[0] is None:
                 continue
-            concrete = optimize(*shapes)
+            key = (id(op.estimator), tuple(shapes))
+            memoized = self._memo.get(key)
+            if memoized is not None and memoized[0]() is op.estimator:
+                concrete = memoized[1]
+            else:
+                concrete = optimize(*shapes)
+                # The original is held weakly with eviction: when the user
+                # drops their pipeline the memo entry (and the concrete
+                # estimator it pins, and in turn that estimator's fit-cache
+                # entry with its pinned training data) is freed. A dead or
+                # recycled id can never serve a stale concrete because the
+                # weakref identity check above fails first.
+                try:
+                    ref = weakref.ref(
+                        op.estimator,
+                        lambda _r, key=key: self._memo.pop(key, None),
+                    )
+                except TypeError:  # not weak-referenceable: don't memoize
+                    ref = None
+                if ref is not None:
+                    self._memo[key] = (ref, concrete)
             if concrete is not None and concrete is not op.estimator:
                 out = out.replace_node(
                     nid, EstimatorOperator(concrete), graph.dependencies[nid]

@@ -26,6 +26,10 @@ import pytest  # noqa: E402
 assert len(jax.devices()) == 8, f"expected 8 CPU devices, got {jax.devices()}"
 
 
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running test")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

@@ -1,0 +1,256 @@
+"""Cross-process fitted-prefix reuse: content-stable signatures, structural
+digests, the on-disk fit store, and NodeOptimizationRule memoization.
+
+Ref: the reference's prefix-state reuse across fits (SURVEY.md §2.1
+auto-caching row, §5 checkpoint/resume row) [unverified].
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from keystone_tpu.nodes.learning import LeastSquaresEstimator
+from keystone_tpu.nodes.stats import StandardScaler
+from keystone_tpu.workflow import LabelEstimator, PipelineEnv, Transformer
+from keystone_tpu.workflow.fingerprint import (
+    UNSTABLE,
+    digest_tree,
+    is_stable,
+    stable_value,
+)
+from keystone_tpu.workflow.graph import structural_digest
+
+
+def _data(seed=0, n=256, d=16, k=3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    Y = rng.normal(size=(n, k)).astype(np.float32)
+    return X, Y
+
+
+class _MatTransformer(Transformer):
+    jittable = False
+
+    def __init__(self, W):
+        self.W = W
+
+    def apply_batch(self, B):
+        return np.asarray(B) @ self.W
+
+
+class CountingEstimator(LabelEstimator):
+    """Closed-form ridge whose fits are counted — the cache-hit oracle."""
+
+    fits = 0
+
+    def __init__(self, lam: float = 1e-3):
+        self.lam = lam
+
+    def fit(self, data, labels):
+        type(self).fits += 1
+        X = np.asarray(data)
+        Y = np.asarray(labels)
+        W = np.linalg.solve(
+            X.T @ X + self.lam * np.eye(X.shape[1]), X.T @ Y
+        ).astype(np.float32)
+        return _MatTransformer(W)
+
+
+class TestStableSignatures:
+    def test_identical_estimators_share_signature(self):
+        a = LeastSquaresEstimator(lam=0.5, block_size=128)
+        b = LeastSquaresEstimator(lam=0.5, block_size=128)
+        assert a.signature() == b.signature()
+        assert is_stable(stable_value(a.signature()))
+
+    def test_hyperparams_distinguish(self):
+        a = LeastSquaresEstimator(lam=0.5)
+        b = LeastSquaresEstimator(lam=0.25)
+        assert a.signature() != b.signature()
+
+    def test_fit_time_diagnostics_do_not_change_signature(self):
+        est = LeastSquaresEstimator(lam=0.5)
+        before = est.signature()
+        X, Y = _data()
+        est.fit(X, Y)
+        assert est.last_choice is not None  # the excluded mutable field moved
+        assert est.signature() == before
+
+    def test_unknown_objects_poison_but_stay_unique(self):
+        o1, o2 = object(), object()  # keep both alive: distinct ids
+        tree_a = stable_value({"fn": o1})
+        tree_b = stable_value({"fn": o2})
+        assert not is_stable(tree_a)
+        assert tree_a != tree_b  # id keeps in-process uniqueness
+        assert digest_tree(tree_a) is None
+
+    def test_array_content_addresses(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        y = x.copy()
+        assert stable_value(x) == stable_value(y)
+        z = y.copy()
+        z[0, 0] += 1
+        assert stable_value(x) != stable_value(z)
+
+
+class TestStructuralDigest:
+    def test_digest_stable_across_rebuilds(self):
+        X, Y = _data()
+
+        def build():
+            p = StandardScaler().with_data(X.copy()).and_then(
+                LeastSquaresEstimator(lam=1e-3), X.copy(), Y.copy()
+            )
+            return p
+
+        from keystone_tpu.workflow.operators import EstimatorOperator
+
+        digests = []
+        for _ in range(2):
+            p = build()
+            g = p.graph
+            for nid in g.reachable([p.sink]):
+                if isinstance(g.operators[nid], EstimatorOperator):
+                    digests.append(structural_digest(g, nid))
+        assert digests and all(d is not None for d in digests)
+        # Both estimator nodes (scaler + solver) match across rebuilds.
+        assert digests[: len(digests) // 2] == digests[len(digests) // 2 :]
+
+    def test_non_array_data_disables_digest(self):
+        est = CountingEstimator()
+        _, Y = _data(n=3)
+        p = est.with_data([b"not", b"an", b"array"], Y)
+        from keystone_tpu.workflow.operators import EstimatorOperator
+
+        g = p.graph
+        (enid,) = [
+            nid
+            for nid in g.reachable([p.sink])
+            if isinstance(g.operators[nid], EstimatorOperator)
+        ]
+        assert structural_digest(g, enid) is None
+
+
+class TestSessionCacheCrossInstance:
+    def test_identical_pipelines_fit_once(self):
+        X, Y = _data()
+        CountingEstimator.fits = 0
+        p1 = CountingEstimator(lam=1e-3).with_data(X.copy(), Y.copy()).fit()
+        p2 = CountingEstimator(lam=1e-3).with_data(X.copy(), Y.copy()).fit()
+        assert CountingEstimator.fits == 1
+        out1 = np.asarray(p1.apply(X).get())
+        out2 = np.asarray(p2.apply(X).get())
+        np.testing.assert_allclose(out1, out2)
+
+    def test_different_data_refits(self):
+        X, Y = _data(seed=0)
+        X2, Y2 = _data(seed=1)
+        CountingEstimator.fits = 0
+        CountingEstimator(lam=1e-3).with_data(X, Y).fit()
+        CountingEstimator(lam=1e-3).with_data(X2, Y2).fit()
+        assert CountingEstimator.fits == 2
+
+
+class TestDiskCache:
+    def test_second_session_hits_disk(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KEYSTONE_CACHE_DIR", str(tmp_path))
+        X, Y = _data()
+        CountingEstimator.fits = 0
+
+        PipelineEnv.reset()
+        p = CountingEstimator(lam=1e-3).with_data(X.copy(), Y.copy()).fit()
+        ref = np.asarray(p.apply(X).get())
+        assert CountingEstimator.fits == 1
+        assert any(f.endswith(".fit.pkl") for f in os.listdir(tmp_path))
+
+        PipelineEnv.reset()  # a "new process" as far as session state goes
+        p2 = CountingEstimator(lam=1e-3).with_data(X.copy(), Y.copy()).fit()
+        assert CountingEstimator.fits == 1  # served from disk
+        np.testing.assert_allclose(np.asarray(p2.apply(X).get()), ref)
+
+    def test_corrupt_entry_degrades_to_miss(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KEYSTONE_CACHE_DIR", str(tmp_path))
+        X, Y = _data()
+        CountingEstimator.fits = 0
+        PipelineEnv.reset()
+        CountingEstimator(lam=1e-3).with_data(X.copy(), Y.copy()).fit()
+        (entry,) = [f for f in os.listdir(tmp_path) if f.endswith(".fit.pkl")]
+        (tmp_path / entry).write_bytes(b"corrupt")
+        PipelineEnv.reset()
+        CountingEstimator(lam=1e-3).with_data(X.copy(), Y.copy()).fit()
+        assert CountingEstimator.fits == 2  # refit, no crash
+
+    @pytest.mark.slow
+    def test_cross_process_reuse(self, tmp_path):
+        """The VERDICT regression: a second *process* skips every refit."""
+        script = textwrap.dedent(
+            """
+            import logging, sys
+            import numpy as np
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            logging.basicConfig(level=logging.INFO)
+            from keystone_tpu.nodes.learning import LeastSquaresEstimator
+            from keystone_tpu.nodes.stats import StandardScaler
+
+            rng = np.random.default_rng(0)
+            X = rng.normal(size=(512, 32)).astype(np.float32)
+            Y = rng.normal(size=(512, 4)).astype(np.float32)
+            p = StandardScaler().with_data(X).and_then(
+                LeastSquaresEstimator(lam=1e-3), X, Y
+            ).fit()
+            out = np.asarray(p.apply(X).get())
+            np.save(sys.argv[1], out)
+            """
+        )
+        from keystone_tpu.utils.platform import cpu_mesh_env
+
+        env = cpu_mesh_env(8)
+        env["KEYSTONE_CACHE_DIR"] = str(tmp_path)
+        outs, hits = [], []
+        for i in range(2):
+            out_npy = str(tmp_path / f"out{i}.npy")
+            proc = subprocess.run(
+                [sys.executable, "-c", script, out_npy],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=300,
+                cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            )
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            outs.append(np.load(out_npy))
+            hits.append(proc.stderr.count("disk fit cache: hit"))
+        np.testing.assert_allclose(outs[0], outs[1], rtol=1e-5, atol=1e-5)
+        assert hits[0] == 0
+        # Both estimators (scaler + solver) served from the store, no refits.
+        assert hits[1] == 2
+
+
+class TestNodeOptimizationMemo:
+    def test_concrete_estimator_stable_across_passes(self):
+        from keystone_tpu.workflow.operators import EstimatorOperator
+        from keystone_tpu.workflow.rules import NodeOptimizationRule
+
+        X, Y = _data(n=256, d=16)
+        p = LeastSquaresEstimator(lam=1e-3).with_data(X, Y)
+        rule = NodeOptimizationRule()
+        g1 = rule.apply(p.graph, [p.sink])
+        g2 = rule.apply(p.graph, [p.sink])
+        c1 = [
+            op.estimator
+            for op in g1.operators.values()
+            if isinstance(op, EstimatorOperator)
+            and not isinstance(op.estimator, LeastSquaresEstimator)
+        ]
+        c2 = [
+            op.estimator
+            for op in g2.operators.values()
+            if isinstance(op, EstimatorOperator)
+            and not isinstance(op.estimator, LeastSquaresEstimator)
+        ]
+        assert c1 and c2 and c1[0] is c2[0]
