@@ -68,7 +68,12 @@ def worker(scale_key: str, dtype: str) -> None:
         force_cpu()
     import jax
 
+    from keystone_tpu.config import config
     from keystone_tpu.linalg import RowMatrix, block_coordinate_descent
+
+    # The flag decides the measured mode outright — an ambient
+    # KEYSTONE_SOLVER_DTYPE must never mislabel an f32 measurement.
+    config.solver_storage_dtype = "bfloat16" if dtype == "bf16" else None
 
     p = SCALE[scale_key]
     n, d, k, block, iters = p["n"], p["d"], p["k"], p["block"], p["iters"]
@@ -77,7 +82,9 @@ def worker(scale_key: str, dtype: str) -> None:
     W_true = rng.normal(size=(d, k)).astype(np.float32)
     B = (A @ W_true).astype(np.float32)
 
-    Ma = RowMatrix.from_array(A)
+    from keystone_tpu.linalg.row_matrix import storage_dtype
+
+    Ma = RowMatrix.from_array(A, dtype=storage_dtype())
     Mb = RowMatrix.from_array(B)
 
     def run():
@@ -177,9 +184,8 @@ def main() -> None:
     # --scale default None = pick by backend (tpu scale on a live chip,
     # cpu scale on the fallback); an explicit value wins everywhere.
     ap.add_argument("--scale", choices=list(SCALE), default=None)
-    # bf16 storage / f32 accumulate lands with the solver dtype mode; until
-    # then only f32 exists so the flag can't mislabel a measurement.
-    ap.add_argument("--dtype", choices=["f32"], default="f32")
+    # bf16 = store A in bfloat16, accumulate f32 (config.solver_storage_dtype).
+    ap.add_argument("--dtype", choices=["f32", "bf16"], default="f32")
     ap.add_argument("--probe-timeout", type=float, default=75.0)
     ap.add_argument("--run-timeout", type=float, default=900.0)
     args = ap.parse_args()

@@ -31,6 +31,15 @@ class Config:
     # default matmul precision is bf16-class and loses ~3 decimal digits;
     # solvers need full fp32 ("highest"). Featurization uses the default.
     solver_precision: str = "highest"
+    # Storage dtype for the solver's BIG operands (the feature matrix A and
+    # streamed blocks). None = default_dtype. "bfloat16" is the v5e
+    # throughput mode: A is stored (and streamed) at half the bytes and
+    # every matmul touching it takes the MXU's native bf16-multiply /
+    # f32-accumulate path; grams, Cholesky factors, weights, and residuals
+    # stay in accum_dtype. Set via KEYSTONE_SOLVER_DTYPE or per-run config.
+    solver_storage_dtype: str | None = field(
+        default_factory=lambda: os.environ.get("KEYSTONE_SOLVER_DTYPE") or None
+    )
     # Mesh axis name used for data (row) parallelism throughout.
     data_axis: str = "data"
     # Mesh axis name used for model (feature-block) parallelism.

@@ -17,6 +17,7 @@ from keystone_tpu.linalg.normal_equations import (
 )
 from keystone_tpu.linalg.tsqr import tsqr_r, solve_least_squares_tsqr
 from keystone_tpu.linalg.bcd import (
+    assemble_blocks,
     block_coordinate_descent,
     block_coordinate_descent_streamed,
 )
@@ -24,6 +25,7 @@ from keystone_tpu.linalg.ring_bcd import block_coordinate_descent_ring
 
 __all__ = [
     "RowMatrix",
+    "assemble_blocks",
     "solve_least_squares_normal",
     "solve_least_squares_chunked",
     "tsqr_r",

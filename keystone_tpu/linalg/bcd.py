@@ -31,7 +31,12 @@ from jax.scipy.linalg import cho_factor, cho_solve
 from jax.sharding import Mesh, PartitionSpec as P
 
 from keystone_tpu.config import config
-from keystone_tpu.linalg.row_matrix import RowMatrix, _precision
+from keystone_tpu.linalg.row_matrix import (
+    RowMatrix,
+    _precision,
+    solver_matmul,
+    storage_dtype,
+)
 
 
 # -- shared per-shard solver math (single source for every shard_map body) --
@@ -42,16 +47,16 @@ def _local_weighted(a_b, w_rows, weighted: bool):
 
 
 def _local_gram_chol(a_b, aw, lam, precision, axis):
-    gram = lax.psum(jnp.matmul(aw.T, a_b, precision=precision), axis)
+    gram = lax.psum(solver_matmul(aw.T, a_b, precision), axis)
     b = a_b.shape[1]
     return jnp.linalg.cholesky(gram + lam * jnp.eye(b, dtype=gram.dtype))
 
 
 def _local_solve_update(a_b, aw, chol, r, w_b, precision, axis):
-    r_plus = r + jnp.matmul(a_b, w_b, precision=precision)
-    rhs = lax.psum(jnp.matmul(aw.T, r_plus, precision=precision), axis)
+    r_plus = r + solver_matmul(a_b, w_b, precision)
+    rhs = lax.psum(solver_matmul(aw.T, r_plus, precision), axis)
     w_b_new = cho_solve((chol, True), rhs)
-    r_new = r_plus - jnp.matmul(a_b, w_b_new, precision=precision)
+    r_new = r_plus - solver_matmul(a_b, w_b_new, precision)
     return r_new, w_b_new
 
 
@@ -124,17 +129,17 @@ def _block_update_fn(mesh: Mesh, axis: str, precision, weighted: bool):
 
     def local(a_b, r, w_b, lam, w_rows):
         # r is the current residual B - A W (row-sharded).
-        r_plus = r + jnp.matmul(a_b, w_b, precision=precision)
+        r_plus = r + solver_matmul(a_b, w_b, precision)
         if weighted:
             aw = a_b * w_rows[:, None]
         else:
             aw = a_b
-        gram = lax.psum(jnp.matmul(aw.T, a_b, precision=precision), axis)
-        rhs = lax.psum(jnp.matmul(aw.T, r_plus, precision=precision), axis)
+        gram = lax.psum(solver_matmul(aw.T, a_b, precision), axis)
+        rhs = lax.psum(solver_matmul(aw.T, r_plus, precision), axis)
         b = a_b.shape[1]
         c, low = cho_factor(gram + lam * jnp.eye(b, dtype=gram.dtype))
         w_b_new = cho_solve((c, low), rhs)
-        r_new = r_plus - jnp.matmul(a_b, w_b_new, precision=precision)
+        r_new = r_plus - solver_matmul(a_b, w_b_new, precision)
         return r_new, w_b_new
 
     sm = shard_map(
@@ -178,7 +183,10 @@ def block_coordinate_descent(
     mesh, axis = A.mesh, config.data_axis
     d = A.data.shape[1]
     k = B.data.shape[1]
+    # A may be stored bf16 (throughput mode); solver state — weights,
+    # residual, lam, grams — always lives in the accumulation dtype.
     dtype = A.data.dtype
+    cdtype = jnp.dtype(config.accum_dtype)
     blocks = [(s, min(s + block_size, d)) for s in range(0, d, block_size)]
 
     weighted = row_weights is not None
@@ -196,20 +204,21 @@ def block_coordinate_descent(
         )
 
     if cache_grams is None:
-        itemsize = jnp.dtype(dtype).itemsize
+        itemsize = jnp.dtype(cdtype).itemsize
         factor_bytes = sum((e - s) ** 2 for s, e in blocks) * itemsize
         cache_grams = num_iters > 1 and factor_bytes < config.hbm_budget_bytes // 4
     update = _block_update_fn(mesh, axis, _precision(), weighted)
-    lam_arr = jnp.asarray(lam, dtype=dtype)
+    lam_arr = jnp.asarray(lam, dtype=cdtype)
 
-    W = [jnp.zeros((e - s, k), dtype=dtype) for s, e in blocks]
-    R = B.data.astype(dtype)
+    W = [jnp.zeros((e - s, k), dtype=cdtype) for s, e in blocks]
+    R = B.data.astype(cdtype)
     sharding = jax.sharding.NamedSharding(mesh, P(axis))
     fingerprint = None
     if checkpoint_dir is not None:
         fingerprint = _make_fingerprint(
             B, d, block_size, lam, weighted,
             a_probe=float(jnp.sum(A.data[0]) + jnp.sum(A.data[A.n - 1])),
+            a_dtype=dtype,
         )
     start_epoch, W, R = _resume_or_default(
         checkpoint_dir, fingerprint, W, R, sharding
@@ -260,11 +269,19 @@ def block_coordinate_descent(
 
 
 def _make_fingerprint(
-    B: RowMatrix, d: int, block_size: int, lam, weighted: bool, a_probe: float
+    B: RowMatrix,
+    d: int,
+    block_size: int,
+    lam,
+    weighted: bool,
+    a_probe: float,
+    a_dtype,
 ) -> dict:
     """Problem identity for checkpoint binding. Probes use LOGICAL rows
     (first and last real row), so the device-resident and host-streamed
-    paths produce identical fingerprints and can resume each other."""
+    paths produce identical fingerprints and can resume each other. The
+    storage dtype is part of the identity — an f32 solve must not resume a
+    bf16 one (mixed-precision epochs with no warning)."""
     return {
         "rows": B.padded_rows,
         "n": B.n,
@@ -273,6 +290,7 @@ def _make_fingerprint(
         "block_size": block_size,
         "lam": float(lam),
         "weighted": weighted,
+        "a_dtype": str(jnp.dtype(a_dtype)),
         "a_probe": a_probe,
         "b_probe": float(jnp.sum(B.data[0]) + jnp.sum(B.data[B.n - 1])),
     }
@@ -353,8 +371,9 @@ def _restore_latest(ckpt_dir: str, fingerprint):
     return int(tree["epoch"]), tree["W"], tree["R"]
 
 
-def assemble_blocks(W: List[jax.Array], blocks: List[Tuple[int, int]]) -> jax.Array:
-    """Concatenate per-block solutions into the full (d, k) matrix."""
+def assemble_blocks(W: List[jax.Array]) -> jax.Array:
+    """Concatenate per-block solutions into the full (d, k) matrix (blocks
+    are contiguous ascending column ranges by construction)."""
     return jnp.concatenate(W, axis=0)
 
 
@@ -383,7 +402,10 @@ def block_coordinate_descent_streamed(
         )
     d = A_host.shape[1]
     k = B.data.shape[1]
-    dtype = jnp.dtype(config.default_dtype)
+    # Streamed blocks take the storage dtype (bf16 halves H2D traffic in
+    # throughput mode); solver state stays in the accumulation dtype.
+    dtype = storage_dtype()
+    cdtype = jnp.dtype(config.accum_dtype)
     blocks = [(s, min(s + block_size, d)) for s in range(0, d, block_size)]
     nb = len(blocks)
     pad = B.padded_rows - A_host.shape[0]
@@ -407,17 +429,18 @@ def block_coordinate_descent_streamed(
 
     first = _first_epoch_update_fn(mesh, axis, _precision(), weighted)
     cached = _cached_block_update_fn(mesh, axis, _precision(), weighted)
-    lam_arr = jnp.asarray(lam, dtype=dtype)
+    lam_arr = jnp.asarray(lam, dtype=cdtype)
     throttle = jax.default_backend() == "cpu"
 
-    W = [jnp.zeros((e - s, k), dtype=dtype) for s, e in blocks]
+    W = [jnp.zeros((e - s, k), dtype=cdtype) for s, e in blocks]
     chols: List[Optional[jax.Array]] = [None] * nb
-    R = B.data.astype(dtype)
+    R = B.data.astype(cdtype)
     fingerprint = None
     if checkpoint_dir is not None:
         fingerprint = _make_fingerprint(
             B, d, block_size, lam, weighted,
             a_probe=float(A_host[0].sum() + A_host[-1].sum()),
+            a_dtype=dtype,
         )
     # On resume, Cholesky factors rebuild lazily: the `first` update at the
     # resumed epoch recomputes them as part of a normal update.

@@ -59,10 +59,12 @@ def solve_least_squares_chunked(
     """
     gram = None
     atb = None
+    from keystone_tpu.linalg.row_matrix import storage_dtype
+
     for X_chunk, Y_chunk in batches:
         if Y_chunk is None:
             raise ValueError("chunked solve needs labeled batches")
-        A = RowMatrix.from_array(X_chunk)
+        A = RowMatrix.from_array(X_chunk, dtype=storage_dtype())
         B = RowMatrix.from_array(Y_chunk)
         g, ab = A.gram_and_atb(B)  # fused: one read of the chunk
         gram = g if gram is None else gram + g

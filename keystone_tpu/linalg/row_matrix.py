@@ -30,12 +30,34 @@ def _precision():
     }[config.solver_precision]
 
 
+def storage_dtype():
+    """Dtype for the solver's big operands (config.solver_storage_dtype)."""
+    return jnp.dtype(config.solver_storage_dtype or config.default_dtype)
+
+
+def solver_matmul(x, y, precision):
+    """Matmul on the solver path, dtype-aware.
+
+    When either operand is stored in bfloat16 (the throughput mode), both
+    are fed to the MXU as bf16 with f32 accumulation — its native fast path
+    (one pass, full accumulator width). Full-width operands keep the
+    configured solver precision (HIGHEST = 6-pass bf16 emulation of f32).
+    """
+    if x.dtype == jnp.bfloat16 or y.dtype == jnp.bfloat16:
+        return jnp.matmul(
+            x.astype(jnp.bfloat16),
+            y.astype(jnp.bfloat16),
+            preferred_element_type=jnp.dtype(config.accum_dtype),
+        )
+    return jnp.matmul(x, y, precision=precision)
+
+
 @lru_cache(maxsize=None)
 def _gram_fn(mesh: Mesh, axis: str, precision):
     @jax.jit
     @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P())
     def gram(a):
-        return lax.psum(jnp.matmul(a.T, a, precision=precision), axis)
+        return lax.psum(solver_matmul(a.T, a, precision), axis)
 
     return gram
 
@@ -45,7 +67,7 @@ def _atb_fn(mesh: Mesh, axis: str, precision):
     @jax.jit
     @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P())
     def atb(a, b):
-        return lax.psum(jnp.matmul(a.T, b, precision=precision), axis)
+        return lax.psum(solver_matmul(a.T, b, precision), axis)
 
     return atb
 
@@ -57,8 +79,8 @@ def _gram_and_atb_fn(mesh: Mesh, axis: str, precision):
     def gram_and_atb(a, b):
         # One program: a is read from HBM once for both reductions.
         return (
-            lax.psum(jnp.matmul(a.T, a, precision=precision), axis),
-            lax.psum(jnp.matmul(a.T, b, precision=precision), axis),
+            lax.psum(solver_matmul(a.T, a, precision), axis),
+            lax.psum(solver_matmul(a.T, b, precision), axis),
         )
 
     return gram_and_atb
@@ -69,7 +91,7 @@ def _matmul_fn(mesh: Mesh, axis: str, precision):
     @jax.jit
     @partial(shard_map, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(axis))
     def mm(a, w):
-        return jnp.matmul(a, w, precision=precision)
+        return solver_matmul(a, w, precision)
 
     return mm
 

@@ -147,7 +147,9 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                 y_mean = (weights[:, None] * Y).sum(axis=0) / wsum
             X = X - x_mean
             Y = Y - y_mean
-        A = RowMatrix.from_array(X)
+        from keystone_tpu.linalg.row_matrix import storage_dtype
+
+        A = RowMatrix.from_array(X, dtype=storage_dtype())
         B = RowMatrix.from_array(Y)
         W_blocks, blocks = block_coordinate_descent(
             A,

@@ -52,15 +52,21 @@ class LinearMapEstimator(LabelEstimator):
         self.method = method
 
     def fit(self, data, labels) -> LinearMapper:
+        from keystone_tpu.linalg.row_matrix import storage_dtype
+
         X = jnp.asarray(data)
         Y = jnp.asarray(labels)
         x_mean = X.mean(axis=0)
         y_mean = Y.mean(axis=0)
-        A = RowMatrix.from_array(X - x_mean)
-        B = RowMatrix.from_array(Y - y_mean)
         if self.method == "tsqr":
+            # QR is storage-dtype-sensitive; TSQR keeps full width.
+            A = RowMatrix.from_array(X - x_mean)
+            B = RowMatrix.from_array(Y - y_mean)
             W = solve_least_squares_tsqr(A, B, self.lam)
         else:
+            # Normal equations: A may store bf16 (gram accumulates f32).
+            A = RowMatrix.from_array(X - x_mean, dtype=storage_dtype())
+            B = RowMatrix.from_array(Y - y_mean)
             W = solve_least_squares_normal(A, B, self.lam)
         b = y_mean - x_mean @ W
         return LinearMapper(W, b)

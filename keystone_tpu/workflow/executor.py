@@ -89,6 +89,7 @@ class GraphExecutor:
                     config.default_dtype,
                     config.accum_dtype,
                     config.solver_precision,
+                    config.solver_storage_dtype,
                 )
             )
 
@@ -253,12 +254,22 @@ class PipelineEnv:
             cache_dir = os.environ["KEYSTONE_CACHE_DIR"]
         else:
             cache_dir = config.cache_dir
+        self.disk_cache = None
         if cache_dir:
             from keystone_tpu.workflow.disk_cache import DiskFitCache
 
-            self.disk_cache: Optional["DiskFitCache"] = DiskFitCache(cache_dir)
-        else:
-            self.disk_cache = None
+            try:
+                self.disk_cache: Optional["DiskFitCache"] = DiskFitCache(
+                    cache_dir
+                )
+            except OSError as e:  # uncreatable dir: degrade, never abort
+                import logging
+
+                logging.getLogger("keystone_tpu").warning(
+                    "disk fit cache disabled: cannot create %s (%s)",
+                    cache_dir,
+                    e,
+                )
 
     @classmethod
     def get(cls) -> "PipelineEnv":

@@ -143,8 +143,8 @@ def test_bcd_checkpoint_resume(rng, tmp_path):
         A, B, 8, 4, lam=0.1, checkpoint_dir=ck
     )
     np.testing.assert_allclose(
-        assemble_blocks(W_resumed, blocks),
-        assemble_blocks(W_ref, blocks),
+        assemble_blocks(W_resumed),
+        assemble_blocks(W_ref),
         rtol=1e-4,
         atol=1e-4,
     )
@@ -217,7 +217,7 @@ def test_bcd_checkpoint_rejects_different_problem(rng, tmp_path):
         RowMatrix.from_array(X2), RowMatrix.from_array(Y2), 8, 2, lam=0.1
     )
     np.testing.assert_allclose(
-        assemble_blocks(W2, blocks), assemble_blocks(W_fresh, blocks),
+        assemble_blocks(W2), assemble_blocks(W_fresh),
         rtol=1e-5, atol=1e-5,
     )
 

@@ -108,7 +108,7 @@ def test_bcd_single_block_equals_normal_equations(rng):
     )
     assert blocks == [(0, A.shape[1])]
     np.testing.assert_allclose(
-        assemble_blocks(W_blocks, blocks),
+        assemble_blocks(W_blocks),
         _ridge_oracle(A, B, lam),
         rtol=1e-3,
         atol=1e-3,
@@ -125,7 +125,7 @@ def test_bcd_converges_to_direct_solution(rng):
         num_iters=30,
         lam=lam,
     )
-    W = np.asarray(assemble_blocks(W_blocks, blocks))
+    W = np.asarray(assemble_blocks(W_blocks))
     oracle = _ridge_oracle(A, B, lam)
     np.testing.assert_allclose(W, oracle, rtol=2e-2, atol=2e-2)
 
@@ -149,7 +149,7 @@ def test_bcd_weighted_matches_weighted_oracle(rng):
         Aw.astype(np.float64).T @ B.astype(np.float64),
     )
     np.testing.assert_allclose(
-        assemble_blocks(W_blocks, blocks), oracle, rtol=1e-3, atol=1e-3
+        assemble_blocks(W_blocks), oracle, rtol=1e-3, atol=1e-3
     )
 
 
@@ -172,8 +172,8 @@ def test_bcd_cached_grams_matches_uncached(rng):
     from keystone_tpu.linalg.bcd import assemble_blocks
 
     np.testing.assert_allclose(
-        assemble_blocks(W_cached, blocks),
-        assemble_blocks(W_plain, blocks),
+        assemble_blocks(W_cached),
+        assemble_blocks(W_plain),
         rtol=1e-4,
         atol=1e-4,
     )
@@ -189,7 +189,7 @@ def test_bcd_cached_grams_weighted(rng):
     from keystone_tpu.linalg.bcd import assemble_blocks
 
     np.testing.assert_allclose(
-        assemble_blocks(W_c, blocks), assemble_blocks(W_p, blocks),
+        assemble_blocks(W_c), assemble_blocks(W_p),
         rtol=1e-4, atol=1e-4,
     )
 
@@ -207,7 +207,7 @@ def test_streamed_bcd_matches_device_resident(rng):
         Ma, RowMatrix.from_array(B), block_size=8, num_iters=4, lam=0.2
     )
     np.testing.assert_allclose(
-        assemble_blocks(W_s, blocks), assemble_blocks(W_d, blocks),
+        assemble_blocks(W_s), assemble_blocks(W_d),
         rtol=1e-4, atol=1e-4,
     )
 
@@ -227,7 +227,7 @@ def test_streamed_bcd_weighted_and_row_mismatch(rng):
         row_weights=w,
     )
     np.testing.assert_allclose(
-        assemble_blocks(W_s, blocks), assemble_blocks(W_d, blocks),
+        assemble_blocks(W_s), assemble_blocks(W_d),
         rtol=1e-4, atol=1e-4,
     )
     with pytest.raises(ValueError, match="must match B rows"):
@@ -275,7 +275,7 @@ def test_streamed_bcd_checkpoint_resume(rng, tmp_path):
         A, Mb, 8, 4, lam=0.1, checkpoint_dir=ck
     )
     np.testing.assert_allclose(
-        assemble_blocks(W_res, blocks), assemble_blocks(W_ref, blocks),
+        assemble_blocks(W_res), assemble_blocks(W_ref),
         rtol=1e-4, atol=1e-4,
     )
 
@@ -328,7 +328,7 @@ def test_checkpoint_resumes_across_device_and_streamed_paths(rng, tmp_path):
         A, RowMatrix.from_array(B), 8, 4, lam=0.1, checkpoint_dir=ck
     )
     np.testing.assert_allclose(
-        assemble_blocks(W_res, blocks), assemble_blocks(W_ref, blocks),
+        assemble_blocks(W_res), assemble_blocks(W_ref),
         rtol=1e-4, atol=1e-4,
     )
 
