@@ -34,8 +34,11 @@ def initialize(
     )
     if coordinator_address is None:
         return  # single-host: nothing to do
-    num_processes = num_processes or int(os.environ["KEYSTONE_NUM_PROCESSES"])
-    process_id = process_id or int(os.environ["KEYSTONE_PROCESS_ID"])
+    # `is None`, not `or`: process_id 0 (the coordinator) is falsy.
+    if num_processes is None:
+        num_processes = int(os.environ["KEYSTONE_NUM_PROCESSES"])
+    if process_id is None:
+        process_id = int(os.environ["KEYSTONE_PROCESS_ID"])
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
