@@ -43,6 +43,22 @@ def cost_analysis(fn: Callable, *args) -> Dict[str, Any]:
     }
 
 
+def device_hbm_bytes(default: int | None = None) -> int:
+    """Memory budget of device 0 as the runtime reports it (``bytes_limit``
+    from ``memory_stats``), falling back to ``config.hbm_budget_bytes`` for
+    backends that don't report one (notably CPU)."""
+    from keystone_tpu.config import config
+
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        if limit:
+            return int(limit)
+    except Exception:
+        pass
+    return default if default is not None else config.hbm_budget_bytes
+
+
 def achieved_tflops(fn: Callable, *args, repeats: int = 3) -> Dict[str, float]:
     """Compile, time, and convert to achieved TFLOPS (per process)."""
     jitted = jax.jit(fn)

@@ -18,14 +18,21 @@ from dataclasses import dataclass
 from typing import Any, Dict, List, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from keystone_tpu.workflow.graph import Graph, GraphId, NodeId, SourceId
 from keystone_tpu.workflow.operators import (
     DatasetOperator,
+    DelegatingOperator,
     EstimatorOperator,
     Operator,
 )
+
+# Profiling hint memo: (transformer signature, sample shape, dtype, scale)
+# -> FLOPs ratio. Cost analysis compiles twice per entry; graph copies and
+# repeated optimizer passes hit this instead.
+_flops_ratio_memo: Dict[Any, float | None] = {}
 
 
 class CacheOperator(Operator):
@@ -75,14 +82,65 @@ class NodeProfile:
     seconds: float
     bytes: int
     scale: float  # full-size / sample-size row ratio estimate
+    # XLA-counted FLOPs ratio full/sample for jittable device nodes: the
+    # non-linear correction (a stage quadratic in rows has ratio ≈ scale²,
+    # which linear time extrapolation under-costs by scale×).
+    flops_ratio: float | None = None
+
+    @property
+    def time_scale(self) -> float:
+        """Multiplier from sampled seconds to full-size seconds: compiled
+        FLOPs when XLA counted them, row ratio otherwise (host nodes)."""
+        return self.flops_ratio if self.flops_ratio is not None else self.scale
 
 
 class Profiler:
     """Executes the graph on row-sampled dataset nodes, timing each operator
-    and sizing each output (the AutoCacheRule sampling profiler)."""
+    and sizing each output (the AutoCacheRule sampling profiler). Device
+    nodes additionally get an XLA cost-model correction: the transformer is
+    lowered at both the sample and the full batch shape and the compiled
+    FLOP counts replace the linear row extrapolation (SURVEY.md §7 hard
+    part 5)."""
 
     def __init__(self, sample_rows: int = 64):
         self.sample_rows = sample_rows
+
+    @staticmethod
+    def _flops_ratio(transformer, sample_input, scale: float) -> float | None:
+        """full/sample FLOPs from the compiled HLO; None when not countable
+        (host nodes, non-arrays, compile failure). Memoized on (signature,
+        shape, scale) so graph copies and repeated passes don't recompile."""
+        if scale <= 1.0 or not getattr(transformer, "jittable", False):
+            return None
+        try:
+            x = jnp.asarray(sample_input)
+            if x.ndim < 1:
+                return None
+            key = None
+            try:
+                key = (transformer.signature(), x.shape, str(x.dtype), scale)
+                if key in _flops_ratio_memo:
+                    return _flops_ratio_memo[key]
+            except TypeError:
+                key = None  # unhashable signature: compute uncached
+            full = jax.ShapeDtypeStruct(
+                (int(round(x.shape[0] * scale)),) + x.shape[1:], x.dtype
+            )
+            sample = jax.ShapeDtypeStruct(x.shape, x.dtype)
+            from keystone_tpu.utils.metrics import cost_analysis
+
+            f_sample = cost_analysis(transformer.apply_batch, sample)["flops"]
+            f_full = cost_analysis(transformer.apply_batch, full)["flops"]
+            ratio = None
+            if f_sample > 0 and f_full > 0:
+                ratio = f_full / f_sample
+            if key is not None:
+                if len(_flops_ratio_memo) > 1024:
+                    _flops_ratio_memo.clear()
+                _flops_ratio_memo[key] = ratio
+            return ratio
+        except Exception:
+            return None
 
     def profile(
         self, graph: Graph, targets: Sequence[GraphId]
@@ -110,6 +168,25 @@ class Profiler:
                 dt = time.perf_counter() - t0
                 scales[nid] = scale
             else:
+                # The fitted-transformer case (DelegatingOperator) carries
+                # its transformer as a dependency value, not an attribute.
+                transformer = getattr(op, "transformer", None)
+                batch_val = dep_vals[0] if dep_vals else None
+                if (
+                    transformer is None
+                    and isinstance(op, DelegatingOperator)
+                    and len(dep_vals) == 2
+                ):
+                    transformer, batch_val = dep_vals[0], dep_vals[1]
+                if transformer is not None and getattr(
+                    transformer, "jittable", False
+                ):
+                    # Warm up so the timed call excludes jit compilation —
+                    # compile time scaled by the FLOPs ratio would dominate
+                    # (and falsify) the ranking.
+                    warm = op.execute(dep_vals)
+                    if isinstance(warm, jax.Array):
+                        jax.block_until_ready(warm)
                 t0 = time.perf_counter()
                 out = op.execute(dep_vals)
                 jax.block_until_ready(out) if isinstance(out, jax.Array) else None
@@ -118,9 +195,15 @@ class Profiler:
                 scales[nid] = max(
                     [scales.get(d, 1.0) for d in deps], default=1.0
                 )
+            flops_ratio = None
+            if not isinstance(op, DatasetOperator) and transformer is not None:
+                flops_ratio = self._flops_ratio(
+                    transformer, batch_val, scales[nid]
+                )
             profiles[nid] = NodeProfile(
                 seconds=dt,
                 bytes=_value_bytes(values[nid]),
                 scale=scales[nid],
+                flops_ratio=flops_ratio,
             )
         return profiles

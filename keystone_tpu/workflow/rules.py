@@ -111,7 +111,15 @@ class AutoCacheRule(Rule):
         self.min_consumers = min_consumers
 
     def apply(self, graph: Graph, targets: Sequence[GraphId]) -> Graph:
-        budget = self.budget_bytes or config.hbm_budget_bytes // 4
+        # `is not None`: an explicit 0 means "no cache budget", not "unset".
+        if self.budget_bytes is not None:
+            budget = self.budget_bytes
+        else:
+            # Real device budget when the runtime reports one (TPU
+            # bytes_limit), config fallback otherwise.
+            from keystone_tpu.utils.metrics import device_hbm_bytes
+
+            budget = device_hbm_bytes() // 4
         profiles = Profiler(self.sample_rows).profile(graph, targets)
         if not profiles:
             return graph
@@ -129,8 +137,10 @@ class AutoCacheRule(Rule):
                 continue
             if nid in targets_set or len(cons.get(nid, ())) < self.min_consumers:
                 continue
+            # Output bytes scale with rows; time scales with compiled FLOPs
+            # when XLA counted them (the non-linear-stage correction).
             est_bytes = int(prof.bytes * prof.scale)
-            est_seconds = prof.seconds * prof.scale
+            est_seconds = prof.seconds * prof.time_scale
             if est_bytes <= 0 or est_seconds <= 0:
                 continue
             candidates.append((est_seconds / est_bytes, est_bytes, nid))

@@ -1,0 +1,125 @@
+"""XLA cost-model integration in the auto-cache rule (SURVEY.md §7 hard
+part 5: the profiler's linear row extrapolation mis-costs non-linear
+stages; compiled FLOP counts fix the ranking)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.workflow import Transformer
+from keystone_tpu.workflow.cache import CacheOperator, NodeProfile, Profiler
+from keystone_tpu.workflow.graph import Graph
+from keystone_tpu.workflow.operators import DatasetOperator, TransformerOperator
+from keystone_tpu.workflow.rules import AutoCacheRule
+
+
+class Linear(Transformer):
+    """O(n) in rows — linear extrapolation is exact for this."""
+
+    def apply_batch(self, X):
+        return X * 2.0 + 1.0
+
+
+class Quadratic(Transformer):
+    """O(n²) in rows (gram against the whole batch): the stage class the
+    sample profiler under-costs by the row ratio."""
+
+    def apply_batch(self, X):
+        return (X @ X.T) @ X
+
+
+def _graph(n=1024, d=16):
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    g = Graph()
+    g, data = g.add(DatasetOperator(X), [])
+    g, q = g.add(TransformerOperator(Quadratic()), [data])
+    g, l = g.add(TransformerOperator(Linear()), [q])
+    g, sink = g.add(TransformerOperator(Linear()), [l])
+    return g, data, q, l, sink
+
+
+def test_flops_ratio_counts_quadratic_stages():
+    g, data, q, l, sink = _graph(n=1024)
+    profiles = Profiler(sample_rows=64).profile(g, [sink])
+    scale = profiles[q].scale
+    assert scale == pytest.approx(16.0)
+    # Quadratic stage: FLOPs grow ~scale², so the XLA-counted ratio must be
+    # far above the row ratio; the linear stage must sit at ~scale.
+    assert profiles[q].flops_ratio == pytest.approx(scale**2, rel=0.1)
+    assert profiles[l].flops_ratio == pytest.approx(scale, rel=0.1)
+    assert profiles[q].time_scale > 10 * profiles[l].time_scale
+
+
+def test_compiled_flops_flip_the_caching_decision(monkeypatch):
+    """The VERDICT regression: a budget that fits ONE cached value, a
+    quadratic node whose sampled seconds look cheaper than a linear node's.
+    Linear extrapolation picks the wrong node; the FLOPs ratio corrects it."""
+    g, data, q, l, sink = _graph()
+    nbytes = 1000
+
+    def fake_profile(self, graph, targets):
+        return {
+            # Quadratic node: fast on the sample (0.5ms) but ratio 256.
+            q: NodeProfile(seconds=5e-4, bytes=nbytes, scale=16.0,
+                           flops_ratio=256.0),
+            # Linear node: slower on the sample (2ms), honest ratio 16.
+            l: NodeProfile(seconds=2e-3, bytes=nbytes, scale=16.0,
+                           flops_ratio=16.0),
+        }
+
+    def cached_nodes(graph):
+        out = set()
+        for nid, op in graph.operators.items():
+            if isinstance(op, CacheOperator):
+                out.add(graph.dependencies[nid][0])
+        return out
+
+    monkeypatch.setattr(Profiler, "profile", fake_profile)
+    # Budget fits exactly one full-size value (est_bytes = bytes * scale).
+    rule = AutoCacheRule(budget_bytes=nbytes * 16, min_consumers=1)
+    got = cached_nodes(rule.apply(g, [sink]))
+    # Full-size truth: q costs 0.5ms*256 = 128ms, l costs 2ms*16 = 32ms.
+    assert got == {q}
+
+    # Strip the FLOPs info: linear extrapolation ranks l first (2ms*16=32ms
+    # vs q's 0.5ms*16=8ms) — the wrong call the cost model exists to fix.
+    def fake_profile_linear(self, graph, targets):
+        return {
+            q: NodeProfile(seconds=5e-4, bytes=nbytes, scale=16.0),
+            l: NodeProfile(seconds=2e-3, bytes=nbytes, scale=16.0),
+        }
+
+    monkeypatch.setattr(Profiler, "profile", fake_profile_linear)
+    got = cached_nodes(rule.apply(g, [sink]))
+    assert got == {l}
+
+
+def test_device_hbm_budget_reports_positive():
+    from keystone_tpu.utils.metrics import device_hbm_bytes
+
+    assert device_hbm_bytes() > 0
+
+
+def test_device_hbm_budget_default_on_unreportable(monkeypatch):
+    def boom():
+        raise RuntimeError("no backend")
+
+    monkeypatch.setattr(jax, "local_devices", boom)
+    from keystone_tpu.utils.metrics import device_hbm_bytes
+
+    assert device_hbm_bytes(default=123) == 123
+
+
+def test_zero_budget_caches_nothing(monkeypatch):
+    g, data, q, l, sink = _graph()
+
+    def fake_profile(self, graph, targets):
+        return {q: NodeProfile(seconds=1e-3, bytes=100, scale=16.0)}
+
+    monkeypatch.setattr(Profiler, "profile", fake_profile)
+    got = AutoCacheRule(budget_bytes=0).apply(g, [sink])
+    assert not any(
+        isinstance(op, CacheOperator) for op in got.operators.values()
+    )
