@@ -61,6 +61,10 @@ class Config:
     # Raise on NaNs inside jitted computations (jax debug_nans; the
     # sanitizer analog — SURVEY.md §5 race-detection row).
     debug_nans: bool = False
+    # Vocabulary size at which text vectorizers switch from dense (batch, K)
+    # output to a host-side CSR SparseBatch (consumers densify per column
+    # block). Below this, dense batches feed the MXU classifiers directly.
+    text_sparse_threshold: int = 16384
     # Directory for the cross-process fitted-prefix store (None = disabled;
     # the KEYSTONE_CACHE_DIR env var takes precedence). Content-addressed, so
     # it never serves stale fits — see workflow/disk_cache.py.

@@ -378,7 +378,7 @@ def assemble_blocks(W: List[jax.Array]) -> jax.Array:
 
 
 def block_coordinate_descent_streamed(
-    A_host: np.ndarray,
+    A_host,
     B: RowMatrix,
     block_size: int,
     num_iters: int,
@@ -391,10 +391,17 @@ def block_coordinate_descent_streamed(
     block b+1 overlaps the MXU work on block b (SURVEY.md §7 hard part 1:
     the replacement for Spark's cached-RDD block access).
 
+    ``A_host`` is a dense ndarray or a CSR ``SparseBatch`` (the large-vocab
+    text path): sparse blocks densify per column block right here, so an
+    (n, vocab) dense matrix never exists anywhere.
+
     The first epoch fuses gram+Cholesky into each block update and keeps
     the small (b, b) factors resident, so later epochs run the cheap
     cached update while still streaming only one block of A at a time.
     """
+    from keystone_tpu.utils.sparse import SparseBatch
+
+    sparse = isinstance(A_host, SparseBatch)
     mesh, axis = B.mesh, config.data_axis
     if A_host.shape[0] != B.n:
         raise ValueError(
@@ -413,7 +420,10 @@ def block_coordinate_descent_streamed(
 
     def put(i: int) -> jax.Array:
         s, e = blocks[i]
-        block = np.ascontiguousarray(A_host[:, s:e], dtype=dtype)
+        if sparse:
+            block = A_host.densify(s, e, dtype=dtype)
+        else:
+            block = np.ascontiguousarray(A_host[:, s:e], dtype=dtype)
         if pad:
             block = np.pad(block, ((0, pad), (0, 0)))
         return jax.device_put(block, sharding)
@@ -437,10 +447,12 @@ def block_coordinate_descent_streamed(
     R = B.data.astype(cdtype)
     fingerprint = None
     if checkpoint_dir is not None:
+        if sparse:
+            a_probe = A_host.row_sum(0) + A_host.row_sum(len(A_host) - 1)
+        else:
+            a_probe = float(A_host[0].sum() + A_host[-1].sum())
         fingerprint = _make_fingerprint(
-            B, d, block_size, lam, weighted,
-            a_probe=float(A_host[0].sum() + A_host[-1].sum()),
-            a_dtype=dtype,
+            B, d, block_size, lam, weighted, a_probe=a_probe, a_dtype=dtype
         )
     # On resume, Cholesky factors rebuild lazily: the `first` update at the
     # resumed epoch recomputes them as part of a normal update.

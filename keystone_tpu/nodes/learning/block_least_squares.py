@@ -48,7 +48,16 @@ class BlockLinearMapper(Transformer):
         self.b = None if b is None else jnp.asarray(b)
 
     def apply_batch(self, X):
+        from keystone_tpu.utils.sparse import SparseBatch
+
         out = None
+        if isinstance(X, SparseBatch):
+            # matmul densifies per column block internally — same streaming
+            # shape as the dense loop below, one implementation.
+            out = X.matmul(np.asarray(self.W))
+            if self.b is not None:
+                out = out + np.asarray(self.b)
+            return out
         for (s, e), w in zip(self.blocks, self.W_blocks):
             contrib = X[..., s:e] @ w
             out = contrib if out is None else out + contrib
@@ -85,6 +94,10 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         return None
 
     def fit(self, data, labels) -> BlockLinearMapper:
+        from keystone_tpu.utils.sparse import SparseBatch
+
+        if isinstance(data, SparseBatch):
+            return self._fit_sparse(data, labels)
         stream = self.stream
         itemsize = jnp.dtype(config.default_dtype).itemsize
         if stream is None:
@@ -164,6 +177,42 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         if self.fit_intercept:
             W = jnp.concatenate(W_blocks, axis=0)
             b = y_mean - x_mean @ W
+        return BlockLinearMapper(W_blocks, blocks, b)
+
+
+    def _fit_sparse(self, data, labels) -> BlockLinearMapper:
+        """Large-vocab path: CSR features stream to the device one dense
+        column block at a time (an (n, vocab) dense array never exists).
+
+        The intercept is learned as the weight of an appended all-ones
+        column (centering would destroy sparsity); with lam > 0 the
+        intercept is therefore ridge-penalized too — a small documented
+        deviation from the centered dense path, exact at lam = 0.
+        """
+        Y = jnp.asarray(labels)
+        weights = self._weights(Y)
+        A = data.append_ones() if self.fit_intercept else data
+        B = RowMatrix.from_array(Y)
+        W_blocks, blocks = block_coordinate_descent_streamed(
+            A,
+            B,
+            block_size=self.block_size,
+            num_iters=self.num_iters,
+            lam=self.lam,
+            row_weights=weights,
+            checkpoint_dir=self.checkpoint_dir,
+        )
+        b = None
+        if self.fit_intercept:
+            last = W_blocks[-1]
+            b = last[-1]
+            if last.shape[0] == 1:  # the ones column was its own block
+                W_blocks = W_blocks[:-1]
+                blocks = blocks[:-1]
+            else:
+                s, e = blocks[-1]
+                W_blocks = W_blocks[:-1] + [last[:-1]]
+                blocks = blocks[:-1] + [(s, e - 1)]
         return BlockLinearMapper(W_blocks, blocks, b)
 
 

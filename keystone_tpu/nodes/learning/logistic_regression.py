@@ -12,6 +12,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from keystone_tpu.config import config
 from keystone_tpu.workflow import LabelEstimator, Transformer
@@ -24,6 +25,10 @@ class LogisticRegressionModel(Transformer):
 
     def apply_batch(self, X):
         """Class scores (logits); compose MaxClassifier for labels."""
+        from keystone_tpu.utils.sparse import SparseBatch
+
+        if isinstance(X, SparseBatch):
+            return X.matmul(np.asarray(self.W)) + np.asarray(self.b)
         return X @ self.W + self.b
 
 
@@ -77,6 +82,21 @@ class LogisticRegressionEstimator(LabelEstimator):
         self.max_iters = max_iters
 
     def fit(self, data, labels) -> LogisticRegressionModel:
+        from keystone_tpu.utils.sparse import SparseBatch
+
+        if isinstance(data, SparseBatch):
+            # LBFGS re-reads X every iteration; no blockwise seam exists
+            # here, so sparse input densifies once — loudly.
+            import logging
+
+            logging.getLogger("keystone_tpu").warning(
+                "LogisticRegressionEstimator densifies SparseBatch input "
+                "(%s -> %.0f MiB); prefer NaiveBayes or the block solvers "
+                "at large vocabularies",
+                data,
+                data.shape[0] * data.shape[1] * 4 / 2**20,
+            )
+            data = data.toarray()
         X = jnp.asarray(data, dtype=config.default_dtype)
         y = jnp.asarray(labels).astype(jnp.int32).ravel()
         W, b = _fit_lbfgs(X, y, self.num_classes, self.reg, self.max_iters)

@@ -5,22 +5,30 @@ nodes/nlp/WordFrequencyEncoder.scala — keep the K most frequent terms and
 encode documents against that dictionary (SURVEY.md §2.7/§2.8)
 [unverified].
 
-TPU note: the reference emits Spark sparse vectors; here encoding produces
-dense (batch, K) arrays — at the vocabulary sizes the canonical text
-pipelines use, the dense batch is exactly what the MXU-backed classifiers
-(NaiveBayes gemms, logistic regression) want. Encoding is host-side; the
-result flows to the device once per batch.
+TPU note: the reference emits Spark sparse vectors; here encoding emits
+dense (batch, K) arrays at small K — what the MXU-backed classifiers want —
+and switches to a host-side CSR ``SparseBatch`` once K crosses
+``config.text_sparse_threshold`` (``sparse="auto"``), so vocab ≫ 10k never
+materializes an (n, vocab) dense array; downstream consumers (naive Bayes,
+the block solvers) densify per column block on their way to the device.
 """
 
 from __future__ import annotations
 
 from collections import Counter
-from typing import Dict, List, Mapping, Sequence
+from typing import Dict, List, Mapping, Sequence, Union
 
 import numpy as np
 
 from keystone_tpu.config import config
+from keystone_tpu.utils.sparse import SparseBatch
 from keystone_tpu.workflow import Estimator, Transformer
+
+
+def _want_sparse(sparse: Union[bool, str], dim: int) -> bool:
+    if sparse == "auto":
+        return dim >= config.text_sparse_threshold
+    return bool(sparse)
 
 
 class SparseFeatureVectorizer(Transformer):
@@ -28,11 +36,14 @@ class SparseFeatureVectorizer(Transformer):
 
     jittable = False
 
-    def __init__(self, index: Mapping[str, int]):
+    def __init__(self, index: Mapping[str, int], sparse: Union[bool, str] = "auto"):
         self.index = dict(index)
         self.dim = len(self.index)
+        self.sparse = sparse
 
     def apply_batch(self, docs: Sequence[Mapping[str, float]]):
+        if _want_sparse(self.sparse, self.dim):
+            return SparseBatch.from_term_maps(docs, self.index, self.dim)
         out = np.zeros((len(docs), self.dim), dtype=config.default_dtype)
         index = self.index
         for i, doc in enumerate(docs):
@@ -51,9 +62,11 @@ class SparseFeatureVectorizer(Transformer):
 
 
 class CountVectorizer(SparseFeatureVectorizer):
-    """Encodes token lists as dense count vectors against a fixed index."""
+    """Encodes token lists as count vectors against a fixed index."""
 
     def apply_batch(self, docs: Sequence[Sequence[str]]):
+        if _want_sparse(self.sparse, self.dim):
+            return SparseBatch.from_counts(docs, self.index, self.dim)
         out = np.zeros((len(docs), self.dim), dtype=config.default_dtype)
         index = self.index
         for i, tokens in enumerate(docs):
@@ -67,27 +80,33 @@ class CountVectorizer(SparseFeatureVectorizer):
 class CommonSparseFeatures(Estimator):
     """Fit: keep the `num_features` terms appearing in the most documents."""
 
-    def __init__(self, num_features: int):
+    def __init__(self, num_features: int, sparse: Union[bool, str] = "auto"):
         self.num_features = num_features
+        self.sparse = sparse
 
     def fit(self, docs: Sequence[Mapping[str, float]]) -> SparseFeatureVectorizer:
         doc_freq: Counter = Counter()
         for doc in docs:
             doc_freq.update(doc.keys())
         top = [t for t, _c in doc_freq.most_common(self.num_features)]
-        return SparseFeatureVectorizer({t: i for i, t in enumerate(top)})
+        return SparseFeatureVectorizer(
+            {t: i for i, t in enumerate(top)}, sparse=self.sparse
+        )
 
 
 class WordFrequencyEncoder(Estimator):
     """Fit over token lists: most frequent words → index; encodes documents
-    as dense count vectors."""
+    as count vectors."""
 
-    def __init__(self, num_words: int):
+    def __init__(self, num_words: int, sparse: Union[bool, str] = "auto"):
         self.num_words = num_words
+        self.sparse = sparse
 
     def fit(self, token_docs: Sequence[Sequence[str]]) -> CountVectorizer:
         freq: Counter = Counter()
         for tokens in token_docs:
             freq.update(tokens)
         top = [w for w, _c in freq.most_common(self.num_words)]
-        return CountVectorizer({w: i for i, w in enumerate(top)})
+        return CountVectorizer(
+            {w: i for i, w in enumerate(top)}, sparse=self.sparse
+        )

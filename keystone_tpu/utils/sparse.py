@@ -1,0 +1,188 @@
+"""Host-side CSR batch — sparse features without (n × vocab) dense arrays.
+
+Ref: the reference's text path emits Spark `SparseVector`s from
+CommonSparseFeatures onward (SURVEY.md §2.7/§2.8) [unverified]. The TPU has
+no sparse MXU path, so the rebuild keeps sparsity on the HOST — where the
+memory problem lives — and densifies per column block right before device
+work: the solver streams dense (n, block) slices to the chip (the same
+double-buffered seam the out-of-HBM dense path uses), and classifier
+inference accumulates block gemms. Vocab ≫ 10k therefore never materializes
+an (n, vocab) dense array anywhere.
+
+Indices are unique within each row (the vectorizers build from dicts);
+``densify`` relies on that.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+
+class SparseBatch:
+    """CSR: ``values[indptr[i]:indptr[i+1]]`` at ``indices[...]`` is row i."""
+
+    __slots__ = ("indptr", "indices", "values", "dim", "_rows", "_csc")
+
+    def __init__(self, indptr, indices, values, dim: int):
+        self.indptr = np.asarray(indptr, dtype=np.int64)
+        self.indices = np.asarray(indices, dtype=np.int32)
+        self.values = np.asarray(values, dtype=np.float32)
+        self.dim = int(dim)
+        self._rows: Optional[np.ndarray] = None
+        self._csc: Optional[tuple] = None
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_term_maps(
+        cls, docs: Sequence[Mapping[str, float]], index: Mapping[str, int], dim: int
+    ) -> "SparseBatch":
+        indptr = [0]
+        indices: list = []
+        values: list = []
+        for doc in docs:
+            for term, weight in doc.items():
+                j = index.get(term)
+                if j is not None:
+                    indices.append(j)
+                    values.append(weight)
+            indptr.append(len(indices))
+        return cls(indptr, indices, values, dim)
+
+    @classmethod
+    def from_counts(
+        cls, docs: Sequence[Sequence[str]], index: Mapping[str, int], dim: int
+    ) -> "SparseBatch":
+        from collections import Counter
+
+        indptr = [0]
+        indices: list = []
+        values: list = []
+        for tokens in docs:
+            counts = Counter(tokens)
+            for term, c in counts.items():
+                j = index.get(term)
+                if j is not None:
+                    indices.append(j)
+                    values.append(float(c))
+            indptr.append(len(indices))
+        return cls(indptr, indices, values, dim)
+
+    @classmethod
+    def from_dense(cls, X) -> "SparseBatch":
+        X = np.asarray(X)
+        indptr = [0]
+        indices: list = []
+        values: list = []
+        for row in X:
+            nz = np.nonzero(row)[0]
+            indices.extend(nz.tolist())
+            values.extend(row[nz].tolist())
+            indptr.append(len(indices))
+        return cls(indptr, indices, values, X.shape[1])
+
+    # -- properties --------------------------------------------------------
+
+    @property
+    def shape(self):
+        return (len(self.indptr) - 1, self.dim)
+
+    @property
+    def nnz(self) -> int:
+        return int(self.indices.shape[0])
+
+    @property
+    def nbytes(self) -> int:
+        return self.indptr.nbytes + self.indices.nbytes + self.values.nbytes
+
+    def __len__(self) -> int:
+        return self.shape[0]
+
+    def _row_ids(self) -> np.ndarray:
+        if self._rows is None:
+            self._rows = np.repeat(
+                np.arange(len(self), dtype=np.int64), np.diff(self.indptr)
+            )
+        return self._rows
+
+    def _col_sorted(self) -> tuple:
+        """(rows, cols, vals) sorted by column — one O(nnz log nnz) sort,
+        after which every column-block densify is O(nnz_block) via
+        searchsorted bounds instead of an O(nnz) mask scan per block."""
+        if self._csc is None:
+            order = np.argsort(self.indices, kind="stable")
+            self._csc = (
+                self._row_ids()[order],
+                self.indices[order],
+                self.values[order],
+            )
+        return self._csc
+
+    # -- dense views -------------------------------------------------------
+
+    def densify(
+        self, start: int = 0, stop: Optional[int] = None, dtype=np.float32
+    ) -> np.ndarray:
+        """Dense (n, stop-start) slice of columns [start, stop) — the
+        per-block view the streamed solver consumes."""
+        stop = self.dim if stop is None else stop
+        out = np.zeros((len(self), stop - start), dtype=dtype)
+        rows, cols, vals = self._col_sorted()
+        lo, hi = np.searchsorted(cols, (start, stop))
+        out[rows[lo:hi], cols[lo:hi] - start] = vals[lo:hi]
+        return out
+
+    def toarray(self, dtype=np.float32) -> np.ndarray:
+        return self.densify(0, self.dim, dtype)
+
+    def matmul(self, M, block: int = 8192) -> np.ndarray:
+        """self @ M for a dense (dim, k) M, densifying one column block at a
+        time — peak extra memory is (n, block), never (n, dim)."""
+        M = np.asarray(M)
+        out = np.zeros((len(self), M.shape[1]), dtype=np.float32)
+        for s in range(0, self.dim, block):
+            e = min(s + block, self.dim)
+            out += self.densify(s, e) @ M[s:e]
+        return out
+
+    # -- reductions --------------------------------------------------------
+
+    def column_sums(self) -> np.ndarray:
+        return np.bincount(
+            self.indices, weights=self.values, minlength=self.dim
+        ).astype(np.float32)
+
+    def grouped_column_sums(self, groups, num_groups: int) -> np.ndarray:
+        """(num_groups, dim) per-group column sums — one bincount over
+        group-offset keys (the naive-Bayes per-class count reduction)."""
+        groups = np.asarray(groups, dtype=np.int64).ravel()
+        rows = self._row_ids()
+        keys = groups[rows] * self.dim + self.indices
+        flat = np.bincount(
+            keys, weights=self.values, minlength=num_groups * self.dim
+        )
+        return flat.reshape(num_groups, self.dim).astype(np.float32)
+
+    def row_sum(self, i: int) -> float:
+        s, e = int(self.indptr[i]), int(self.indptr[i + 1])
+        return float(self.values[s:e].sum())
+
+    # -- structure edits ---------------------------------------------------
+
+    def append_ones(self) -> "SparseBatch":
+        """A copy with one extra all-ones column at index ``dim`` — the
+        intercept column for solvers that learn b as a model weight."""
+        n = len(self)
+        indptr = self.indptr + np.arange(n + 1, dtype=np.int64)
+        # Insert one (dim, 1.0) entry at each original row end — three
+        # vectorized ops, no per-row Python loop.
+        at = np.asarray(self.indptr[1:])
+        indices = np.insert(self.indices, at, np.int32(self.dim))
+        values = np.insert(self.values, at, np.float32(1.0))
+        return SparseBatch(indptr, indices, values, self.dim + 1)
+
+    def __repr__(self) -> str:
+        n, d = self.shape
+        return f"SparseBatch({n}x{d}, nnz={self.nnz})"

@@ -63,6 +63,8 @@ class CacheOperator(Operator):
 def _value_bytes(v: Any) -> int:
     if isinstance(v, (jax.Array, np.ndarray)):
         return int(v.size) * v.dtype.itemsize
+    if hasattr(v, "nbytes"):  # e.g. SparseBatch
+        return int(v.nbytes)
     if isinstance(v, (list, tuple)):
         return sum(_value_bytes(x) for x in v)
     if isinstance(v, str):

@@ -1,0 +1,209 @@
+"""Sparse text features: CSR SparseBatch through CommonSparseFeatures into
+the classifiers and solvers, densified only per column block.
+
+Ref: the reference's Spark SparseVector text path (SURVEY.md §2.7/§2.8)
+[unverified]; VERDICT round-2 item 9 — vocab ≫ 10k must never materialize
+an (n, vocab) dense array.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.config import config
+from keystone_tpu.nodes.learning import (
+    BlockLeastSquaresEstimator,
+    NaiveBayesEstimator,
+)
+from keystone_tpu.nodes.nlp import CommonSparseFeatures, WordFrequencyEncoder
+from keystone_tpu.utils.sparse import SparseBatch
+
+
+def _random_sparse(rng, n=64, d=512, nnz_per_row=8, centered=False):
+    X = np.zeros((n, d), dtype=np.float32)
+    for i in range(n):
+        cols = rng.choice(d, size=nnz_per_row, replace=False)
+        if centered:
+            # Zero-mean values keep the intercept column near-orthogonal to
+            # the features, so coordinate descent converges fast — the
+            # parity tests compare SOLUTIONS, not convergence rates.
+            X[i, cols] = rng.normal(size=nnz_per_row)
+        else:
+            X[i, cols] = rng.uniform(0.5, 2.0, size=nnz_per_row)
+    return X
+
+
+class TestSparseBatch:
+    def test_densify_matches_dense(self, rng):
+        X = _random_sparse(rng)
+        sb = SparseBatch.from_dense(X)
+        np.testing.assert_allclose(sb.toarray(), X)
+        np.testing.assert_allclose(sb.densify(100, 300), X[:, 100:300])
+
+    def test_matmul_blocks(self, rng):
+        X = _random_sparse(rng)
+        M = rng.normal(size=(512, 7)).astype(np.float32)
+        np.testing.assert_allclose(
+            SparseBatch.from_dense(X).matmul(M, block=100),
+            X @ M,
+            rtol=1e-4,
+            atol=1e-5,
+        )
+
+    def test_reductions(self, rng):
+        X = _random_sparse(rng)
+        sb = SparseBatch.from_dense(X)
+        np.testing.assert_allclose(sb.column_sums(), X.sum(0), rtol=1e-5)
+        y = rng.integers(0, 3, size=len(X))
+        grouped = sb.grouped_column_sums(y, 3)
+        for c in range(3):
+            np.testing.assert_allclose(
+                grouped[c], X[y == c].sum(0), rtol=1e-5
+            )
+        assert sb.row_sum(0) == pytest.approx(float(X[0].sum()), rel=1e-5)
+
+    def test_append_ones(self, rng):
+        X = _random_sparse(rng, n=16, d=32)
+        aug = SparseBatch.from_dense(X).append_ones()
+        dense = aug.toarray()
+        np.testing.assert_allclose(dense[:, :32], X)
+        np.testing.assert_allclose(dense[:, 32], np.ones(16))
+
+
+class TestVectorizers:
+    def test_sparse_output_parity(self):
+        docs = [{"a": 1.0, "b": 2.0}, {"b": 3.0, "c": 4.0}, {"a": 5.0}]
+        dense_fit = CommonSparseFeatures(3, sparse=False).fit(docs)
+        sparse_fit = CommonSparseFeatures(3, sparse=True).fit(docs)
+        sb = sparse_fit.apply_batch(docs)
+        assert isinstance(sb, SparseBatch)
+        np.testing.assert_allclose(sb.toarray(), dense_fit.apply_batch(docs))
+
+    def test_count_vectorizer_parity(self):
+        docs = [["a", "b", "a"], ["c"], ["b", "b", "b"]]
+        dense = WordFrequencyEncoder(3, sparse=False).fit(docs).apply_batch(docs)
+        sb = WordFrequencyEncoder(3, sparse=True).fit(docs).apply_batch(docs)
+        np.testing.assert_allclose(sb.toarray(), np.asarray(dense))
+
+    def test_auto_switches_on_threshold(self, monkeypatch):
+        docs = [{"a": 1.0}, {"b": 2.0}]
+        monkeypatch.setattr(config, "text_sparse_threshold", 2)
+        assert isinstance(
+            CommonSparseFeatures(2).fit(docs).apply_batch(docs), SparseBatch
+        )
+        monkeypatch.setattr(config, "text_sparse_threshold", 100)
+        assert isinstance(
+            CommonSparseFeatures(2).fit(docs).apply_batch(docs), np.ndarray
+        )
+
+
+class TestSparseClassifiers:
+    def test_naive_bayes_sparse_matches_dense(self, rng):
+        X = _random_sparse(rng, n=128, d=256)
+        y = rng.integers(0, 4, size=128)
+        dense_model = NaiveBayesEstimator(4).fit(X, y)
+        sparse_model = NaiveBayesEstimator(4).fit(SparseBatch.from_dense(X), y)
+        np.testing.assert_allclose(
+            np.asarray(sparse_model.apply_batch(SparseBatch.from_dense(X))),
+            np.asarray(dense_model.apply_batch(jnp.asarray(X))),
+            rtol=1e-4,
+            atol=1e-4,
+        )
+
+    def test_block_ls_sparse_matches_dense(self, rng):
+        X = _random_sparse(rng, n=256, d=96, centered=True)
+        W = rng.normal(size=(96, 3)).astype(np.float32)
+        Y = X @ W + 0.5
+        est = dict(block_size=32, num_iters=6, lam=0.0)
+        dense_pred = np.asarray(
+            BlockLeastSquaresEstimator(**est).fit(X, Y).apply_batch(X)
+        )
+        sb = SparseBatch.from_dense(X)
+        sparse_pred = np.asarray(
+            BlockLeastSquaresEstimator(**est).fit(sb, Y).apply_batch(sb)
+        )
+        # Same model class solved two ways (centering vs ones-column);
+        # at lam=0 both converge to the same least-squares predictions.
+        np.testing.assert_allclose(sparse_pred, dense_pred, rtol=2e-2, atol=2e-2)
+
+    def test_logistic_sparse_roundtrip(self, rng):
+        """Sparse input: fit densifies (loudly), inference stays CSR."""
+        from keystone_tpu.nodes.learning import LogisticRegressionEstimator
+
+        X = _random_sparse(rng, n=96, d=64, centered=True)
+        y = rng.integers(0, 3, size=96)
+        model = LogisticRegressionEstimator(3, max_iters=30).fit(
+            SparseBatch.from_dense(X), y
+        )
+        dense_scores = np.asarray(model.apply_batch(jnp.asarray(X)))
+        sparse_scores = np.asarray(
+            model.apply_batch(SparseBatch.from_dense(X))
+        )
+        np.testing.assert_allclose(
+            sparse_scores, dense_scores, rtol=1e-4, atol=1e-4
+        )
+
+    def test_block_ls_sparse_no_intercept_exact(self, rng):
+        X = _random_sparse(rng, n=256, d=64)
+        W = rng.normal(size=(64, 3)).astype(np.float32)
+        Y = X @ W
+        kw = dict(block_size=64, num_iters=3, lam=1e-6, fit_intercept=False)
+        dense_pred = np.asarray(
+            BlockLeastSquaresEstimator(**kw).fit(X, Y).apply_batch(X)
+        )
+        sb = SparseBatch.from_dense(X)
+        sparse_pred = np.asarray(
+            BlockLeastSquaresEstimator(**kw).fit(sb, Y).apply_batch(sb)
+        )
+        np.testing.assert_allclose(sparse_pred, dense_pred, rtol=1e-3, atol=1e-3)
+
+
+def _wide_corpus(n=500, tail_vocab=120_000, num_classes=4, seed=0):
+    """Synthetic text whose vocabulary genuinely exceeds the sparse
+    threshold: per-class signal tokens plus a long tail of rare words (the
+    newsgroups loader's built-in topics only span a few hundred terms)."""
+    r = np.random.default_rng(seed)
+    texts, labels = [], []
+    for _ in range(n):
+        c = int(r.integers(0, num_classes))
+        sig = [f"sig{c}x{int(r.integers(0, 50))}" for _ in range(15)]
+        tail = [f"w{int(r.integers(0, tail_vocab))}" for _ in range(60)]
+        words = sig + tail
+        r.shuffle(words)
+        texts.append(" ".join(words))
+        labels.append(c)
+    return texts, np.asarray(labels, dtype=np.int32)
+
+
+class TestNewsgroupsLargeVocab:
+    @pytest.mark.slow
+    def test_pipeline_at_100k_feature_budget(self):
+        """The VERDICT regression: the canonical text stages with a 100k
+        feature budget stay CSR end-to-end — an (n, vocab) dense array is
+        never built — and the classifier still works."""
+        from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+        from keystone_tpu.nodes.nlp import (
+            LowerCase,
+            TermFrequency,
+            Tokenizer,
+            Trim,
+        )
+        from keystone_tpu.nodes.util import MaxClassifier
+
+        texts, labels = _wide_corpus()
+        featurizer = (
+            Trim()
+            .and_then(LowerCase())
+            .and_then(Tokenizer())
+            .and_then(TermFrequency("log"))
+            .and_then(CommonSparseFeatures(100_000), texts)
+        )
+        feats = featurizer(texts).get()
+        assert isinstance(feats, SparseBatch)  # over the sparse threshold
+        assert feats.dim > config.text_sparse_threshold
+        pipeline = featurizer.and_then(
+            NaiveBayesEstimator(4), texts, labels
+        ).and_then(MaxClassifier())
+        preds = pipeline(texts).get()
+        metrics = MulticlassClassifierEvaluator(4).evaluate(preds, labels)
+        assert metrics.total_accuracy > 0.9
