@@ -42,6 +42,42 @@ class ImageNetLoader:
         return out
 
     @staticmethod
+    def iter_jobs(
+        data_path: str,
+        label_map: Dict[str, int],
+        limit: Optional[int] = None,
+    ):
+        """Lazily yield (jpeg_bytes, label) in deterministic walk order —
+        the streaming source both `load` and `stream_batches` consume."""
+        count = 0
+        for entry in sorted(os.listdir(data_path)):
+            synset = entry[:-4] if entry.endswith(".tar") else entry
+            label = label_map.get(synset)
+            if label is None:
+                continue
+            full = os.path.join(data_path, entry)
+            if entry.endswith(".tar"):
+                with tarfile.open(full) as tf:
+                    # Iterate the TarFile directly: members stream as the
+                    # archive is read, so limit/prefetch consumers never
+                    # wait on a full getmembers() scan of a multi-GB tar.
+                    for member in tf:
+                        if member.isfile():
+                            f = tf.extractfile(member)
+                            if f is not None:
+                                yield f.read(), label
+                                count += 1
+                                if limit is not None and count >= limit:
+                                    return
+            elif os.path.isdir(full):
+                for fname in sorted(os.listdir(full)):
+                    with open(os.path.join(full, fname), "rb") as f:
+                        yield f.read(), label
+                    count += 1
+                    if limit is not None and count >= limit:
+                        return
+
+    @staticmethod
     def load(
         data_path: str,
         label_map: Dict[str, int],
@@ -51,33 +87,104 @@ class ImageNetLoader:
     ) -> LabeledData:
         """`data_path`: directory of `<synset>.tar` archives or of
         `<synset>/` subdirectories of JPEGs."""
-        jobs: List[Tuple[bytes, int]] = []
-        for entry in sorted(os.listdir(data_path)):
-            synset = entry[:-4] if entry.endswith(".tar") else entry
-            label = label_map.get(synset)
-            if label is None:
-                continue
-            full = os.path.join(data_path, entry)
-            if entry.endswith(".tar"):
-                with tarfile.open(full) as tf:
-                    for member in tf.getmembers():
-                        if member.isfile():
-                            f = tf.extractfile(member)
-                            if f is not None:
-                                jobs.append((f.read(), label))
-            elif os.path.isdir(full):
-                for fname in sorted(os.listdir(full)):
-                    with open(os.path.join(full, fname), "rb") as f:
-                        jobs.append((f.read(), label))
-            if limit is not None and len(jobs) >= limit:
-                jobs = jobs[:limit]
-                break
+        jobs: List[Tuple[bytes, int]] = list(
+            ImageNetLoader.iter_jobs(data_path, label_map, limit)
+        )
         with ThreadPoolExecutor(max_workers=workers) as pool:
             images = list(pool.map(lambda j: _decode(j[0], size), jobs))
         return LabeledData(
             np.stack(images).astype(config.default_dtype),
             np.asarray([label for _b, label in jobs], dtype=np.int32),
         )
+
+    @staticmethod
+    def stream_batches(
+        data_path: str,
+        label_map: Dict[str, int],
+        batch_size: int = 256,
+        size: int = 256,
+        workers: int = 16,
+        limit: Optional[int] = None,
+        prefetch: int = 2,
+    ):
+        """Decode-ahead (X, y) batch stream — the ingest-featurization
+        overlap path (SURVEY.md §7 hard part 4).
+
+        A producer thread reads bytes and decodes batches on its own pool,
+        running up to ``prefetch`` batches ahead through a bounded queue, so
+        JPEG decode of batch b+1 overlaps the device work on batch b. The
+        yielded (NHWC float batch, int labels) pairs plug straight into the
+        ``BatchIterator``/chunked-solver seam (loaders/stream.py).
+        """
+        import queue
+        import threading
+
+        q: "queue.Queue" = queue.Queue(maxsize=max(prefetch, 1))
+        DONE = object()
+        stop = threading.Event()  # set when the consumer abandons early
+
+        def put(item) -> bool:
+            """Bounded put that gives up when the consumer is gone —
+            otherwise an abandoned generator strands this thread (and its
+            tar handle + decode pool) blocked on a full queue forever."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                with ThreadPoolExecutor(max_workers=workers) as pool:
+                    bufs: List[bytes] = []
+                    labels: List[int] = []
+
+                    def flush() -> bool:
+                        images = list(
+                            pool.map(lambda b: _decode(b, size), bufs)
+                        )
+                        X = np.stack(images).astype(config.default_dtype)
+                        y = np.asarray(labels, dtype=np.int32)
+                        bufs.clear()
+                        labels.clear()
+                        return put((X, y))
+
+                    for buf, label in ImageNetLoader.iter_jobs(
+                        data_path, label_map, limit
+                    ):
+                        if stop.is_set():
+                            return
+                        bufs.append(buf)
+                        labels.append(label)
+                        if len(bufs) == batch_size and not flush():
+                            return
+                    if bufs:
+                        flush()
+            except BaseException as e:  # surface in the consumer thread
+                put(e)
+            finally:
+                q.put(DONE)
+
+        thread = threading.Thread(target=produce, daemon=True)
+        thread.start()
+        try:
+            while True:
+                item = q.get()
+                if item is DONE:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            while True:  # drain so the producer's final put can't block
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            thread.join(timeout=30)
 
     @staticmethod
     def synthetic(

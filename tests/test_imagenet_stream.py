@@ -1,0 +1,108 @@
+"""Decode-ahead ImageNet streaming: parity with the eager loader and
+composition with the chunked-solver seam (SURVEY.md §7 hard part 4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from keystone_tpu.loaders.imagenet import ImageNetLoader
+
+PIL = pytest.importorskip("PIL")
+
+
+@pytest.fixture
+def jpeg_tree(tmp_path):
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    label_map = {}
+    for s in range(2):
+        name = f"n{s:08d}"
+        label_map[name] = s
+        d = tmp_path / name
+        d.mkdir()
+        for i in range(6):
+            arr = (rng.uniform(size=(48, 48, 3)) * 255).astype(np.uint8)
+            Image.fromarray(arr).save(d / f"im_{i}.JPEG", quality=92)
+    return str(tmp_path), label_map
+
+
+def test_stream_matches_eager_load(jpeg_tree):
+    root, label_map = jpeg_tree
+    eager = ImageNetLoader.load(root, label_map, size=32, workers=4)
+    Xs, ys = [], []
+    for X, y in ImageNetLoader.stream_batches(
+        root, label_map, batch_size=5, size=32, workers=4
+    ):
+        assert X.ndim == 4 and X.shape[1:] == (32, 32, 3)
+        Xs.append(X)
+        ys.append(y)
+    np.testing.assert_allclose(np.concatenate(Xs), eager.data, atol=1e-6)
+    np.testing.assert_array_equal(np.concatenate(ys), eager.labels)
+
+
+def test_stream_respects_limit(jpeg_tree):
+    root, label_map = jpeg_tree
+    batches = list(
+        ImageNetLoader.stream_batches(
+            root, label_map, batch_size=4, size=32, workers=2, limit=7
+        )
+    )
+    assert sum(len(x) for x, _ in batches) == 7
+
+
+def test_stream_feeds_chunked_solver(jpeg_tree):
+    """The BatchIterator seam: decode-ahead batches drive the out-of-core
+    normal-equations solve directly."""
+    from keystone_tpu.linalg import solve_least_squares_chunked
+
+    root, label_map = jpeg_tree
+    rng = np.random.default_rng(0)
+    # 8 features for 12 rows: keeps the toy normal equations full rank.
+    W_true = rng.normal(size=(8, 2)).astype(np.float32)
+
+    def batches():
+        for X, _y in ImageNetLoader.stream_batches(
+            root, label_map, batch_size=4, size=32, workers=2
+        ):
+            F = X.reshape(len(X), -1)[:, :8]
+            yield F, F @ W_true
+
+    W = np.asarray(solve_least_squares_chunked(batches(), lam=1e-6))
+    eager = ImageNetLoader.load(root, label_map, size=32, workers=2)
+    F = eager.data.reshape(len(eager.data), -1)[:, :8]
+    resid = np.linalg.norm(F @ W - F @ W_true) / np.linalg.norm(F @ W_true)
+    assert resid < 1e-2
+
+
+def test_abandoned_stream_stops_producer(jpeg_tree):
+    import threading
+
+    root, label_map = jpeg_tree
+    before = threading.active_count()
+    gen = ImageNetLoader.stream_batches(
+        root, label_map, batch_size=2, size=32, workers=2, prefetch=1
+    )
+    next(gen)
+    gen.close()  # consumer walks away mid-stream
+    # The producer must unblock and exit, not strand on the full queue.
+    deadline = 50
+    while threading.active_count() > before and deadline:
+        import time
+
+        time.sleep(0.1)
+        deadline -= 1
+    assert threading.active_count() <= before
+
+
+def test_stream_surfaces_decode_errors(tmp_path):
+    d = tmp_path / "n00000000"
+    d.mkdir()
+    (d / "bad.JPEG").write_bytes(b"not a jpeg")
+    with pytest.raises(Exception):
+        list(
+            ImageNetLoader.stream_batches(
+                str(tmp_path), {"n00000000": 0}, batch_size=2, size=32
+            )
+        )

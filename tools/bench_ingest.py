@@ -1,0 +1,134 @@
+"""ImageNet ingest throughput benchmark (SURVEY.md §7 hard part 4).
+
+Generates a synthetic-JPEG synset tree, then measures:
+1. decode -> NHWC rate (images/sec) of the PIL thread pool at 256px,
+   swept over worker counts;
+2. the featurization rate of a representative conv patch-extraction step
+   on the default backend;
+3. overlapped streaming (decode-ahead batches feeding featurization)
+   vs serial decode-then-featurize.
+
+Usage: python tools/bench_ingest.py [--images 512] [--size 256]
+Prints one JSON line; paste the numbers into NOTES_r2.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_jpeg_tree(root: str, n_images: int, size: int, synsets: int = 8) -> dict:
+    """Class-textured JPEGs in <synset>/ dirs; returns the label map."""
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    label_map = {}
+    base, extra = divmod(n_images, synsets)
+    for s in range(synsets):
+        name = f"n{s:08d}"
+        label_map[name] = s
+        d = os.path.join(root, name)
+        os.makedirs(d, exist_ok=True)
+        per = base + (1 if s < extra else 0)  # remainder distributed
+        for i in range(per):
+            x = rng.uniform(size=(size, size, 3))
+            yy, xx = np.mgrid[0:size, 0:size]
+            x[..., 0] = 0.5 + 0.5 * np.sin(2 * np.pi * (s + 2) / size * xx)
+            img = Image.fromarray((x * 255).astype(np.uint8))
+            img.save(os.path.join(d, f"img_{i:05d}.JPEG"), quality=90)
+    return label_map
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--images", type=int, default=512)
+    ap.add_argument("--size", type=int, default=256)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--workers", type=int, nargs="+", default=[4, 8, 16, 32])
+    args = ap.parse_args()
+
+    from keystone_tpu.utils.platform import ensure_live_backend
+
+    backend = ensure_live_backend()
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from keystone_tpu.loaders.imagenet import ImageNetLoader
+
+    result: dict = {"metric": "imagenet_ingest", "backend": backend}
+    with tempfile.TemporaryDirectory() as root:
+        label_map = make_jpeg_tree(root, args.images, args.size)
+
+        # 1. raw decode rate per worker count
+        decode = {}
+        for w in args.workers:
+            t0 = time.perf_counter()
+            data = ImageNetLoader.load(root, label_map, size=args.size, workers=w)
+            dt = time.perf_counter() - t0
+            decode[w] = round(len(data.data) / dt, 1)
+        result["decode_images_per_sec"] = decode
+        best_rate = max(decode.values())
+
+        # 2. featurization rate: conv patch extraction + pool, the front of
+        # the RandomPatchCifar/ImageNet featurization stack.
+        filters = jnp.asarray(
+            np.random.default_rng(0).normal(size=(6, 6, 3, 64)) * 0.1,
+            dtype=jnp.float32,
+        )
+
+        @jax.jit
+        def featurize(X):
+            out = lax.conv_general_dilated(
+                X, filters, (2, 2), "VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            return jnp.maximum(out, 0.0).mean(axis=(1, 2))
+
+        X0 = jnp.asarray(data.data[: args.batch])
+        jax.block_until_ready(featurize(X0))  # compile
+        t0 = time.perf_counter()
+        reps = 5
+        for _ in range(reps):
+            jax.block_until_ready(featurize(X0))
+        feat_rate = args.batch * reps / (time.perf_counter() - t0)
+        result["featurize_images_per_sec"] = round(feat_rate, 1)
+        result["decode_feeds_featurization"] = best_rate >= feat_rate
+
+        # 3. serial vs overlapped end-to-end
+        t0 = time.perf_counter()
+        data = ImageNetLoader.load(root, label_map, size=args.size, workers=16)
+        for s in range(0, len(data.data), args.batch):
+            jax.block_until_ready(
+                featurize(jnp.asarray(data.data[s : s + args.batch]))
+            )
+        serial = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        n = 0
+        for X, _y in ImageNetLoader.stream_batches(
+            root, label_map, batch_size=args.batch, size=args.size, workers=16
+        ):
+            jax.block_until_ready(featurize(jnp.asarray(X)))
+            n += len(X)
+        overlap = time.perf_counter() - t0
+        assert n == args.images
+        result["serial_seconds"] = round(serial, 2)
+        result["overlapped_seconds"] = round(overlap, 2)
+        result["overlap_speedup"] = round(serial / overlap, 2)
+        result["images"] = args.images
+        result["px"] = args.size
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
