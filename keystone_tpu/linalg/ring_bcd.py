@@ -40,7 +40,7 @@ from jax.scipy.linalg import cho_solve
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from keystone_tpu.config import config
-from keystone_tpu.linalg.row_matrix import _precision
+from keystone_tpu.linalg.row_matrix import _precision, solver_matmul, storage_dtype
 
 
 @lru_cache(maxsize=None)
@@ -60,22 +60,23 @@ def _ring_solve_fn(mesh: Mesh, model_axis: str, data_axis, precision):
         # b_chunk: (n_loc, kc) — its shard of the chunk starting on this ring slot
         d_loc = a_loc.shape[1]
         kc = b_chunk.shape[1]
-        gram = maybe_psum(jnp.matmul(a_loc.T, a_loc, precision=precision))
+        gram = maybe_psum(solver_matmul(a_loc.T, a_loc, precision))
         chol = jnp.linalg.cholesky(
             gram + lam * jnp.eye(d_loc, dtype=gram.dtype)
         )
         idx = lax.axis_index(model_axis)
-        w0 = jnp.zeros((d_loc, nshards * kc), dtype=a_loc.dtype)
+        # Solver state in the accumulation dtype even when A stores bf16.
+        w0 = jnp.zeros((d_loc, nshards * kc), dtype=b_chunk.dtype)
 
         def step(s, carry):
             r, w = carry
             # Which chunk this ring slot holds at step s (chunks move +1/step).
             j = jnp.mod(idx - s, nshards)
             w_old = lax.dynamic_slice(w, (0, j * kc), (d_loc, kc))
-            r_plus = r + jnp.matmul(a_loc, w_old, precision=precision)
-            rhs = maybe_psum(jnp.matmul(a_loc.T, r_plus, precision=precision))
+            r_plus = r + solver_matmul(a_loc, w_old, precision)
+            rhs = maybe_psum(solver_matmul(a_loc.T, r_plus, precision))
             w_new = cho_solve((chol, True), rhs)
-            r_new = r_plus - jnp.matmul(a_loc, w_new, precision=precision)
+            r_new = r_plus - solver_matmul(a_loc, w_new, precision)
             w = lax.dynamic_update_slice(w, w_new, (0, j * kc))
             r_next = lax.ppermute(
                 r_new,
@@ -127,7 +128,7 @@ def block_coordinate_descent_ring(
         row_shards = mesh.shape[data_axis]
     nshards = mesh.shape[axis]
     dtype = jnp.dtype(config.default_dtype)
-    A = np.asarray(A, dtype=dtype)
+    A = np.asarray(A, dtype=storage_dtype())  # bf16 in throughput mode
     B = np.asarray(B, dtype=dtype)
     n, d = A.shape
     k = B.shape[1]

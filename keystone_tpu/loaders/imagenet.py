@@ -29,6 +29,32 @@ def _decode(buf: bytes, size: int) -> np.ndarray:
         return np.asarray(im, dtype=np.float32) / 255.0
 
 
+def _decode_batch(bufs, size: int, pool) -> np.ndarray:
+    """Decode a batch of jpeg buffers to (n, size, size, 3) float32.
+
+    ``KEYSTONE_JPEG_BACKEND`` = native | pil | auto (default). auto uses the
+    C++ libjpeg pool (OpenMP, no GIL — see native/src/jpeg_pool.cpp) when
+    the library builds, falling back to the PIL thread pool per batch (also
+    on any native decode error, e.g. a CMYK jpeg libjpeg won't convert).
+    """
+    backend = os.environ.get("KEYSTONE_JPEG_BACKEND", "auto")
+    if backend in ("auto", "native"):
+        from keystone_tpu import native
+
+        if native.jpeg_available():
+            try:
+                return native.decode_jpeg_batch(list(bufs), size)
+            except ValueError:
+                if backend == "native":
+                    raise
+        elif backend == "native":
+            raise RuntimeError(
+                f"native jpeg pool unavailable: {native.build_error()}"
+            )
+    images = list(pool.map(lambda b: _decode(b, size), bufs))
+    return np.stack(images).astype(np.float32)
+
+
 class ImageNetLoader:
     @staticmethod
     def load_label_map(path: str) -> Dict[str, int]:
@@ -91,9 +117,9 @@ class ImageNetLoader:
             ImageNetLoader.iter_jobs(data_path, label_map, limit)
         )
         with ThreadPoolExecutor(max_workers=workers) as pool:
-            images = list(pool.map(lambda j: _decode(j[0], size), jobs))
+            images = _decode_batch([b for b, _l in jobs], size, pool)
         return LabeledData(
-            np.stack(images).astype(config.default_dtype),
+            images.astype(config.default_dtype, copy=False),
             np.asarray([label for _b, label in jobs], dtype=np.int32),
         )
 
@@ -142,10 +168,9 @@ class ImageNetLoader:
                     labels: List[int] = []
 
                     def flush() -> bool:
-                        images = list(
-                            pool.map(lambda b: _decode(b, size), bufs)
+                        X = _decode_batch(bufs, size, pool).astype(
+                            config.default_dtype, copy=False
                         )
-                        X = np.stack(images).astype(config.default_dtype)
                         y = np.asarray(labels, dtype=np.int32)
                         bufs.clear()
                         labels.clear()

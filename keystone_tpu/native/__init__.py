@@ -20,6 +20,7 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_DIR, "libkeystone_native.so")
 _lib: Optional[ctypes.CDLL] = None
 _build_error: Optional[str] = None
+_has_jpeg: bool = False
 
 
 def _f32(a) -> np.ndarray:
@@ -44,28 +45,54 @@ def _load() -> Optional[ctypes.CDLL]:
         _build_error = getattr(e, "stderr", str(e)) or str(e)
         if not os.path.exists(_LIB_PATH):
             return None
-    lib = ctypes.CDLL(_LIB_PATH)
-    f32p = ctypes.POINTER(ctypes.c_float)
-    lib.ks_sift_num_keypoints.restype = ctypes.c_int
-    lib.ks_sift_num_keypoints.argtypes = [ctypes.c_int] * 4
-    lib.ks_dense_sift.restype = ctypes.c_int
-    lib.ks_dense_sift.argtypes = [f32p] + [ctypes.c_int] * 5 + [f32p]
-    lib.ks_gmm_fit.restype = ctypes.c_int
-    lib.ks_gmm_fit.argtypes = (
-        [f32p] + [ctypes.c_int] * 4 + [ctypes.c_uint64, f32p, f32p, f32p]
-    )
-    lib.ks_fisher_vector.restype = ctypes.c_int
-    lib.ks_fisher_vector.argtypes = (
-        [f32p, ctypes.c_int, ctypes.c_int, f32p, f32p, f32p, ctypes.c_int, f32p]
-    )
-    lib.ks_abi_version.restype = ctypes.c_int
-    assert lib.ks_abi_version() == 1, "native ABI mismatch — run make clean"
+    # Binding/ABI failures (stale .so from an older build + a failed make,
+    # missing optional symbols) must degrade to unavailable(), never raise —
+    # the auto ingest backend depends on a clean False to fall back to PIL.
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        lib.ks_abi_version.restype = ctypes.c_int
+        if lib.ks_abi_version() != 2:
+            _build_error = "native ABI mismatch — run make clean"
+            return None
+        lib.ks_sift_num_keypoints.restype = ctypes.c_int
+        lib.ks_sift_num_keypoints.argtypes = [ctypes.c_int] * 4
+        lib.ks_dense_sift.restype = ctypes.c_int
+        lib.ks_dense_sift.argtypes = [f32p] + [ctypes.c_int] * 5 + [f32p]
+        lib.ks_gmm_fit.restype = ctypes.c_int
+        lib.ks_gmm_fit.argtypes = (
+            [f32p] + [ctypes.c_int] * 4 + [ctypes.c_uint64, f32p, f32p, f32p]
+        )
+        lib.ks_fisher_vector.restype = ctypes.c_int
+        lib.ks_fisher_vector.argtypes = (
+            [f32p, ctypes.c_int, ctypes.c_int, f32p, f32p, f32p, ctypes.c_int, f32p]
+        )
+        # Optional: compiled out when the host lacks libjpeg (Makefile gate).
+        global _has_jpeg
+        _has_jpeg = hasattr(lib, "ks_decode_jpeg_batch")
+        if _has_jpeg:
+            lib.ks_decode_jpeg_batch.restype = ctypes.c_int
+            lib.ks_decode_jpeg_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_int,
+                ctypes.c_int,
+                f32p,
+            ]
+    except Exception as e:
+        _build_error = f"native binding failed: {e}"
+        return None
     _lib = lib
     return _lib
 
 
 def available() -> bool:
     return _load() is not None
+
+
+def jpeg_available() -> bool:
+    """True when the library was built against libjpeg."""
+    return _load() is not None and _has_jpeg
 
 
 def build_error() -> Optional[str]:
@@ -122,6 +149,37 @@ def gmm_fit(
     if rc != 0:
         raise RuntimeError(f"ks_gmm_fit failed ({rc})")
     return weights, means, variances
+
+
+def decode_jpeg_batch(bufs, size: int) -> np.ndarray:
+    """list of jpeg byte strings → (n, size, size, 3) float32 NHWC in [0,1].
+
+    libjpeg DCT-scaled decode + bilinear resize, OpenMP across images —
+    the native replacement for the PIL thread pool on the ingest path.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_build_error}")
+    if not _has_jpeg:
+        raise RuntimeError("native library was built without libjpeg")
+    n = len(bufs)
+    if n == 0:
+        return np.empty((0, size, size, 3), dtype=np.float32)
+    offsets = np.zeros(n + 1, dtype=np.uint64)
+    for i, b in enumerate(bufs):
+        offsets[i + 1] = offsets[i] + len(b)
+    data = np.frombuffer(b"".join(bufs), dtype=np.uint8)
+    out = np.empty((n, size, size, 3), dtype=np.float32)
+    rc = lib.ks_decode_jpeg_batch(
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        n,
+        size,
+        _ptr(out),
+    )
+    if rc != 0:
+        raise ValueError(f"jpeg decode failed at image {-rc - 1}")
+    return out
 
 
 def fisher_vector(

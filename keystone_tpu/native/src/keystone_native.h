@@ -45,6 +45,18 @@ int ks_fisher_vector(const float* X, int n, int d, const float* weights,
                      const float* means, const float* vars, int k,
                      float* out);
 
+// Parallel JPEG decode pool: n images -> RGB float32 NHWC at (size, size),
+// values scaled to [0, 1]. The ingest-side replacement for a Python-thread
+// PIL pool (SURVEY.md §7 hard part 4): libjpeg DCT-scaled decode + bilinear
+// resize, OpenMP across images, no GIL anywhere.
+//   data:    concatenation of all jpeg byte streams
+//   offsets: (n+1) prefix offsets into data (offsets[0] == 0)
+//   out:     (n, size, size, 3) float32
+// Returns 0, or -(i+1) where i is the first image that failed to decode.
+int ks_decode_jpeg_batch(const std::uint8_t* data,
+                         const std::uint64_t* offsets, int n, int size,
+                         float* out);
+
 // Library ABI version (bump on struct/signature changes).
 int ks_abi_version();
 

@@ -120,7 +120,7 @@ void descriptor_at(const float* gx, const float* gy, int w, int top,
 
 extern "C" {
 
-int ks_abi_version() { return 1; }
+int ks_abi_version() { return 2; }
 
 int ks_sift_num_keypoints(int h, int w, int step, int bin_size) {
   if (h <= 0 || w <= 0 || step <= 0 || bin_size <= 0) return -1;

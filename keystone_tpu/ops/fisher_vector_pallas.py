@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from keystone_tpu.config import config
 
@@ -37,11 +38,11 @@ def _fv_kernel(
     mu_inv_ref,  # (k, d) mu/var
     sigma_ref,  # (k, d)  sqrt(var)
     c2_ref,  # (1, k)  Σ_d mu² / var
-    m_real_ref,  # (1, 1)  logical descriptor count (pre-padding)
     gmu_ref,  # (1, k, d) out accumulator
     gvar_ref,  # (1, k, d) out accumulator
     *,
     tile_m: int,
+    m_real: int,  # logical descriptor count (pre-padding) — static
 ):
     t = pl.program_id(1)
     x = x_ref[0]  # (Tm, d)
@@ -55,7 +56,7 @@ def _fv_kernel(
     r = jax.nn.softmax(logits, axis=-1)
     # Mask rows beyond the logical descriptor count (zero-padded tiles).
     row = t * tile_m + jax.lax.broadcasted_iota(jnp.int32, (tile_m, 1), 0)
-    r = jnp.where(row < m_real_ref[0, 0], r, 0.0)
+    r = jnp.where(row < m_real, r, 0.0)
 
     rs = jnp.sum(r, axis=0)  # (k,)
     t1 = jnp.dot(r.T, x, preferred_element_type=jnp.float32)  # (k, d)
@@ -92,10 +93,16 @@ def _fv_pallas(X, w, mu, var, tile_m: int, interpret: bool):
     w, inv, logw_norm_vec, cm, cv = fv_constants(w, mu, var, m)
     logw_norm = logw_norm_vec[None, :]  # (1, k)
     c2 = jnp.sum(mu * mu * inv, axis=1)[None, :]  # (1, k)
-    m_real = jnp.full((1, 1), m, dtype=jnp.int32)
+
+    # Grid semantics for Mosaic: image programs are independent
+    # ("parallel"); the m-tile axis accumulates into the same output block
+    # and must iterate in order ("arbitrary"). Ignored by the interpreter.
+    compiler_params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary")
+    )
 
     gmu, gvar = pl.pallas_call(
-        functools.partial(_fv_kernel, tile_m=tile_m),
+        functools.partial(_fv_kernel, tile_m=tile_m, m_real=m),
         grid=(B, tiles),
         in_specs=[
             pl.BlockSpec((1, tile_m, d), lambda b, t: (b, t, 0)),
@@ -105,7 +112,6 @@ def _fv_pallas(X, w, mu, var, tile_m: int, interpret: bool):
             pl.BlockSpec((k, d), lambda b, t: (0, 0)),
             pl.BlockSpec((k, d), lambda b, t: (0, 0)),
             pl.BlockSpec((1, k), lambda b, t: (0, 0)),
-            pl.BlockSpec((1, 1), lambda b, t: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, k, d), lambda b, t: (b, 0, 0)),
@@ -115,6 +121,7 @@ def _fv_pallas(X, w, mu, var, tile_m: int, interpret: bool):
             jax.ShapeDtypeStruct((B, k, d), jnp.float32),
             jax.ShapeDtypeStruct((B, k, d), jnp.float32),
         ],
+        compiler_params=compiler_params,
         interpret=interpret,
     )(
         X,
@@ -124,7 +131,6 @@ def _fv_pallas(X, w, mu, var, tile_m: int, interpret: bool):
         mu * inv,
         jnp.sqrt(var),
         c2,
-        m_real,
     )
     out = jnp.concatenate(
         [(gmu * cm).reshape(B, -1), (gvar * cv).reshape(B, -1)], axis=-1

@@ -93,6 +93,26 @@ def test_streamed_blocks_use_bf16(bf16, rng):
     assert np.linalg.norm(W - W_true) / np.linalg.norm(W_true) < 5e-2
 
 
+def test_ring_bcd_tracks_f32_solve(rng):
+    """bf16 storage must track the f32 ring solve at the same iteration
+    count (convergence rate is a property of the sweep, not the dtype)."""
+    from keystone_tpu.linalg import block_coordinate_descent_ring
+
+    X, Y, W_true = _problem(rng, n=256, d=64, k=4)
+    W32 = np.asarray(
+        block_coordinate_descent_ring(X, Y, num_iters=6, lam=1e-4)
+    )
+    config.solver_storage_dtype = "bfloat16"
+    try:
+        W16 = np.asarray(
+            block_coordinate_descent_ring(X, Y, num_iters=6, lam=1e-4)
+        )
+    finally:
+        config.solver_storage_dtype = None
+    assert np.linalg.norm(W16 - W32) / np.linalg.norm(W32) < 2e-2
+    assert np.linalg.norm(W16 - W_true) / np.linalg.norm(W_true) < 5e-2
+
+
 def test_estimator_prediction_parity(rng):
     """End-to-end: bf16-mode predictions match the f32 fit within bf16 noise."""
     X, Y, _ = _problem(rng, n=256, d=32, k=3)

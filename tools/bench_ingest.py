@@ -69,13 +69,33 @@ def main() -> None:
     with tempfile.TemporaryDirectory() as root:
         label_map = make_jpeg_tree(root, args.images, args.size)
 
-        # 1. raw decode rate per worker count
+        # 1. raw decode rate: PIL thread pool per worker count vs the
+        # native libjpeg/OpenMP pool. Save/restore any user override.
         decode = {}
-        for w in args.workers:
-            t0 = time.perf_counter()
-            data = ImageNetLoader.load(root, label_map, size=args.size, workers=w)
-            dt = time.perf_counter() - t0
-            decode[w] = round(len(data.data) / dt, 1)
+        prior = os.environ.get("KEYSTONE_JPEG_BACKEND")
+        try:
+            os.environ["KEYSTONE_JPEG_BACKEND"] = "pil"
+            for w in args.workers:
+                t0 = time.perf_counter()
+                data = ImageNetLoader.load(
+                    root, label_map, size=args.size, workers=w
+                )
+                dt = time.perf_counter() - t0
+                decode[f"pil-{w}"] = round(len(data.data) / dt, 1)
+            from keystone_tpu import native
+
+            if native.jpeg_available():
+                os.environ["KEYSTONE_JPEG_BACKEND"] = "native"
+                t0 = time.perf_counter()
+                data = ImageNetLoader.load(root, label_map, size=args.size)
+                decode["native"] = round(
+                    len(data.data) / (time.perf_counter() - t0), 1
+                )
+        finally:
+            if prior is None:
+                os.environ.pop("KEYSTONE_JPEG_BACKEND", None)
+            else:
+                os.environ["KEYSTONE_JPEG_BACKEND"] = prior
         result["decode_images_per_sec"] = decode
         best_rate = max(decode.values())
 
