@@ -77,6 +77,13 @@ def worker(scale_key: str, dtype: str) -> None:
 
     p = SCALE[scale_key]
     n, d, k, block, iters = p["n"], p["d"], p["k"], p["block"], p["iters"]
+    # Block-size override for the MFU sweep (tools/bench_mfu.py); clamped
+    # to a divisor of d so the FLOP formula stays exact.
+    env_block = os.environ.get("KEYSTONE_BENCH_BLOCK")
+    if env_block:
+        block = max(1, min(int(env_block), d))
+        while d % block:
+            block -= 1
     rng = np.random.default_rng(0)
     A = rng.normal(size=(n, d)).astype(np.float32)
     W_true = rng.normal(size=(d, k)).astype(np.float32)

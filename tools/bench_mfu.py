@@ -1,0 +1,95 @@
+"""Block-size / dtype MFU sweep for the BCD solver (BASELINE.md north-star
+metric prep — VERDICT round-2 item 2).
+
+For each (block, dtype) it runs the bench worker's solve, converts the
+analytic FLOP count to TFLOPS/chip, and reports MFU against the chip's
+plausible peak. Run on a live TPU:
+
+    python tools/bench_mfu.py --blocks 1024 2048 4096 8192 --dtypes f32 bf16
+
+On CPU it still runs (scaled-down problem, labelled) so the harness itself
+stays verified while the chip is down. Prints one JSON line per config plus
+a final summary table on stderr. Configs that clamp to the same effective
+block (CPU scale has d=2048) are measured once.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # repo-root bench.py: worker protocol + plausible peaks
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--blocks", type=int, nargs="+",
+                    default=[1024, 2048, 4096, 8192])
+    ap.add_argument("--dtypes", nargs="+", default=["f32", "bf16"])
+    ap.add_argument("--timeout", type=float, default=900.0)
+    args = ap.parse_args()
+
+    from keystone_tpu.utils.platform import cpu_mesh_env, probe_backend
+
+    def probe_live_tpu() -> bool:
+        info = probe_backend(timeout=75)
+        return info is not None and info.get("platform") != "cpu"
+
+    live_tpu = probe_live_tpu()
+    scale_key = "tpu" if live_tpu else "cpu"
+    base_env = dict(os.environ) if live_tpu else cpu_mesh_env(8)
+
+    rows = []
+    for dtype in args.dtypes:
+        peak = bench.PLAUSIBLE_PEAK_TFLOPS["bf16" if dtype == "bf16" else "f32"]
+        seen_blocks = set()
+        for block in args.blocks:
+            env = dict(base_env)
+            env["KEYSTONE_BENCH_BLOCK"] = str(block)
+            # bench._run_worker tails worker stderr on failure — the
+            # diagnostics contract the round-1 gate failure taught us.
+            r = bench._run_worker(env, scale_key, dtype, args.timeout)
+            if r is None or r.get("value") is None:
+                print(json.dumps(
+                    {"block": block, "dtype": dtype, "error": "run failed"}
+                ))
+                # A mid-sweep TPU death would otherwise cost one full
+                # timeout per remaining config — re-probe and degrade.
+                if scale_key == "tpu" and not probe_live_tpu():
+                    print("TPU died mid-sweep; falling back to the CPU "
+                          "scale for the rest", file=sys.stderr)
+                    scale_key = "cpu"
+                    base_env = cpu_mesh_env(8)
+                continue
+            actual_block = r["detail"]["block"]  # divisor-clamped by worker
+            if actual_block in seen_blocks:
+                continue
+            seen_blocks.add(actual_block)
+            mfu = r["value"] / peak
+            line = {
+                "block": actual_block,
+                "dtype": dtype,
+                "backend": r.get("backend"),
+                "tflops_per_chip": r["value"],
+                "mfu_vs_plausible_peak": round(mfu, 4),
+                "seconds_per_solve": r["detail"]["seconds_per_solve"],
+            }
+            rows.append(line)
+            print(json.dumps(line), flush=True)
+
+    if rows:
+        print("\nblock  dtype  backend  TFLOPS/chip   MFU", file=sys.stderr)
+        for r in rows:
+            print(
+                f"{r['block']:>5}  {r['dtype']:<5}  {r['backend']:<7}"
+                f"  {r['tflops_per_chip']:>10.3f}  {r['mfu_vs_plausible_peak']:>6.2%}",
+                file=sys.stderr,
+            )
+
+
+if __name__ == "__main__":
+    main()
