@@ -123,6 +123,13 @@ def setup_platform() -> None:
         import jax
 
         jax.config.update("jax_platforms", plat)
+    # Persistent XLA compilation cache: first TPU compiles run 20-40s; with
+    # this set, repeat launches load the compiled executable from disk.
+    compile_cache = os.environ.get("KEYSTONE_COMPILE_CACHE")
+    if compile_cache:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", compile_cache)
     from keystone_tpu.config import config, env_flag
 
     if config.debug_nans or env_flag("KEYSTONE_DEBUG_NANS"):

@@ -24,12 +24,55 @@ logger = logging.getLogger("keystone_tpu")
 
 
 class DiskFitCache:
-    def __init__(self, root: str):
+    def __init__(self, root: str, max_bytes: Optional[int] = None):
         self.root = root
+        if max_bytes is None:
+            raw = os.environ.get("KEYSTONE_CACHE_MAX_BYTES", "")
+            try:
+                max_bytes = int(raw) if raw else 10 << 30
+            except ValueError:  # malformed knob: default, don't abort runs
+                logger.warning(
+                    "ignoring malformed KEYSTONE_CACHE_MAX_BYTES=%r", raw
+                )
+                max_bytes = 10 << 30
+        self.max_bytes = max_bytes
         os.makedirs(root, exist_ok=True)
 
     def _path(self, key: str) -> str:
         return os.path.join(self.root, f"{key}.fit.pkl")
+
+    def _trim(self) -> None:
+        """Evict least-recently-USED entries (get() refreshes mtime) until
+        under the size budget — content-addressed entries are always safe to
+        drop (pure misses). Per-file errors skip and continue: a concurrent
+        trimmer racing us must not abort the whole sweep."""
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return
+        entries = []
+        total = 0
+        for name in names:
+            if not name.endswith(".fit.pkl"):
+                continue
+            path = os.path.join(self.root, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue  # removed by a concurrent trimmer
+            entries.append((st.st_mtime, st.st_size, path))
+            total += st.st_size
+        if total <= self.max_bytes:
+            return
+        entries.sort()
+        for _mtime, size, path in entries:
+            try:
+                os.remove(path)
+            except OSError:
+                continue
+            total -= size
+            if total <= self.max_bytes:
+                break
 
     def get(self, key: str) -> Optional[Any]:
         path = self._path(key)
@@ -45,6 +88,10 @@ class DiskFitCache:
             except OSError:
                 pass
             return None
+        try:
+            os.utime(path)  # refresh recency: eviction is LRU, not FIFO
+        except OSError:
+            pass
         logger.info("disk fit cache: hit %s", key)
         return fitted
 
@@ -61,6 +108,7 @@ class DiskFitCache:
                 with os.fdopen(fd, "wb") as f:
                     pickle.dump(fitted, f)
                 os.replace(tmp, path)  # atomic: concurrent writers race safely
+                self._trim()
             except BaseException:
                 try:
                     os.remove(tmp)

@@ -231,6 +231,41 @@ class TestDiskCache:
         assert hits[1] == 2
 
 
+class TestDiskCacheTrim:
+    def test_evicts_least_recently_used(self, tmp_path):
+        import time
+
+        from keystone_tpu.workflow.disk_cache import DiskFitCache
+
+        X, _ = _data()
+        one = len(__import__("pickle").dumps(_MatTransformer(X)))
+        # Budget fits exactly one entry, so the eviction ORDER is pinned:
+        # the stale entry goes, the freshly-used one survives.
+        cache = DiskFitCache(str(tmp_path), max_bytes=int(one * 1.5))
+        cache.put("aaa", _MatTransformer(X))
+        time.sleep(0.05)
+        assert cache.get("aaa") is not None  # refreshes recency
+        time.sleep(0.05)
+        cache.put("bbb", _MatTransformer(X))
+        time.sleep(0.05)
+        assert cache.get("bbb") is not None
+        cache.put("ccc", _MatTransformer(X))  # trims: aaa is now the LRU
+        remaining = {
+            f for f in os.listdir(tmp_path) if f.endswith(".fit.pkl")
+        }
+        assert "ccc.fit.pkl" in remaining
+        assert "aaa.fit.pkl" not in remaining
+
+    def test_no_trim_under_budget(self, tmp_path):
+        from keystone_tpu.workflow.disk_cache import DiskFitCache
+
+        cache = DiskFitCache(str(tmp_path), max_bytes=1 << 30)
+        X, _ = _data()
+        cache.put("aaa", _MatTransformer(X))
+        cache.put("bbb", _MatTransformer(X))
+        assert cache.get("aaa") is not None and cache.get("bbb") is not None
+
+
 class TestNodeOptimizationMemo:
     def test_concrete_estimator_stable_across_passes(self):
         from keystone_tpu.workflow.operators import EstimatorOperator

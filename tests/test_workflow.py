@@ -98,6 +98,17 @@ def test_estimator_with_data():
     assert est.fit_count == 1
 
 
+def test_to_dot_export():
+    est = MeanShift()
+    X = np.array([[1.0, 2.0], [3.0, 4.0]])
+    p = est.with_data(X)
+    dot = p.to_dot()
+    assert dot.startswith("digraph pipeline {") and dot.endswith("}")
+    assert "MeanShift.fit" in dot and "Delegating" in dot
+    assert "input" in dot  # the free source renders as a diamond
+    assert "->" in dot
+
+
 def test_fit_cache_across_applications():
     est = MeanShift()
     X = np.array([[1.0, 2.0], [3.0, 4.0]])
