@@ -257,6 +257,8 @@ def block_coordinate_descent(
                 R.block_until_ready()
             if checkpoint_dir is not None:
                 _save_epoch(checkpoint_dir, epoch + 1, W, R, fingerprint)
+        if checkpoint_dir is not None:
+            wait_for_checkpoints(checkpoint_dir)
         return W, blocks
     for epoch in range(start_epoch, num_iters):
         for i in range(len(blocks)):
@@ -265,6 +267,8 @@ def block_coordinate_descent(
             R.block_until_ready()
         if checkpoint_dir is not None:
             _save_epoch(checkpoint_dir, epoch + 1, W, R, fingerprint)
+    if checkpoint_dir is not None:
+        wait_for_checkpoints(checkpoint_dir)
     return W, blocks
 
 
@@ -309,21 +313,48 @@ def _resume_or_default(checkpoint_dir, fingerprint, W, R, sharding):
     return epoch, W, R
 
 
+# One process-wide async checkpointer (it carries no per-directory state):
+# writes overlap the next epoch's device work; wait_until_finished bounds
+# in-flight saves to one — globally, so no two solves can ever race a write
+# into the same physical directory regardless of path spelling — and makes
+# the solvers' returns durable (SURVEY.md §5 failure-recovery row).
+_ASYNC_CKPT: list = []
+
+
+def _async_checkpointer():
+    import orbax.checkpoint as ocp
+
+    if not _ASYNC_CKPT:
+        _ASYNC_CKPT.append(
+            ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        )
+    return _ASYNC_CKPT[0]
+
+
 def _save_epoch(ckpt_dir: str, epoch: int, W, R, fingerprint) -> None:
     import os
 
-    import orbax.checkpoint as ocp
-
     path = os.path.join(os.path.abspath(ckpt_dir), f"epoch_{epoch}")
     # Host-resident pytree: checkpoints cross process/mesh boundaries, so
-    # shardings are re-applied on restore rather than persisted.
+    # shardings are re-applied on restore rather than persisted. The D2H
+    # fetch is synchronous; serialization + write run in the background.
     tree = {
         "epoch": epoch,
         "W": [np.asarray(w) for w in W],
         "R": np.asarray(R),
         "fingerprint": dict(fingerprint),
     }
-    ocp.PyTreeCheckpointer().save(path, tree, force=True)
+    cp = _async_checkpointer()
+    cp.wait_until_finished()  # at most one save in flight
+    cp.save(path, tree, force=True)
+
+
+def wait_for_checkpoints(ckpt_dir: str = "") -> None:
+    """Block until every in-flight epoch save is durable (the checkpointer
+    is process-wide, so the argument is only documentation). The solvers
+    call this before returning; callers only need it for mid-solve probes."""
+    if _ASYNC_CKPT:
+        _ASYNC_CKPT[0].wait_until_finished()
 
 
 def _fingerprint_matches(saved, expected) -> bool:
@@ -477,4 +508,6 @@ def block_coordinate_descent_streamed(
                 R.block_until_ready()
         if checkpoint_dir is not None:
             _save_epoch(checkpoint_dir, epoch + 1, W, R, fingerprint)
+    if checkpoint_dir is not None:
+        wait_for_checkpoints(checkpoint_dir)
     return W, blocks

@@ -36,6 +36,9 @@ class DiskFitCache:
                 )
                 max_bytes = 10 << 30
         self.max_bytes = max_bytes
+        # Approximate directory size, refreshed by each sweep: puts only pay
+        # the full listdir+stat sweep when the estimate crosses the budget.
+        self._approx_total: Optional[int] = None
         os.makedirs(root, exist_ok=True)
 
     def _path(self, key: str) -> str:
@@ -46,6 +49,11 @@ class DiskFitCache:
         under the size budget — content-addressed entries are always safe to
         drop (pure misses). Per-file errors skip and continue: a concurrent
         trimmer racing us must not abort the whole sweep."""
+        if (
+            self._approx_total is not None
+            and self._approx_total <= self.max_bytes
+        ):
+            return
         try:
             names = os.listdir(self.root)
         except OSError:
@@ -63,6 +71,7 @@ class DiskFitCache:
             entries.append((st.st_mtime, st.st_size, path))
             total += st.st_size
         if total <= self.max_bytes:
+            self._approx_total = total
             return
         entries.sort()
         for _mtime, size, path in entries:
@@ -73,6 +82,7 @@ class DiskFitCache:
             total -= size
             if total <= self.max_bytes:
                 break
+        self._approx_total = total
 
     def get(self, key: str) -> Optional[Any]:
         path = self._path(key)
@@ -108,6 +118,11 @@ class DiskFitCache:
                 with os.fdopen(fd, "wb") as f:
                     pickle.dump(fitted, f)
                 os.replace(tmp, path)  # atomic: concurrent writers race safely
+                if self._approx_total is not None:
+                    try:
+                        self._approx_total += os.path.getsize(path)
+                    except OSError:
+                        self._approx_total = None  # force a real sweep
                 self._trim()
             except BaseException:
                 try:
