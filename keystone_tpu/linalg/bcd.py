@@ -313,22 +313,27 @@ def _resume_or_default(checkpoint_dir, fingerprint, W, R, sharding):
     return epoch, W, R
 
 
-# One process-wide async checkpointer (it carries no per-directory state):
-# writes overlap the next epoch's device work; wait_until_finished bounds
-# in-flight saves to one — globally, so no two solves can ever race a write
-# into the same physical directory regardless of path spelling — and makes
-# the solvers' returns durable (SURVEY.md §5 failure-recovery row).
-_ASYNC_CKPT: list = []
+# One async checkpointer per checkpoint directory (keyed by abspath):
+# writes overlap the next epoch's device work; orbax's save() itself blocks
+# on any previous in-flight save, so at most one write per directory is ever
+# outstanding. Per-directory scoping confines a failed background write to
+# the solve that issued it, and wait_for_checkpoints closes + drops the
+# entry at every solver return so instances don't accumulate
+# (SURVEY.md §5 failure-recovery row).
+_ASYNC_CKPT: dict = {}
 
 
-def _async_checkpointer():
+def _async_checkpointer(ckpt_dir: str):
+    import os
+
     import orbax.checkpoint as ocp
 
-    if not _ASYNC_CKPT:
-        _ASYNC_CKPT.append(
-            ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
-        )
-    return _ASYNC_CKPT[0]
+    key = os.path.abspath(ckpt_dir)
+    cp = _ASYNC_CKPT.get(key)
+    if cp is None:
+        cp = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        _ASYNC_CKPT[key] = cp
+    return cp
 
 
 def _save_epoch(ckpt_dir: str, epoch: int, W, R, fingerprint) -> None:
@@ -337,24 +342,29 @@ def _save_epoch(ckpt_dir: str, epoch: int, W, R, fingerprint) -> None:
     path = os.path.join(os.path.abspath(ckpt_dir), f"epoch_{epoch}")
     # Host-resident pytree: checkpoints cross process/mesh boundaries, so
     # shardings are re-applied on restore rather than persisted. The D2H
-    # fetch is synchronous; serialization + write run in the background.
+    # fetch is synchronous; serialization + write run in the background
+    # (save blocks internally on the previous in-flight save).
     tree = {
         "epoch": epoch,
         "W": [np.asarray(w) for w in W],
         "R": np.asarray(R),
         "fingerprint": dict(fingerprint),
     }
-    cp = _async_checkpointer()
-    cp.wait_until_finished()  # at most one save in flight
-    cp.save(path, tree, force=True)
+    _async_checkpointer(ckpt_dir).save(path, tree, force=True)
 
 
-def wait_for_checkpoints(ckpt_dir: str = "") -> None:
-    """Block until every in-flight epoch save is durable (the checkpointer
-    is process-wide, so the argument is only documentation). The solvers
-    call this before returning; callers only need it for mid-solve probes."""
-    if _ASYNC_CKPT:
-        _ASYNC_CKPT[0].wait_until_finished()
+def wait_for_checkpoints(ckpt_dir: str) -> None:
+    """Block until ``ckpt_dir``'s in-flight epoch save is durable, then
+    release its checkpointer. The solvers call this before returning;
+    callers only need it for mid-solve probes."""
+    import os
+
+    cp = _ASYNC_CKPT.pop(os.path.abspath(ckpt_dir), None)
+    if cp is not None:
+        try:
+            cp.wait_until_finished()
+        finally:
+            cp.close()
 
 
 def _fingerprint_matches(saved, expected) -> bool:

@@ -37,9 +37,15 @@ class DiskFitCache:
                 max_bytes = 10 << 30
         self.max_bytes = max_bytes
         # Approximate directory size, refreshed by each sweep: puts only pay
-        # the full listdir+stat sweep when the estimate crosses the budget.
+        # the full listdir+stat sweep when the estimate crosses the budget —
+        # but at most _SWEEP_EVERY puts go by between real sweeps, because
+        # the estimate only counts THIS process's writes and a shared
+        # directory grows under everyone's.
         self._approx_total: Optional[int] = None
+        self._puts_since_sweep = 0
         os.makedirs(root, exist_ok=True)
+
+    _SWEEP_EVERY = 32
 
     def _path(self, key: str) -> str:
         return os.path.join(self.root, f"{key}.fit.pkl")
@@ -52,8 +58,10 @@ class DiskFitCache:
         if (
             self._approx_total is not None
             and self._approx_total <= self.max_bytes
+            and self._puts_since_sweep < self._SWEEP_EVERY
         ):
             return
+        self._puts_since_sweep = 0
         try:
             names = os.listdir(self.root)
         except OSError:
@@ -118,6 +126,7 @@ class DiskFitCache:
                 with os.fdopen(fd, "wb") as f:
                     pickle.dump(fitted, f)
                 os.replace(tmp, path)  # atomic: concurrent writers race safely
+                self._puts_since_sweep += 1
                 if self._approx_total is not None:
                     try:
                         self._approx_total += os.path.getsize(path)

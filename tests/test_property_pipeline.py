@@ -1,0 +1,189 @@
+"""Property test: randomly composed pipelines are invariant to every
+execution configuration — chain fusion on/off, auto-caching on/off, disk
+cache on/off — and structurally identical rebuilds hit the fit cache
+instead of refitting.
+
+This is the workflow layer's deepest contract (the reference's optimizer
+rules must be semantics-preserving; SURVEY.md §2.1 optimizer rows
+[unverified]): whatever DAG the composition algebra produces, optimization
+must only change HOW it executes.
+"""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.config import config
+from keystone_tpu.workflow import (
+    Estimator,
+    PipelineEnv,
+    Pipeline,
+    Transformer,
+)
+
+
+class Affine(Transformer):
+    """Jittable, content-stable: identical params hash alike."""
+
+    def __init__(self, a: float, b: float):
+        self.a = float(a)
+        self.b = float(b)
+
+    def signature(self):
+        return self.stable_signature(self.a, self.b)
+
+    def apply_batch(self, X):
+        return X * self.a + self.b
+
+
+class Clip(Transformer):
+    def __init__(self, lo: float):
+        self.lo = float(lo)
+
+    def signature(self):
+        return self.stable_signature(self.lo)
+
+    def apply_batch(self, X):
+        import jax.numpy as jnp
+
+        return jnp.maximum(X, self.lo)
+
+
+class HostScale(Transformer):
+    """Host-side (unjittable) stage — breaks fusion chains."""
+
+    jittable = False
+
+    def __init__(self, c: float):
+        self.c = float(c)
+
+    def signature(self):
+        return self.stable_signature(self.c)
+
+    def apply_batch(self, X):
+        return np.asarray(X) * self.c
+
+
+class MeanCenter(Estimator):
+    """Content-stable estimator whose fits are globally counted."""
+
+    fits = 0
+
+    def __init__(self, tag: int):
+        self.tag = tag
+
+    def fit(self, data):
+        type(self).fits += 1
+        mu = np.asarray(data).mean(axis=0)
+        return Affine(1.0, 0.0) if self.tag < 0 else _Shift(-mu)
+
+
+class _Shift(Transformer):
+    def __init__(self, mu):
+        self.mu = np.asarray(mu)
+
+    def signature(self):
+        return self.stable_signature(self.mu.tobytes(), self.mu.shape)
+
+    def apply_batch(self, X):
+        return X + self.mu
+
+
+def _random_pipeline(rng, data, depth=None):
+    """A random composition over the node pool, including estimator splices
+    and gathered branches."""
+    depth = depth if depth is not None else int(rng.integers(2, 6))
+    p = None
+    for _ in range(depth):
+        roll = rng.uniform()
+        if roll < 0.45:
+            node = Affine(
+                float(rng.uniform(0.5, 1.5)), float(rng.uniform(-0.5, 0.5))
+            ).to_pipeline()
+        elif roll < 0.6:
+            node = Clip(float(rng.uniform(-0.2, 0.2))).to_pipeline()
+        elif roll < 0.75:
+            node = HostScale(float(rng.uniform(0.9, 1.1))).to_pipeline()
+        elif roll < 0.9:
+            node = MeanCenter(int(rng.integers(0, 1000))).with_data(
+                data.copy()
+            )
+        else:
+            a = Affine(float(rng.uniform(0.5, 1.5)), 0.0)
+            b = Clip(0.0)
+            node = Pipeline.gather([a.to_pipeline(), b.to_pipeline()])
+        p = node if p is None else p.and_then(node)
+    return p
+
+
+def _run(build, X, fuse: bool, auto_cache: bool, cache_dir):
+    PipelineEnv.reset()
+    old_fuse, old_auto = config.fuse_chains, config.auto_cache
+    config.fuse_chains = fuse
+    config.auto_cache = auto_cache
+    import os
+
+    old_dir = os.environ.get("KEYSTONE_CACHE_DIR")
+    if cache_dir is not None:
+        os.environ["KEYSTONE_CACHE_DIR"] = str(cache_dir)
+    else:
+        os.environ.pop("KEYSTONE_CACHE_DIR", None)
+    try:
+        p = build().fit()
+        return np.asarray(p.apply(X).get())
+    finally:
+        config.fuse_chains = old_fuse
+        config.auto_cache = old_auto
+        if old_dir is None:
+            os.environ.pop("KEYSTONE_CACHE_DIR", None)
+        else:
+            os.environ["KEYSTONE_CACHE_DIR"] = old_dir
+        PipelineEnv.reset()
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_configs_agree(seed, tmp_path):
+    rng = np.random.default_rng(seed)
+    data = rng.normal(size=(96, 12)).astype(np.float32)
+    X = rng.normal(size=(32, 12)).astype(np.float32)
+
+    def build():
+        return _random_pipeline(np.random.default_rng(seed + 1000), data)
+
+    ref = _run(build, X, fuse=True, auto_cache=False, cache_dir=None)
+    for fuse, auto_cache, use_disk in [
+        (False, False, False),
+        (True, True, False),
+        (True, False, True),
+    ]:
+        got = _run(
+            build, X, fuse=fuse, auto_cache=auto_cache,
+            cache_dir=tmp_path if use_disk else None,
+        )
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_rebuild_hits_fit_cache(seed):
+    """Two structurally identical builds in one session fit each estimator
+    once — content-stable prefixes dedup across graph copies."""
+    rng = np.random.default_rng(seed)
+    data = rng.normal(size=(64, 8)).astype(np.float32)
+    X = rng.normal(size=(16, 8)).astype(np.float32)
+
+    def build():
+        return _random_pipeline(np.random.default_rng(seed + 2000), data)
+
+    PipelineEnv.reset()
+    MeanCenter.fits = 0
+    # Keep the first pipeline alive: fit-cache entries are scoped to their
+    # estimator's lifetime (dropping every reference frees the pinned
+    # training data and evicts — by design; the DISK cache covers rebuilds
+    # after that, see test_disk_cache.py).
+    p1 = build()
+    out1 = np.asarray(p1.fit().apply(X).get())
+    fits_first = MeanCenter.fits
+    p2 = build()
+    out2 = np.asarray(p2.fit().apply(X).get())
+    np.testing.assert_allclose(out2, out1, rtol=1e-5)
+    assert MeanCenter.fits == fits_first  # zero refits on the rebuild
+    PipelineEnv.reset()
