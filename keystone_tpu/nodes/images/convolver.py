@@ -30,6 +30,7 @@ class Convolver(Transformer):
         filters: jax.Array,
         stride: int = 1,
         whitener=None,
+        compute_dtype: Optional[str] = None,
     ):
         filters = jnp.asarray(filters)
         self.num_filters, self.fh, self.fw, self.c = filters.shape
@@ -47,15 +48,32 @@ class Convolver(Transformer):
             self.bias = None
         self.filters = filters
         self.stride = stride
+        # "bfloat16": feed images + filters to the MXU in bf16 with f32
+        # accumulation — the conv throughput mode (outputs stay f32, so
+        # rectify/pool downstream are untouched). Normalized + validated
+        # here so "float32" means off everywhere and a bad dtype fails at
+        # the constructor, not deep inside a fused trace.
+        if compute_dtype is not None:
+            dt = jnp.dtype(compute_dtype)
+            compute_dtype = None if dt == jnp.float32 else str(dt)
+        self.compute_dtype = compute_dtype
 
     def apply_batch(self, X):
+        kwargs = {}
+        filters = self.filters
+        if self.compute_dtype is not None:
+            dt = jnp.dtype(self.compute_dtype)
+            X = X.astype(dt)
+            filters = filters.astype(dt)
+            kwargs["preferred_element_type"] = jnp.float32
         # NHWC × OHWI → NHWO
         out = lax.conv_general_dilated(
             X,
-            self.filters,
+            filters,
             window_strides=(self.stride, self.stride),
             padding="VALID",
             dimension_numbers=("NHWC", "OHWI", "NHWC"),
+            **kwargs,
         )
         if self.bias is not None:
             out = out + self.bias

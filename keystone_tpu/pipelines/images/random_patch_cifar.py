@@ -57,6 +57,9 @@ class RandomPatchCifarConfig:
     num_classes: int = 10
     seed: int = 0
     synthetic_n: int = 2048
+    # "bfloat16" runs the conv featurization on the MXU's bf16/f32-accum
+    # path (features and the solve stay f32 unless KEYSTONE_SOLVER_DTYPE).
+    feature_dtype: Optional[str] = None
 
 
 def build_featurizer(conf: RandomPatchCifarConfig, train_images) -> Pipeline:
@@ -79,7 +82,7 @@ def build_featurizer(conf: RandomPatchCifarConfig, train_images) -> Pipeline:
         conf.num_filters, conf.patch_size, conf.patch_size, c
     )
     return (
-        Convolver(filters, whitener=whitener)
+        Convolver(filters, whitener=whitener, compute_dtype=conf.feature_dtype)
         .and_then(SymmetricRectifier(alpha=conf.alpha))
         .and_then(Pooler(conf.pool_stride, conf.pool_size, mode="sum"))
         .and_then(ImageVectorizer())
@@ -134,6 +137,9 @@ def main(argv=None):
     p.add_argument("--num-iters", type=int, default=3)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--synthetic-n", type=int, default=2048)
+    p.add_argument(
+        "--feature-dtype", choices=["float32", "bfloat16"], default=None
+    )
     a = p.parse_args(argv)
     conf = RandomPatchCifarConfig(
         train_path=a.train_path,
@@ -144,6 +150,7 @@ def main(argv=None):
         num_iters=a.num_iters,
         seed=a.seed,
         synthetic_n=a.synthetic_n,
+        feature_dtype=a.feature_dtype,  # Convolver normalizes "float32"→off
     )
     out = run(conf)
     print(out["summary"])

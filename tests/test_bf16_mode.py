@@ -113,6 +113,39 @@ def test_ring_bcd_tracks_f32_solve(rng):
     assert np.linalg.norm(W16 - W_true) / np.linalg.norm(W_true) < 5e-2
 
 
+def test_bf16_conv_featurization_tracks_f32(rng):
+    """The featurization half of the throughput mode: bf16 conv inputs with
+    f32 accumulation track the f32 features within bf16 rounding, and the
+    outputs stay f32 for the downstream rectify/pool/solve."""
+    from keystone_tpu.nodes.images import Convolver
+
+    X = rng.normal(size=(8, 16, 16, 3)).astype(np.float32)
+    filters = rng.normal(size=(32, 5, 5, 3)).astype(np.float32) * 0.1
+    ref = np.asarray(Convolver(filters).apply_batch(jnp.asarray(X)))
+    got = Convolver(filters, compute_dtype="bfloat16").apply_batch(
+        jnp.asarray(X)
+    )
+    assert got.dtype == jnp.float32
+    got = np.asarray(got)
+    denom = max(np.abs(ref).max(), 1e-6)
+    assert np.abs(got - ref).max() / denom < 3e-2
+
+
+def test_cifar_pipeline_bf16_features():
+    """End-to-end: RandomPatchCifar with bf16 featurization keeps quality."""
+    from keystone_tpu.pipelines.images.random_patch_cifar import (
+        RandomPatchCifarConfig,
+        run,
+    )
+
+    conf = dict(
+        num_filters=32, patch_sample=512, synthetic_n=256, num_iters=2
+    )
+    f32 = run(RandomPatchCifarConfig(**conf))
+    b16 = run(RandomPatchCifarConfig(**conf, feature_dtype="bfloat16"))
+    assert b16["test_accuracy"] >= f32["test_accuracy"] - 0.05
+
+
 def test_estimator_prediction_parity(rng):
     """End-to-end: bf16-mode predictions match the f32 fit within bf16 noise."""
     X, Y, _ = _problem(rng, n=256, d=32, k=3)
