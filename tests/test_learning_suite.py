@@ -257,6 +257,49 @@ def test_kernel_ridge_rejects_kernel_plus_gamma():
         KernelRidgeRegression(kernel=GaussianKernelGenerator(1.0), gamma=2.0)
 
 
+def test_kernel_ridge_nystrom_preconditioner(rng):
+    """PCG must (a) agree with the plain CG solution and (b) converge in
+    strictly fewer iterations on an ill-conditioned RBF system (wide
+    kernel, small lam) — the regime the preconditioner exists for."""
+    n, d, k = 600, 12, 2
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    Y = rng.normal(size=(n, k)).astype(np.float32)
+    gamma, lam = 0.05, 1e-3
+    plain = KernelRidgeRegression(gamma=gamma, lam=lam, max_iters=500, tol=1e-4)
+    m_plain = plain.fit(X, Y)
+    pre = KernelRidgeRegression(
+        gamma=gamma, lam=lam, max_iters=500, tol=1e-4, precond_landmarks=200
+    )
+    m_pre = pre.fit(X, Y)
+    # Same stopping rule, same operator: both land on the same system
+    # solution within the residual tolerance.
+    sq = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+    K = np.exp(-gamma * sq)
+    for m in (m_plain, m_pre):
+        resid = np.linalg.norm(
+            (K + lam * np.eye(n)) @ np.asarray(m.alpha) - Y
+        ) / np.linalg.norm(Y)
+        assert resid < 1e-3
+    assert pre.last_cg_iters < plain.last_cg_iters / 2
+
+
+def test_kernel_ridge_preconditioned_padded_rows(rng):
+    """n not divisible by the mesh: padded rows must stay inert under the
+    preconditioner exactly as under plain CG."""
+    n, d = 150, 5  # 150 % 8 != 0
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    Y = rng.normal(size=(n, 2)).astype(np.float32)
+    gamma, lam = 0.3, 0.1
+    est = KernelRidgeRegression(
+        gamma=gamma, lam=lam, max_iters=400, tol=1e-7, precond_landmarks=64
+    )
+    model = est.fit(X, Y)
+    sq = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+    K = np.exp(-gamma * sq)
+    alpha = np.linalg.solve(K + lam * np.eye(n), Y.astype(np.float64))
+    np.testing.assert_allclose(np.asarray(model.alpha), alpha, atol=1e-2)
+
+
 def test_block_weighted_matches_weighted_ridge_oracle(rng):
     # Full check incl. intercept: weighted centering must reproduce the
     # exact weighted-ridge-with-intercept optimum in the single-block case.
