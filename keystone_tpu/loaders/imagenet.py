@@ -163,7 +163,9 @@ class ImageNetLoader:
 
         def produce():
             try:
-                with ThreadPoolExecutor(max_workers=workers) as pool:
+                with ThreadPoolExecutor(
+                    max_workers=workers, thread_name_prefix="keystone-decode"
+                ) as pool:
                     bufs: List[bytes] = []
                     labels: List[int] = []
 
@@ -192,7 +194,9 @@ class ImageNetLoader:
             finally:
                 q.put(DONE)
 
-        thread = threading.Thread(target=produce, daemon=True)
+        thread = threading.Thread(
+            target=produce, daemon=True, name="keystone-ingest-producer"
+        )
         thread.start()
         try:
             while True:

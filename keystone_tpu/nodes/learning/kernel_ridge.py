@@ -265,6 +265,11 @@ class KernelRidgeRegression(LabelEstimator):
         x_full = jax.device_put(
             A.data, NamedSharding(A.mesh, P())
         )
+        if self.precond_landmarks and self.lam <= 0.0:
+            raise ValueError(
+                "precond_landmarks requires lam > 0: the Woodbury "
+                "preconditioner divides by lam (plain CG handles lam=0)"
+            )
         if self.precond_landmarks:
             m = min(int(self.precond_landmarks), A.n)
             rng = np.random.default_rng(self.seed)

@@ -43,6 +43,23 @@ def cost_analysis(fn: Callable, *args) -> Dict[str, Any]:
     }
 
 
+@contextmanager
+def maybe_trace(tag: str):
+    """Capture a jax profiler trace when KEYSTONE_PROFILE_DIR is set — the
+    tensorboard-consumable artifact for MXU-utilization work on hardware.
+    No-op (zero overhead) when the knob is absent."""
+    import os
+
+    out = os.environ.get("KEYSTONE_PROFILE_DIR")
+    if not out:
+        yield
+        return
+    path = os.path.join(out, tag)
+    with jax.profiler.trace(path):
+        yield
+    logger.info("profiler trace written to %s", path)
+
+
 def device_hbm_bytes(default: int | None = None) -> int:
     """Memory budget of device 0 as the runtime reports it (``bytes_limit``
     from ``memory_stats``), falling back to ``config.hbm_budget_bytes`` for

@@ -81,17 +81,26 @@ def test_abandoned_stream_stops_producer(jpeg_tree):
     import time
 
     root, label_map = jpeg_tree
-    before = set(threading.enumerate())
     gen = ImageNetLoader.stream_batches(
         root, label_map, batch_size=2, size=32, workers=2, prefetch=1
     )
     next(gen)
     gen.close()  # consumer walks away mid-stream
+
+    def ours():
+        # The producer and its pool carry keystone-specific names, so this
+        # can't flake on unrelated threads other tests/jax spin up.
+        return [
+            t
+            for t in threading.enumerate()
+            if t.is_alive()
+            and ("keystone-ingest" in t.name or "keystone-decode" in t.name)
+        ]
+
     # The producer (and its pool) must unblock and exit, not strand on the
-    # full queue. Compare thread identities: unrelated helper threads from
-    # other tests/jax must not flake this.
-    for _ in range(50):
-        leaked = [t for t in threading.enumerate() if t not in before and t.is_alive()]
+    # full queue.
+    for _ in range(100):
+        leaked = ours()
         if not leaked:
             break
         time.sleep(0.1)
