@@ -61,6 +61,11 @@ class Config:
     # Raise on NaNs inside jitted computations (jax debug_nans; the
     # sanitizer analog — SURVEY.md §5 race-detection row).
     debug_nans: bool = False
+    # Datasets above this size keep id-based signatures instead of content
+    # fingerprints: hashing multi-hundred-MB streamed batches costs real
+    # time per batch and such batches are transform inputs, not the fit
+    # inputs the cross-process cache exists for.
+    fingerprint_max_bytes: int = 128 << 20
     # Vocabulary size at which text vectorizers switch from dense (batch, K)
     # output to a host-side CSR SparseBatch (consumers densify per column
     # block). Below this, dense batches feed the MXU classifiers directly.

@@ -124,6 +124,35 @@ class ImageNetLoader:
         )
 
     @staticmethod
+    def load_balanced_sample(
+        data_path: str,
+        label_map: Dict[str, int],
+        total: int,
+        size: int = 256,
+        workers: int = 16,
+    ) -> np.ndarray:
+        """~total images drawn a few per synset (decoded NHWC) — the
+        class-balanced fitting sample for featurizer statistics (a prefix
+        of the sorted walk would be a single class)."""
+        entries = [
+            e
+            for e in sorted(os.listdir(data_path))
+            if (e[:-4] if e.endswith(".tar") else e) in label_map
+        ]
+        per = max(1, -(-total // max(len(entries), 1)))  # ceil
+        bufs: List[bytes] = []
+        for entry in entries:
+            synset = entry[:-4] if entry.endswith(".tar") else entry
+            for buf, _label in ImageNetLoader.iter_jobs(
+                data_path, {synset: label_map[synset]}, limit=per
+            ):
+                bufs.append(buf)
+            if len(bufs) >= total:
+                break
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            return _decode_batch(bufs[:total], size, pool)
+
+    @staticmethod
     def stream_batches(
         data_path: str,
         label_map: Dict[str, int],

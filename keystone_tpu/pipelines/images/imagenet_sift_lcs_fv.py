@@ -61,6 +61,14 @@ class ImageNetSiftLcsFVConfig:
     seed: int = 0
     synthetic_n: int = 512
     synthetic_classes: int = 16
+    # Out-of-core mode: fit the featurizer on a bounded image sample, then
+    # stream images from disk (decode-ahead) and featurize batch by batch —
+    # only FEATURES are held on host, and the solve streams feature blocks
+    # to the device. The single-host projection of the reference's
+    # cache-features-not-images cluster layout (SURVEY.md §7 hard parts 1+4).
+    stream: bool = False
+    stream_batch: int = 256
+    fit_sample_images: int = 512
 
 
 def build_featurizer(conf: ImageNetSiftLcsFVConfig, train_images) -> Pipeline:
@@ -84,7 +92,120 @@ def build_featurizer(conf: ImageNetSiftLcsFVConfig, train_images) -> Pipeline:
     return Pipeline.gather(branches)
 
 
+def _synthetic_batches(data, labels, batch_size):
+    for s in range(0, len(data), batch_size):
+        yield data[s : s + batch_size], labels[s : s + batch_size]
+
+
+def run_streamed(conf: ImageNetSiftLcsFVConfig) -> dict:
+    """Out-of-core execution of the north-star pipeline.
+
+    Images never sit in memory all at once: the featurizer (PCA/GMM) fits
+    on ``fit_sample_images``, train batches stream through it (decode of
+    batch b+1 overlapping featurization of batch b on real paths), the
+    accumulated FEATURE matrix — ~3× smaller than the images at the
+    64k-dim config — feeds the host-streamed weighted BCD, and test
+    batches stream through scoring the same way.
+    """
+    if conf.augment:
+        raise ValueError(
+            "test-time augmentation is not supported with --stream; run the "
+            "eager mode for the TTA protocol"
+        )
+    if conf.data_path:
+        if not (conf.test_data_path and conf.label_map_path):
+            raise ValueError("real data requires test path and label map")
+        label_map = ImageNetLoader.load_label_map(conf.label_map_path)
+        # Class-balanced fitting sample: PCA/GMM fit on a few images from
+        # EVERY synset — a prefix of the sorted walk would be one class.
+        fit_sample = ImageNetLoader.load_balanced_sample(
+            conf.data_path, label_map, total=conf.fit_sample_images
+        )
+        num_classes = max(label_map.values()) + 1
+
+        def train_batches():
+            return ImageNetLoader.stream_batches(
+                conf.data_path, label_map, batch_size=conf.stream_batch
+            )
+
+        def test_batches():
+            return ImageNetLoader.stream_batches(
+                conf.test_data_path, label_map, batch_size=conf.stream_batch
+            )
+
+    else:
+        train, test = ImageNetLoader.synthetic(
+            n=conf.synthetic_n, num_classes=conf.synthetic_classes
+        )
+        fit_sample = train.data[: conf.fit_sample_images]
+        num_classes = conf.synthetic_classes
+
+        def train_batches():
+            return _synthetic_batches(
+                train.data, train.labels, conf.stream_batch
+            )
+
+        def test_batches():
+            return _synthetic_batches(test.data, test.labels, conf.stream_batch)
+
+    t0 = time.time()
+    featurizer = build_featurizer(conf, fit_sample)
+
+    feats, labels = [], []
+    for X, y in train_batches():
+        feats.append(np.asarray(featurizer(X).get()))
+        labels.append(np.asarray(y))
+    # Assemble in place, freeing each chunk as it lands: peak host memory is
+    # the feature matrix + ONE batch, not the 2× a concatenate would cost
+    # (the whole point of this mode at the 64k-dim scale).
+    n_total = sum(len(f) for f in feats)
+    A_host = np.empty((n_total, feats[0].shape[1]), dtype=feats[0].dtype)
+    off = 0
+    while feats:
+        f = feats.pop(0)
+        A_host[off : off + len(f)] = f
+        off += len(f)
+    y_train = np.concatenate(labels)
+
+    targets = np.asarray(ClassLabelIndicators(num_classes)(y_train))
+    solver = BlockWeightedLeastSquaresEstimator(
+        block_size=conf.block_size,
+        num_iters=conf.num_iters,
+        lam=conf.lam,
+        mixture_weight=conf.mixture_weight,
+        stream=True,  # feature blocks stream to the device, double-buffered
+    )
+    model = solver.fit(A_host, targets)
+    del A_host
+
+    correct = []
+    top1_wrong = []
+    for X, y in test_batches():
+        scores = model.apply_batch(np.asarray(featurizer(X).get()))
+        topk = np.asarray(TopKClassifier(conf.top_k)(scores))
+        correct.append((topk == np.asarray(y)[:, None]).any(axis=1))
+        top1_wrong.append(topk[:, 0] != np.asarray(y))
+    correct = np.concatenate(correct)
+    top1_wrong = np.concatenate(top1_wrong)
+    elapsed = time.time() - t0
+
+    top_k_error = float(1.0 - correct.mean())
+    top1 = float(top1_wrong.mean())
+    return {
+        "top_k_error": top_k_error,
+        "top_1_error": top1,
+        "feature_dim": 2 * (2 * conf.gmm_k * conf.pca_dims),
+        "seconds": elapsed,
+        "summary": (
+            f"top-{conf.top_k} error: {top_k_error:.4f} | "
+            f"top-1 error: {top1:.4f} (streamed)"
+        ),
+    }
+
+
 def run(conf: ImageNetSiftLcsFVConfig) -> dict:
+    if conf.stream:
+        return run_streamed(conf)
     if conf.data_path:
         if not (conf.test_data_path and conf.label_map_path):
             raise ValueError("real data requires test path and label map")
@@ -157,6 +278,10 @@ def main(argv=None):
     p.add_argument("--augment-crop", type=int, default=0,
                    help="crop side in pixels (0 = 7/8 of the image side)")
     p.add_argument("--fv-backend", choices=["tpu", "pallas", "native"], default="tpu")
+    p.add_argument("--stream", action="store_true",
+                   help="out-of-core: stream images, hold only features")
+    p.add_argument("--stream-batch", type=int, default=256)
+    p.add_argument("--fit-sample-images", type=int, default=512)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--synthetic-n", type=int, default=512)
     p.add_argument("--synthetic-classes", type=int, default=16)
@@ -174,6 +299,9 @@ def main(argv=None):
             augment=a.augment,
             augment_crop=a.augment_crop,
             fv_backend=a.fv_backend,
+            stream=a.stream,
+            stream_batch=a.stream_batch,
+            fit_sample_images=a.fit_sample_images,
             seed=a.seed,
             synthetic_n=a.synthetic_n,
             synthetic_classes=a.synthetic_classes,

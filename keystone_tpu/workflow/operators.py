@@ -115,13 +115,21 @@ class DatasetOperator(Operator):
                 array_fingerprint,
             )
 
+            from keystone_tpu.config import config
+
             data = self.data
-            if isinstance(data, jax.Array):
-                data = np.asarray(data)
-            if isinstance(data, np.ndarray) and data.dtype.kind in "biufc":
-                sig = ("dataset", array_fingerprint(data))
-            else:
+            # Size gate FIRST (jax.Array exposes nbytes): an over-budget
+            # device array must not pay the D2H copy just to be discarded.
+            nbytes = getattr(data, "nbytes", None)
+            if nbytes is not None and nbytes > config.fingerprint_max_bytes:
                 sig = ("dataset", id(self.data), UNSTABLE)
+            else:
+                if isinstance(data, jax.Array):
+                    data = np.asarray(data)
+                if isinstance(data, np.ndarray) and data.dtype.kind in "biufc":
+                    sig = ("dataset", array_fingerprint(data))
+                else:
+                    sig = ("dataset", id(self.data), UNSTABLE)
             self._sig_cache = sig
         return sig
 

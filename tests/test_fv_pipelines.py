@@ -104,6 +104,36 @@ def test_imagenet_sift_lcs_fv_end_to_end():
     assert out["top_1_error"] < 0.5, out["summary"]
 
 
+def test_imagenet_streamed_matches_eager():
+    """Out-of-core mode: streaming batches through the featurizer and the
+    host-streamed solver must reproduce the eager run (same fitting sample,
+    same data — only the execution schedule differs)."""
+    from keystone_tpu.pipelines.images.imagenet_sift_lcs_fv import (
+        ImageNetSiftLcsFVConfig,
+        run,
+    )
+
+    base = dict(
+        synthetic_n=192,
+        synthetic_classes=6,
+        pca_dims=16,
+        gmm_k=4,
+        descriptor_sample=20_000,
+        num_iters=1,
+        top_k=3,
+    )
+    eager = run(ImageNetSiftLcsFVConfig(**base))
+    streamed = run(
+        ImageNetSiftLcsFVConfig(
+            **base, stream=True, stream_batch=64, fit_sample_images=192
+        )
+    )
+    # Same featurizer (full train as fitting sample), same solve — the
+    # schedules agree to solver tolerance.
+    assert abs(streamed["top_k_error"] - eager["top_k_error"]) < 0.05
+    assert abs(streamed["top_1_error"] - eager["top_1_error"]) < 0.1
+
+
 @needs_native
 def test_fitted_native_pipeline_save_load(tmp_path):
     import numpy as np

@@ -158,6 +158,31 @@ class TestNativeJpegPool:
         assert out.shape == (0, 16, 16, 3)
 
 
+def test_balanced_sample_spans_synsets(jpeg_tree):
+    root, label_map = jpeg_tree  # 2 synsets x 6 images
+    sample = ImageNetLoader.load_balanced_sample(
+        root, label_map, total=4, size=32, workers=2
+    )
+    assert sample.shape == (4, 32, 32, 3)
+    # 4 across 2 synsets = 2 per synset: images from BOTH classes, not a
+    # prefix of the first (the bug this helper exists to avoid).
+    eager = ImageNetLoader.load(root, label_map, size=32, workers=2)
+    first = eager.data[:2]  # synset 0's first two
+    second = eager.data[6:8]  # synset 1's first two
+    np.testing.assert_allclose(sample[:2], first, atol=1e-6)
+    np.testing.assert_allclose(sample[2:], second, atol=1e-6)
+
+
+def test_streamed_rejects_augment():
+    from keystone_tpu.pipelines.images.imagenet_sift_lcs_fv import (
+        ImageNetSiftLcsFVConfig,
+        run,
+    )
+
+    with pytest.raises(ValueError, match="augmentation"):
+        run(ImageNetSiftLcsFVConfig(stream=True, augment=True))
+
+
 def test_stream_surfaces_decode_errors(tmp_path):
     d = tmp_path / "n00000000"
     d.mkdir()
