@@ -7,6 +7,7 @@
 #   KEYSTONE_PLATFORM=cpu|axon     force the JAX platform (default: auto)
 #   KEYSTONE_NUM_DEVICES=N         virtual CPU device count (testing meshes)
 #   KEYSTONE_NO_FUSE=1             disable chain fusion (debugging)
+#   KEYSTONE_AUTO_CACHE=1          profile + auto-insert cache nodes
 #   KEYSTONE_CACHE_DIR=path        fitted-prefix store; a rerun with the same
 #                                  data + hyperparams skips refits entirely
 #                                  (default: .keystone_cache next to the repo;

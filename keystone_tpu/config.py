@@ -61,10 +61,10 @@ class Config:
     # Raise on NaNs inside jitted computations (jax debug_nans; the
     # sanitizer analog — SURVEY.md §5 race-detection row).
     debug_nans: bool = False
-    # Datasets above this size keep id-based signatures instead of content
-    # fingerprints: hashing multi-hundred-MB streamed batches costs real
-    # time per batch and such batches are transform inputs, not the fit
-    # inputs the cross-process cache exists for.
+    # Arrays above this size are fingerprinted from a deterministic chunk
+    # sample instead of a full scan: multi-GB fit inputs stay
+    # content-addressed (the cross-process cache keeps working at real
+    # scale) without paying full-buffer hashing per streamed batch.
     fingerprint_max_bytes: int = 128 << 20
     # Vocabulary size at which text vectorizers switch from dense (batch, K)
     # output to a host-side CSR SparseBatch (consumers densify per column

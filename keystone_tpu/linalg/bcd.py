@@ -426,6 +426,7 @@ def block_coordinate_descent_streamed(
     lam: float = 0.0,
     row_weights: Optional[jax.Array] = None,
     checkpoint_dir: Optional[str] = None,
+    col_center: Optional[np.ndarray] = None,
 ) -> Tuple[List[jax.Array], List[Tuple[int, int]]]:
     """BCD for feature matrices that exceed HBM: A stays in host RAM and
     column blocks stream to the device double-buffered — the transfer of
@@ -436,6 +437,11 @@ def block_coordinate_descent_streamed(
     text path): sparse blocks densify per column block right here, so an
     (n, vocab) dense matrix never exists anywhere.
 
+    ``col_center`` (dense only): per-column means subtracted from each
+    block AS it streams — the intercept-centering of the estimator layer
+    without a second full-size host copy of A (each block is a fresh copy
+    on its way to the device anyway).
+
     The first epoch fuses gram+Cholesky into each block update and keeps
     the small (b, b) factors resident, so later epochs run the cheap
     cached update while still streaming only one block of A at a time.
@@ -443,6 +449,11 @@ def block_coordinate_descent_streamed(
     from keystone_tpu.utils.sparse import SparseBatch
 
     sparse = isinstance(A_host, SparseBatch)
+    if sparse and col_center is not None:
+        raise ValueError(
+            "col_center is a dense-path feature (sparse fits learn the "
+            "intercept via an appended ones column)"
+        )
     mesh, axis = B.mesh, config.data_axis
     if A_host.shape[0] != B.n:
         raise ValueError(
@@ -459,10 +470,20 @@ def block_coordinate_descent_streamed(
     pad = B.padded_rows - A_host.shape[0]
     sharding = jax.sharding.NamedSharding(mesh, P(axis))
 
+    # Center in A's own (full-width) dtype BEFORE any storage-dtype cast:
+    # subtracting a large mean after bf16 quantization would leave the
+    # centered values carrying the uncentered magnitude's rounding error
+    # (catastrophic cancellation) — the device path centers in f32 too.
+    center = (
+        None if col_center is None else np.asarray(col_center, dtype=A_host.dtype)
+    )
+
     def put(i: int) -> jax.Array:
         s, e = blocks[i]
         if sparse:
             block = A_host.densify(s, e, dtype=dtype)
+        elif center is not None:
+            block = np.asarray(A_host[:, s:e] - center[s:e], dtype=dtype)
         else:
             block = np.ascontiguousarray(A_host[:, s:e], dtype=dtype)
         if pad:
@@ -491,7 +512,10 @@ def block_coordinate_descent_streamed(
         if sparse:
             a_probe = A_host.row_sum(0) + A_host.row_sum(len(A_host) - 1)
         else:
-            a_probe = float(A_host[0].sum() + A_host[-1].sum())
+            # Probe the EFFECTIVE (centered) matrix so device-path and
+            # streamed-path checkpoints stay mutually resumable.
+            shift = 2.0 * float(center.sum()) if center is not None else 0.0
+            a_probe = float(A_host[0].sum() + A_host[-1].sum()) - shift
         fingerprint = _make_fingerprint(
             B, d, block_size, lam, weighted, a_probe=a_probe, a_dtype=dtype
         )

@@ -139,6 +139,11 @@ class ImageNetLoader:
             for e in sorted(os.listdir(data_path))
             if (e[:-4] if e.endswith(".tar") else e) in label_map
         ]
+        if len(entries) > total > 0:
+            # Fewer samples than synsets: stride across the whole alphabet
+            # instead of stopping at a prefix of it (class-coverage bias).
+            stride = len(entries) / total
+            entries = [entries[int(i * stride)] for i in range(total)]
         per = max(1, -(-total // max(len(entries), 1)))  # ceil
         bufs: List[bytes] = []
         for entry in entries:
@@ -221,7 +226,7 @@ class ImageNetLoader:
             except BaseException as e:  # surface in the consumer thread
                 put(e)
             finally:
-                q.put(DONE)
+                put(DONE)  # stop-aware: never blocks an abandoned stream
 
         thread = threading.Thread(
             target=produce, daemon=True, name="keystone-ingest-producer"
@@ -237,12 +242,15 @@ class ImageNetLoader:
                 yield item
         finally:
             stop.set()
-            while True:  # drain so the producer's final put can't block
+            # Keep draining until the producer is DEAD: a one-shot drain
+            # races its in-flight put (it can land right after we empty the
+            # queue, leaving a blocking put + a stranded thread).
+            while thread.is_alive():
                 try:
-                    q.get_nowait()
+                    q.get(timeout=0.1)
                 except queue.Empty:
-                    break
-            thread.join(timeout=30)
+                    pass
+                thread.join(timeout=0.1)
 
     @staticmethod
     def synthetic(

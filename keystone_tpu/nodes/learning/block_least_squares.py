@@ -105,8 +105,10 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             stream = a_bytes > config.hbm_budget_bytes // 2
 
         if stream:
-            # Features stay in host RAM; center there, stream blocks down.
-            X_host = np.array(data, dtype=config.default_dtype, copy=True)
+            # Features stay in host RAM — the caller's array, uncopied and
+            # unmodified: centering happens per block as it streams
+            # (col_center), so peak memory is A + one block, never 2·A.
+            X_host = np.asarray(data, dtype=config.default_dtype)
             Y = jnp.asarray(labels)
             weights = self._weights(Y)
             x_mean = y_mean = None
@@ -125,7 +127,6 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                     y_mean = (weights[:, None] * Y).sum(0) / jnp.maximum(
                         weights.sum(), 1e-12
                     )
-                X_host -= x_mean.astype(X_host.dtype)
                 Y = Y - y_mean
             B = RowMatrix.from_array(Y)
             W_blocks, blocks = block_coordinate_descent_streamed(
@@ -136,6 +137,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                 lam=self.lam,
                 row_weights=weights,
                 checkpoint_dir=self.checkpoint_dir,
+                col_center=None if x_mean is None else np.asarray(x_mean),
             )
             b = None
             if self.fit_intercept:

@@ -92,11 +92,6 @@ def build_featurizer(conf: ImageNetSiftLcsFVConfig, train_images) -> Pipeline:
     return Pipeline.gather(branches)
 
 
-def _synthetic_batches(data, labels, batch_size):
-    for s in range(0, len(data), batch_size):
-        yield data[s : s + batch_size], labels[s : s + batch_size]
-
-
 def run_streamed(conf: ImageNetSiftLcsFVConfig) -> dict:
     """Out-of-core execution of the north-star pipeline.
 
@@ -134,6 +129,8 @@ def run_streamed(conf: ImageNetSiftLcsFVConfig) -> dict:
             )
 
     else:
+        from keystone_tpu.loaders.stream import BatchIterator
+
         train, test = ImageNetLoader.synthetic(
             n=conf.synthetic_n, num_classes=conf.synthetic_classes
         )
@@ -141,12 +138,18 @@ def run_streamed(conf: ImageNetSiftLcsFVConfig) -> dict:
         num_classes = conf.synthetic_classes
 
         def train_batches():
-            return _synthetic_batches(
-                train.data, train.labels, conf.stream_batch
+            return iter(
+                BatchIterator.from_arrays(
+                    train.data, train.labels, conf.stream_batch
+                )
             )
 
         def test_batches():
-            return _synthetic_batches(test.data, test.labels, conf.stream_batch)
+            return iter(
+                BatchIterator.from_arrays(
+                    test.data, test.labels, conf.stream_batch
+                )
+            )
 
     t0 = time.time()
     featurizer = build_featurizer(conf, fit_sample)
@@ -155,6 +158,11 @@ def run_streamed(conf: ImageNetSiftLcsFVConfig) -> dict:
     for X, y in train_batches():
         feats.append(np.asarray(featurizer(X).get()))
         labels.append(np.asarray(y))
+    if not feats:
+        raise ValueError(
+            "the training stream produced no batches — check that the data "
+            "directory's synsets appear in the label map"
+        )
     # Assemble in place, freeing each chunk as it lands: peak host memory is
     # the feature matrix + ONE batch, not the 2× a concatenate would cost
     # (the whole point of this mode at the 64k-dim scale).

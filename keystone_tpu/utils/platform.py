@@ -136,6 +136,8 @@ def setup_platform() -> None:
         import jax
 
         jax.config.update("jax_debug_nans", True)
+    if env_flag("KEYSTONE_AUTO_CACHE"):
+        config.auto_cache = True
     # Multi-host rendezvous when the env knobs are present (no-op otherwise).
     from keystone_tpu.utils import distributed
 

@@ -47,13 +47,39 @@ def _is_jax_array(v: Any) -> bool:
 
 
 def array_fingerprint(a: np.ndarray) -> tuple:
-    """Content identity of a numeric array: shape, dtype, blake2b of bytes."""
+    """Content identity of a numeric array: shape, dtype, blake2b of bytes.
+
+    Above ``config.fingerprint_max_bytes`` the digest covers a deterministic
+    sample (64 evenly-spaced 1 MiB chunks plus head and tail) instead of the
+    full buffer — bounded cost for multi-GB fit inputs, at the engineering
+    risk (same as the solver checkpoint fingerprints' row probes) that a
+    change confined entirely to unsampled bytes goes unseen. Real data never
+    changes that way; adversarial inputs shouldn't share a cache dir.
+    """
+    from keystone_tpu.config import config
+
     h = hashlib.blake2b(digest_size=16)
     h.update(repr(a.shape).encode())
     h.update(str(a.dtype).encode())
-    c = np.ascontiguousarray(a)
-    h.update(memoryview(c).cast("B"))
-    return ("ndarray", a.shape, str(a.dtype), h.hexdigest())
+    limit = config.fingerprint_max_bytes
+    if a.nbytes <= limit:
+        c = np.ascontiguousarray(a)  # bounded by limit even when it copies
+        h.update(memoryview(c).cast("B"))
+        return ("ndarray", a.shape, str(a.dtype), h.hexdigest())
+    # Over-limit: sample ~64 row-block chunks of ~1 MiB via axis-0 slices —
+    # views, so a non-contiguous multi-GB array is never materialized whole
+    # (only each small chunk is made contiguous).
+    h.update(str(a.nbytes).encode())
+    n0 = a.shape[0]
+    row_bytes = max(a.nbytes // max(n0, 1), 1)
+    rows_per = max(1, (1 << 20) // row_bytes)
+    stride = max(n0 // 64, rows_per)
+    for s in range(0, n0, stride):
+        chunk = np.ascontiguousarray(a[s : s + rows_per])
+        h.update(memoryview(chunk).cast("B"))
+    tail = np.ascontiguousarray(a[max(n0 - rows_per, 0) :])
+    h.update(memoryview(tail).cast("B"))
+    return ("ndarray-sampled", a.shape, str(a.dtype), h.hexdigest())
 
 
 def stable_value(v: Any) -> Any:

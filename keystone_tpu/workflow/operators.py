@@ -118,18 +118,20 @@ class DatasetOperator(Operator):
             from keystone_tpu.config import config
 
             data = self.data
-            # Size gate FIRST (jax.Array exposes nbytes): an over-budget
-            # device array must not pay the D2H copy just to be discarded.
-            nbytes = getattr(data, "nbytes", None)
-            if nbytes is not None and nbytes > config.fingerprint_max_bytes:
-                sig = ("dataset", id(self.data), UNSTABLE)
+            if isinstance(data, jax.Array):
+                if data.nbytes > config.fingerprint_max_bytes:
+                    # Sampled hashing would still need the full D2H copy
+                    # for a device array; not worth it.
+                    self._sig_cache = ("dataset", id(self.data), UNSTABLE)
+                    return self._sig_cache
+                data = np.asarray(data)
+            if isinstance(data, np.ndarray) and data.dtype.kind in "biufc":
+                # array_fingerprint switches to a bounded chunk-sampled
+                # digest above config.fingerprint_max_bytes, so huge fit
+                # inputs stay content-addressed at fixed cost.
+                sig = ("dataset", array_fingerprint(data))
             else:
-                if isinstance(data, jax.Array):
-                    data = np.asarray(data)
-                if isinstance(data, np.ndarray) and data.dtype.kind in "biufc":
-                    sig = ("dataset", array_fingerprint(data))
-                else:
-                    sig = ("dataset", id(self.data), UNSTABLE)
+                sig = ("dataset", id(self.data), UNSTABLE)
             self._sig_cache = sig
         return sig
 
