@@ -80,6 +80,12 @@ class ChainFusionRule(Rule):
         key = tuple(id(s) for s in stages)
         fused = self._fuse_cache.get(key)
         if fused is None:
+            while len(self._fuse_cache) > 1024:
+                # Bound the memo by evicting the OLDEST entry (dict keeps
+                # insertion order): wholesale clearing would force live hot
+                # pipelines to re-fuse (new identity, recompile, cache-miss
+                # cascade); dropping one cold entry degrades gracefully.
+                self._fuse_cache.pop(next(iter(self._fuse_cache)))
             fused = FusedTransformer(stages)
             self._fuse_cache[key] = fused
         return fused
@@ -153,8 +159,9 @@ def default_optimizer() -> Optimizer:
     batches: List[Tuple[str, List[Rule], int]] = [
         ("dedup", [EquivalentNodeMergeRule()], 3),
         ("node-level", [NodeOptimizationRule()], 1),
+        # Gated per-apply on config.auto_cache (see AutoCacheRule), so the
+        # flag works whenever it's flipped, not only before env creation.
+        ("auto-cache", [AutoCacheRule(only_if_enabled=True)], 1),
+        ("fusion", [ChainFusionRule()], 1),
     ]
-    if config.auto_cache:
-        batches.append(("auto-cache", [AutoCacheRule()], 1))
-    batches.append(("fusion", [ChainFusionRule()], 1))
     return Optimizer(batches)

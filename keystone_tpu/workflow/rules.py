@@ -105,12 +105,20 @@ class AutoCacheRule(Rule):
         budget_bytes: int | None = None,
         sample_rows: int = 64,
         min_consumers: int = 1,
+        only_if_enabled: bool = False,
     ):
         self.budget_bytes = budget_bytes
         self.sample_rows = sample_rows
         self.min_consumers = min_consumers
+        # The default optimizer installs the rule unconditionally and gates
+        # each apply on config.auto_cache, so toggling the flag mid-session
+        # takes effect instead of silently depending on when PipelineEnv
+        # was constructed. Directly-constructed rules stay unconditional.
+        self.only_if_enabled = only_if_enabled
 
     def apply(self, graph: Graph, targets: Sequence[GraphId]) -> Graph:
+        if self.only_if_enabled and not config.auto_cache:
+            return graph
         # `is not None`: an explicit 0 means "no cache budget", not "unset".
         if self.budget_bytes is not None:
             budget = self.budget_bytes

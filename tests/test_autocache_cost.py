@@ -112,6 +112,27 @@ def test_device_hbm_budget_default_on_unreportable(monkeypatch):
     assert device_hbm_bytes(default=123) == 123
 
 
+def test_auto_cache_flag_works_after_env_creation(monkeypatch):
+    """Flipping config.auto_cache mid-session must take effect — the rule
+    is installed unconditionally and gated per apply."""
+    from keystone_tpu.config import config
+    from keystone_tpu.workflow import PipelineEnv
+
+    # (conftest's autouse fresh_env fixture resets PipelineEnv around every
+    # test, so no explicit cleanup is needed even on assertion failure.)
+    g, data, q, l, sink = _graph(n=256)
+    env = PipelineEnv.get()  # constructed while auto_cache is False
+    out_off = env.optimizer.execute(g, [sink])
+    assert not any(
+        isinstance(op, CacheOperator) for op in out_off.operators.values()
+    )
+    monkeypatch.setattr(config, "auto_cache", True)
+    out_on = env.optimizer.execute(g, [sink])  # same env, flag now on
+    assert any(
+        isinstance(op, CacheOperator) for op in out_on.operators.values()
+    )
+
+
 def test_zero_budget_caches_nothing(monkeypatch):
     g, data, q, l, sink = _graph()
 
