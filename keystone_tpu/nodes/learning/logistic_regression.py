@@ -85,19 +85,12 @@ class LogisticRegressionEstimator(LabelEstimator):
         from keystone_tpu.utils.sparse import SparseBatch
 
         if isinstance(data, SparseBatch):
-            # LBFGS re-reads X every iteration; no blockwise seam exists
-            # here, so sparse input densifies once — loudly.
-            import logging
-
-            logging.getLogger("keystone_tpu").warning(
-                "LogisticRegressionEstimator densifies SparseBatch input "
-                "(%s -> %.0f MiB); prefer NaiveBayes or the block solvers "
-                "at large vocabularies",
-                data,
-                data.shape[0] * data.shape[1] * 4 / 2**20,
-            )
-            data = data.toarray()
-        X = jnp.asarray(data, dtype=config.default_dtype)
+            # Device-sparse fit: the LBFGS loop re-reads X every iteration,
+            # so X rides along as a BCOO — `X @ W` inside the jitted loss
+            # stays sparse and an (n, vocab) dense array never exists.
+            X = data.to_bcoo(dtype=config.default_dtype)
+        else:
+            X = jnp.asarray(data, dtype=config.default_dtype)
         y = jnp.asarray(labels).astype(jnp.int32).ravel()
         W, b = _fit_lbfgs(X, y, self.num_classes, self.reg, self.max_iters)
         return LogisticRegressionModel(W, b)

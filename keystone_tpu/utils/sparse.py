@@ -169,6 +169,26 @@ class SparseBatch:
         s, e = int(self.indptr[i]), int(self.indptr[i + 1])
         return float(self.values[s:e].sum())
 
+    def to_bcoo(self, dtype=None):
+        """This batch as a ``jax.experimental.sparse.BCOO`` on the default
+        device — the device-sparse view for models that iterate over X
+        inside jit (e.g. logistic regression's LBFGS loop). COO coords come
+        straight from the CSR structure; nothing densifies.
+        ``unique_indices=True`` is safe by the class invariant (indices are
+        unique per row) and unlocks the cheaper scatter lowerings."""
+        import jax.numpy as jnp
+        from jax.experimental import sparse as jsparse
+
+        values = self.values if dtype is None else self.values.astype(dtype)
+        coords = np.stack(
+            [self._row_ids().astype(np.int32), self.indices], axis=1
+        )
+        return jsparse.BCOO(
+            (jnp.asarray(values), jnp.asarray(coords)),
+            shape=self.shape,
+            unique_indices=True,
+        )
+
     # -- structure edits ---------------------------------------------------
 
     def append_ones(self) -> "SparseBatch":
