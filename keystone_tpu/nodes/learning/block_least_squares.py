@@ -79,7 +79,10 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         fit_intercept: bool = True,
         checkpoint_dir: Optional[str] = None,
         stream: Optional[bool] = None,
+        parallelism: str = "data",
     ):
+        if parallelism not in ("data", "model"):
+            raise ValueError("parallelism must be 'data' or 'model'")
         self.block_size = block_size
         self.num_iters = num_iters
         self.lam = lam
@@ -89,6 +92,10 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         # Host-streamed feature blocks (double-buffered H2D) for feature
         # matrices that exceed HBM; None = auto by size.
         self.stream = stream
+        # "data": rows sharded, psum'd grams (the default). "model": the
+        # d-axis shards across the mesh and residual chunks ride a ppermute
+        # ring (linalg/ring_bcd.py) — the right trade when d dwarfs n·k.
+        self.parallelism = parallelism
 
     def _weights(self, Y: jnp.ndarray) -> Optional[jax.Array]:
         return None
@@ -97,7 +104,14 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         from keystone_tpu.utils.sparse import SparseBatch
 
         if isinstance(data, SparseBatch):
+            if self.parallelism == "model":
+                raise ValueError(
+                    "model parallelism is a dense-feature path; sparse "
+                    "features use the streamed data-parallel solve"
+                )
             return self._fit_sparse(data, labels)
+        if self.parallelism == "model":
+            return self._fit_ring(data, labels)
         stream = self.stream
         itemsize = jnp.dtype(config.default_dtype).itemsize
         if stream is None:
@@ -182,6 +196,45 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         return BlockLinearMapper(W_blocks, blocks, b)
 
 
+    def _fit_ring(self, data, labels) -> BlockLinearMapper:
+        """Model-parallel fit: columns of A shard across the mesh and the
+        residual chunks ride a ppermute ring (no gram psum, no all-gather —
+        see linalg/ring_bcd.py for the layout and comm accounting)."""
+        from keystone_tpu.linalg import block_coordinate_descent_ring
+
+        if self._weights(jnp.asarray(labels)) is not None:
+            raise ValueError(
+                "the ring solver has no per-row weighting; use "
+                "parallelism='data' for the class-weighted problem"
+            )
+        if self.checkpoint_dir is not None or self.stream:
+            # Refuse rather than silently drop resume/streaming semantics
+            # the data-parallel path would have honored.
+            raise ValueError(
+                "checkpoint_dir/stream are data-parallel features; the ring "
+                "solver keeps its d-shard resident and has no epoch "
+                "checkpointing (block_size is likewise implicit: each chip's "
+                "block is d / ring size)"
+            )
+        X = np.asarray(data, dtype=config.default_dtype)
+        Y = np.asarray(labels, dtype=config.default_dtype)
+        x_mean = y_mean = None
+        if self.fit_intercept:
+            x_mean = X.mean(axis=0)
+            y_mean = Y.mean(axis=0)
+            # Center in place on an owned copy: X - mean would hold a second
+            # full (n, d) array on the path meant for the largest d.
+            X = np.array(X, copy=True) if X is data else X
+            np.subtract(X, x_mean, out=X)
+            Y = Y - y_mean
+        W = block_coordinate_descent_ring(
+            X, Y, num_iters=self.num_iters, lam=self.lam
+        )
+        b = None
+        if self.fit_intercept:
+            b = jnp.asarray(y_mean) - jnp.asarray(x_mean) @ W
+        return BlockLinearMapper([W], [(0, X.shape[1])], b)
+
     def _fit_sparse(self, data, labels) -> BlockLinearMapper:
         """Large-vocab path: CSR features stream to the device one dense
         column block at a time (an (n, vocab) dense array never exists).
@@ -238,13 +291,22 @@ class BlockWeightedLeastSquaresEstimator(BlockLeastSquaresEstimator):
         fit_intercept: bool = True,
         checkpoint_dir: Optional[str] = None,
         stream: Optional[bool] = None,
+        parallelism: str = "data",
     ):
         super().__init__(
-            block_size, num_iters, lam, fit_intercept, checkpoint_dir, stream
+            block_size,
+            num_iters,
+            lam,
+            fit_intercept,
+            checkpoint_dir,
+            stream,
+            parallelism,
         )
         self.mixture_weight = mixture_weight
 
     def _weights(self, Y: jnp.ndarray) -> Optional[jax.Array]:
+        if self.mixture_weight == 0.0:
+            return None  # exactly the unweighted problem
         # Y may be centered; class identity is still the row-wise argmax of
         # the ±1 indicator encoding.
         classes = jnp.argmax(Y, axis=1)
