@@ -197,7 +197,9 @@ def main() -> None:
     ap.add_argument("--scale", choices=list(SCALE), default=None)
     # bf16 = store A in bfloat16, accumulate f32 (config.solver_storage_dtype).
     ap.add_argument("--dtype", choices=["f32", "bf16"], default="f32")
-    ap.add_argument("--probe-timeout", type=float, default=75.0)
+    # Generous: first TPU contact through a cold relay can take ~a minute
+    # (backend init + tiny-op compile); a dead backend just costs the wait.
+    ap.add_argument("--probe-timeout", type=float, default=120.0)
     ap.add_argument("--run-timeout", type=float, default=900.0)
     args = ap.parse_args()
 

@@ -36,7 +36,7 @@ def main() -> None:
     from keystone_tpu.utils.platform import cpu_mesh_env, probe_backend
 
     def probe_live_tpu() -> bool:
-        info = probe_backend(timeout=75)
+        info = probe_backend(timeout=120)
         return info is not None and info.get("platform") != "cpu"
 
     live_tpu = probe_live_tpu()
