@@ -14,16 +14,33 @@ from typing import Callable, Dict, Sequence
 from keystone_tpu.workflow import Transformer
 
 
+def _identity(c: float) -> float:
+    return c
+
+
+def _log1p(c: float) -> float:
+    return math.log(c + 1.0)
+
+
+# Named module-level functions (not lambdas): fitted text pipelines pickle
+# through save_pipeline, and the name doubles as a content-stable signature.
+_WEIGHTINGS: Dict[str, Callable[[float], float]] = {
+    "identity": _identity,
+    "log": _log1p,
+}
+
+
 class TermFrequency(Transformer):
     jittable = False
 
     def __init__(self, fn: str | Callable[[float], float] = "identity"):
-        if fn == "identity":
-            self.fn: Callable[[float], float] = lambda c: c
-        elif fn == "log":
-            self.fn = lambda c: math.log(c + 1.0)
+        if isinstance(fn, str):
+            if fn not in _WEIGHTINGS:
+                raise ValueError(f"unknown weighting {fn!r}")
+            self.fn = _WEIGHTINGS[fn]
+            self._sig = self.stable_signature(fn)
         elif callable(fn):
-            self.fn = fn
+            self.fn = fn  # custom callables keep identity-based hashing
         else:
             raise ValueError(f"unknown weighting {fn!r}")
 

@@ -43,7 +43,7 @@ def parse_json_line(text: str) -> Optional[dict]:
     return None
 
 
-def probe_backend(timeout: float = 60.0) -> Optional[dict]:
+def probe_backend(timeout: float = 120.0) -> Optional[dict]:
     """Check the ambient default JAX backend is *alive* without risking a hang.
 
     The TPU tunnel in this environment can die mid-session, after which any
@@ -75,7 +75,7 @@ def probe_backend(timeout: float = 60.0) -> Optional[dict]:
     return info if info is not None and "platform" in info else None
 
 
-def ensure_live_backend(timeout: float = 90.0) -> str:
+def ensure_live_backend(timeout: float = 120.0) -> str:
     """Probe the ambient backend; fall back to CPU if it is dead or hung.
 
     Must run before this process initializes any JAX backend (config.update
