@@ -21,6 +21,9 @@ class NGramsFeaturizer(Transformer):
         self.max_n = max_n
         self.joiner = joiner
 
+    def signature(self):
+        return self.stable_signature(self.min_n, self.max_n, self.joiner)
+
     def apply(self, tokens: Sequence[str]) -> List[str]:
         out: List[str] = []
         for n in range(self.min_n, self.max_n + 1):

@@ -16,12 +16,20 @@ from keystone_tpu.workflow import Transformer
 class Trim(Transformer):
     jittable = False
 
+    def signature(self):
+        # Parameterless + deterministic: content-stable so text prefixes
+        # through it keep a persistable digest (cross-process fit cache).
+        return self.stable_signature()
+
     def apply(self, x: str) -> str:
         return x.strip()
 
 
 class LowerCase(Transformer):
     jittable = False
+
+    def signature(self):
+        return self.stable_signature()
 
     def apply(self, x: str) -> str:
         return x.lower()
@@ -34,6 +42,9 @@ class Tokenizer(Transformer):
 
     def __init__(self, pattern: str = r"[^\w']+"):
         self.pattern = re.compile(pattern)
+
+    def signature(self):
+        return self.stable_signature(self.pattern.pattern)
 
     def apply(self, x: str) -> List[str]:
         return [t for t in self.pattern.split(x) if t]

@@ -82,6 +82,45 @@ def array_fingerprint(a: np.ndarray) -> tuple:
     return ("ndarray-sampled", a.shape, str(a.dtype), h.hexdigest())
 
 
+def text_fingerprint(seq) -> Optional[tuple]:
+    """Content identity of a text corpus (list/tuple of str) — the dataset
+    payload of every NLP pipeline. Full hash up to the size budget, then a
+    strided item sample (same engineering tradeoff as array_fingerprint).
+    None if any element isn't a str."""
+    from keystone_tpu.config import config
+
+    h = hashlib.blake2b(digest_size=16)
+    n = len(seq)
+    total = 0  # chars — a ≤4× under-count of UTF-8 bytes, used ONLY to
+    for s in seq:  # pick full-vs-sampled mode, never as the work bound
+        if not isinstance(s, str):
+            return None
+        total += len(s)
+    h.update(str(n).encode())
+    h.update(str(total).encode())  # total size is part of the identity
+    limit = config.fingerprint_max_bytes
+    if total <= limit:
+        for s in seq:
+            b = s.encode()
+            h.update(str(len(b)).encode())
+            h.update(b)
+        return ("text", n, h.hexdigest())
+    # Sampled mode: every sampled item contributes its exact byte length,
+    # but hashed CONTENT is hard-capped (≤1 MiB per item, ≤64 MiB overall)
+    # so corpus size never unbounds the first structural hash.
+    step = max(1, n // 1024)
+    budget = 64 << 20
+    spent = 0
+    for i in range(0, n, step):
+        b = seq[i].encode()
+        h.update(str(len(b)).encode())
+        if spent < budget:
+            take = min(len(b), budget - spent, 1 << 20)
+            h.update(b[:take])
+            spent += take
+    return ("text-sampled", n, h.hexdigest())
+
+
 def stable_value(v: Any) -> Any:
     """Canonicalize ``v`` into a tree of primitives; unknown objects keep
     their id (in-process uniqueness) but carry the UNSTABLE poison."""

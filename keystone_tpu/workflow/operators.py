@@ -130,6 +130,15 @@ class DatasetOperator(Operator):
                 # digest above config.fingerprint_max_bytes, so huge fit
                 # inputs stay content-addressed at fixed cost.
                 sig = ("dataset", array_fingerprint(data))
+            elif isinstance(data, (list, tuple)) and data:
+                from keystone_tpu.workflow.fingerprint import text_fingerprint
+
+                fp = text_fingerprint(data)
+                sig = (
+                    ("dataset", fp)
+                    if fp is not None
+                    else ("dataset", id(self.data), UNSTABLE)
+                )
             else:
                 sig = ("dataset", id(self.data), UNSTABLE)
             self._sig_cache = sig

@@ -184,60 +184,6 @@ def _wide_corpus(n=500, tail_vocab=120_000, num_classes=4, seed=0):
     return texts, np.asarray(labels, dtype=np.int32)
 
 
-class TestRoundTrips:
-    def test_sparse_text_pipeline_save_load(self, tmp_path):
-        """Fitted sparse-text pipelines (CSR vectorizer + NB model) survive
-        save_pipeline/load_pipeline bit-exactly."""
-        from keystone_tpu.nodes.nlp import TermFrequency, Tokenizer
-        from keystone_tpu.workflow.serialization import (
-            load_pipeline,
-            save_pipeline,
-        )
-
-        rng = np.random.default_rng(0)
-        texts, labels = [], []
-        for _ in range(120):
-            c = int(rng.integers(0, 3))
-            words = [f"s{c}x{int(rng.integers(0, 20))}" for _ in range(10)]
-            texts.append(" ".join(words))
-            labels.append(c)
-        labels = np.asarray(labels, dtype=np.int32)
-        pipe = (
-            Tokenizer()
-            .and_then(TermFrequency("log"))
-            .and_then(CommonSparseFeatures(1000, sparse=True), texts)
-            .and_then(NaiveBayesEstimator(3), texts, labels)
-            .fit()
-        )
-        ref = np.asarray(pipe.apply(texts).get())
-        path = str(tmp_path / "sparse_text.pkl")
-        save_pipeline(pipe, path)
-        loaded = load_pipeline(path)
-        np.testing.assert_allclose(
-            np.asarray(loaded.apply(texts).get()), ref
-        )
-
-    def test_kernel_pcg_model_save_load(self, tmp_path, rng):
-        from keystone_tpu.nodes.learning import KernelRidgeRegression
-        from keystone_tpu.workflow.serialization import (
-            load_pipeline,
-            save_pipeline,
-        )
-
-        X = rng.normal(size=(128, 8)).astype(np.float32)
-        Y = rng.normal(size=(128, 2)).astype(np.float32)
-        est = KernelRidgeRegression(
-            gamma=0.2, lam=1e-2, max_iters=100, precond_landmarks=32
-        )
-        pipe = est.with_data(X, Y).fit()
-        ref = np.asarray(pipe.apply(X).get())
-        path = str(tmp_path / "krr.pkl")
-        save_pipeline(pipe, path)
-        np.testing.assert_allclose(
-            np.asarray(load_pipeline(path).apply(X).get()), ref, rtol=1e-6
-        )
-
-
 class TestNewsgroupsLargeVocab:
     @pytest.mark.slow
     def test_pipeline_at_100k_feature_budget(self):
