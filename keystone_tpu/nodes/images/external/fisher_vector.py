@@ -72,6 +72,15 @@ class FisherVector(Transformer):
         self.means = np.asarray(means, dtype=np.float32)
         self.variances = np.asarray(variances, dtype=np.float32)
         self.backend = backend
+        from keystone_tpu.workflow.fingerprint import array_fingerprint
+
+        # Content-stable from the fitted GMM (backend excluded: it changes
+        # WHERE the math runs, not what the encoding is).
+        self._sig = self.stable_signature(
+            array_fingerprint(self.weights),
+            array_fingerprint(self.means),
+            array_fingerprint(self.variances),
+        )
         self.jittable = backend in ("tpu", "pallas")
 
     def apply_batch(self, X):
@@ -119,6 +128,74 @@ def fit_fisher_featurizer(
     from keystone_tpu.nodes.stats import SignedHellingerMapper
     from keystone_tpu.nodes.stats.normalizer import L2Normalizer
     from keystone_tpu.nodes.stats.samplers import sample_rows
+    from keystone_tpu.workflow import PipelineEnv
+
+    def _assemble(pca, fv):
+        return (
+            front.and_then(pca)
+            .and_then(fv)
+            .and_then(SignedHellingerMapper())
+            .and_then(L2Normalizer())
+        )
+
+    # These eager fits (dense SIFT/LCS over the sample + GMM EM) dominate a
+    # flagship refit, and being OUTSIDE the graph they'd never hit the
+    # executor's fit cache — so they get their own content-addressed disk
+    # entry: front signatures + image fingerprint + hyperparams + numeric
+    # salt. Any unstable part (custom front node) degrades to no caching.
+    env = PipelineEnv.get()
+    key = None
+    if env.disk_cache is not None:
+        from keystone_tpu.config import config as _config
+        from keystone_tpu.workflow.fingerprint import (
+            array_fingerprint,
+            digest_tree,
+        )
+
+        try:
+            from keystone_tpu.workflow.graph import structural_digest
+
+            # Digest the WHOLE front graph (estimator + dataset nodes fold
+            # in; anything id-based poisons to None) — a transformer-only
+            # signature list would silently drop embedded fitted state.
+            front_digest = structural_digest(
+                front.graph, front.sink, source_token="branch-input"
+            )
+            images_fp = array_fingerprint(_np.asarray(train_images))
+            key = (
+                None
+                if front_digest is None
+                else digest_tree(
+                    (
+                        "fv-branch-v2",
+                        front_digest,
+                        images_fp,
+                        pca_dims,
+                        gmm_k,
+                        em_iters,
+                        sample_size,
+                        backend,
+                        seed,
+                        # These fits only read the default compute dtype —
+                        # solver-side knobs must not invalidate hours of
+                        # SIFT+EM (see executor.d_of for the solver salt).
+                        _config.default_dtype,
+                    )
+                )
+            )
+        except Exception as e:
+            import logging
+
+            logging.getLogger("keystone_tpu").warning(
+                "fisher branch cache key construction failed (%s); "
+                "branch fits will not be cached",
+                e,
+            )
+            key = None
+        if key is not None:
+            cached = env.disk_cache.get(key)
+            if cached is not None:
+                return _assemble(*cached)
 
     descs = _np.asarray(front(train_images).get())  # (n, m, d)
     flat = sample_rows(
@@ -134,12 +211,9 @@ def fit_fisher_featurizer(
         backend=backend,
         seed=seed,
     ).fit(_np.asarray(pca(flat)))
-    return (
-        front.and_then(pca)
-        .and_then(fv)
-        .and_then(SignedHellingerMapper())
-        .and_then(L2Normalizer())
-    )
+    if key is not None:
+        env.disk_cache.put(key, (pca, fv))
+    return _assemble(pca, fv)
 
 
 class GMMFisherVectorEstimator(Estimator):

@@ -26,6 +26,27 @@ class PCATransformer(Transformer):
         self.components = jnp.asarray(components)
         self.mean = None if mean is None else jnp.asarray(mean)
 
+    def signature(self):
+        # Content-stable from the fitted parameters: prefixes THROUGH a
+        # fitted PCA stay persistable, so downstream fits (the flagship
+        # solver) can hit the cross-process cache. Computed once — this is
+        # called on every executor walk and the fingerprint costs a
+        # device-to-host fetch.
+        sig = getattr(self, "_sig", None)
+        if sig is None:
+            import numpy as np
+
+            from keystone_tpu.workflow.fingerprint import array_fingerprint
+
+            sig = self.stable_signature(
+                array_fingerprint(np.asarray(self.components)),
+                None
+                if self.mean is None
+                else array_fingerprint(np.asarray(self.mean)),
+            )
+            self._sig = sig
+        return sig
+
     def apply_batch(self, X):
         if self.mean is not None:
             X = X - self.mean

@@ -209,17 +209,25 @@ def structural_digest(
     graph: Graph,
     target: GraphId,
     _memo: Dict[GraphId, Any] | None = None,
+    source_token: str | None = None,
 ) -> str | None:
     """Content-stable prefix digest of ``target`` — the cross-process cache
     key. None when any operator in the prefix lacks content identity, or the
-    prefix reaches a free source (an unbound input has no content)."""
+    prefix reaches a free source (an unbound input has no content) — unless
+    ``source_token`` names the free input, for digesting pipeline TEMPLATES
+    (e.g. an unfitted featurizer front) rather than bound executions."""
     memo: Dict[GraphId, Any] = {} if _memo is None else _memo
 
     def rec(gid: GraphId):
         if gid in memo:
             return memo[gid]
         if isinstance(gid, SourceId):
-            d = None
+            if source_token is not None:
+                from keystone_tpu.workflow.fingerprint import digest_tree
+
+                d = digest_tree(("source", source_token))
+            else:
+                d = None
         else:
             op = graph.operators[gid]
             dep_d = tuple(rec(x) for x in graph.dependencies[gid])

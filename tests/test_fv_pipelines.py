@@ -104,6 +104,50 @@ def test_imagenet_sift_lcs_fv_end_to_end():
     assert out["top_1_error"] < 0.5, out["summary"]
 
 
+def test_fisher_branch_fit_served_from_disk(tmp_path, monkeypatch):
+    """A second fit of the same FV branch (same images + params) comes from
+    the content-addressed store — no SIFT pass, no GMM EM."""
+    import numpy as np
+
+    from keystone_tpu.nodes.images import GrayScaler
+    from keystone_tpu.nodes.images.external import SIFTExtractor
+    from keystone_tpu.nodes.images.external.fisher_vector import (
+        GMMFisherVectorEstimator,
+        fit_fisher_featurizer,
+    )
+    from keystone_tpu.workflow import PipelineEnv
+
+    monkeypatch.setenv("KEYSTONE_CACHE_DIR", str(tmp_path))
+    calls = {"n": 0}
+    orig = GMMFisherVectorEstimator.fit
+
+    def counting_fit(self, *a, **k):
+        calls["n"] += 1
+        return orig(self, *a, **k)
+
+    monkeypatch.setattr(GMMFisherVectorEstimator, "fit", counting_fit)
+
+    rng = np.random.default_rng(0)
+    images = rng.uniform(size=(12, 32, 32, 3)).astype(np.float32)
+    front = GrayScaler().and_then(SIFTExtractor(step=8, bin_size=4))
+
+    def build():
+        return fit_fisher_featurizer(
+            front, images.copy(), pca_dims=8, gmm_k=3, em_iters=3,
+            sample_size=2000,
+        )
+
+    PipelineEnv.reset()
+    b1 = build()
+    ref = np.asarray(b1(images[:4]).get())
+    assert calls["n"] == 1
+
+    PipelineEnv.reset()  # fresh session state, same disk store
+    b2 = build()
+    assert calls["n"] == 1  # served from disk: EM never ran again
+    np.testing.assert_allclose(np.asarray(b2(images[:4]).get()), ref)
+
+
 def test_imagenet_streamed_matches_eager():
     """Out-of-core mode: streaming batches through the featurizer and the
     host-streamed solver must reproduce the eager run (same fitting sample,
