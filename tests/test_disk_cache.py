@@ -266,6 +266,76 @@ class TestDiskCacheTrim:
         assert cache.get("aaa") is not None and cache.get("bbb") is not None
 
 
+class TestConcurrentWriters:
+    @pytest.mark.slow
+    def test_parallel_processes_share_one_store(self, tmp_path):
+        """Four processes share one cache dir, two per problem — the pairs
+        race the SAME content key's tmp+rename commit while the pairs
+        differ. Every process's second session must log a real store hit
+        (not just reproduce values by refitting), entries must end corrupt-
+        free, and a distinct-key pair must coexist with the racing pair."""
+        script = textwrap.dedent(
+            """
+            import logging, os, sys
+            import numpy as np
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            logging.basicConfig(level=logging.INFO)
+            from keystone_tpu.nodes.learning import LeastSquaresEstimator
+            from keystone_tpu.workflow import PipelineEnv
+
+            seed = int(sys.argv[1])
+            rng = np.random.default_rng(seed)
+            X = rng.normal(size=(128, 16)).astype(np.float32)
+            W = rng.normal(size=(16, 2)).astype(np.float32)
+            Y = X @ W
+            p = LeastSquaresEstimator(lam=1e-4).with_data(X, Y).fit()
+            out1 = np.asarray(p.apply(X).get())
+            PipelineEnv.reset()  # second "session": must hit the store
+            p2 = LeastSquaresEstimator(lam=1e-4).with_data(X.copy(), Y.copy()).fit()
+            out2 = np.asarray(p2.apply(X).get())
+            np.testing.assert_allclose(out2, out1, rtol=1e-6)
+            resid = np.linalg.norm(out1 - Y) / np.linalg.norm(Y)
+            assert resid < 1e-3, resid
+            print("WRITER_OK", seed)
+            """
+        )
+        from keystone_tpu.utils.platform import cpu_mesh_env
+
+        env = cpu_mesh_env(2)
+        env["KEYSTONE_CACHE_DIR"] = str(tmp_path)
+        procs = []
+        try:
+            procs = [
+                subprocess.Popen(
+                    [sys.executable, "-c", script, str(seed)],
+                    env=env,
+                    cwd=os.path.dirname(
+                        os.path.dirname(os.path.abspath(__file__))
+                    ),
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                )
+                for seed in (0, 0, 1, 1)  # pairs race the same key
+            ]
+            for p in procs:
+                out, err = p.communicate(timeout=300)
+                assert p.returncode == 0, err[-2000:]
+                assert "WRITER_OK" in out
+                # The read path must actually serve the entry — a refit
+                # would reproduce the values and hide a dead get().
+                assert "disk fit cache: hit" in err
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
+        entries = [f for f in os.listdir(tmp_path) if f.endswith(".fit.pkl")]
+        assert len(entries) == 2  # one entry per distinct problem
+        assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+
+
 class TestNodeOptimizationMemo:
     def test_concrete_estimator_stable_across_passes(self):
         from keystone_tpu.workflow.operators import EstimatorOperator
