@@ -223,8 +223,11 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             x_mean = X.mean(axis=0)
             y_mean = Y.mean(axis=0)
             # Center in place on an owned copy: X - mean would hold a second
-            # full (n, d) array on the path meant for the largest d.
-            X = np.array(X, copy=True) if X is data else X
+            # full (n, d) array on the path meant for the largest d. When the
+            # input was a jax.Array, np.asarray gives a read-only zero-copy
+            # view (X is not data yet not writeable) — copy in that case too.
+            if X is data or not X.flags.writeable:
+                X = np.array(X, copy=True)
             np.subtract(X, x_mean, out=X)
             Y = Y - y_mean
         W = block_coordinate_descent_ring(

@@ -10,10 +10,20 @@ are simply never addressed.
 
 Enabled by ``KEYSTONE_CACHE_DIR`` (or ``config.cache_dir``); corrupt or
 unreadable entries degrade to cache misses, never errors.
+
+Trust boundary: entries are pickles, and unpickling runs code. The cache
+directory MUST be private to the user — it is created mode 0o700, and loads
+go through a restricted unpickler that only resolves classes from an
+allowlist of module prefixes (keystone_tpu / numpy / jax / stdlib containers),
+so a planted entry cannot smuggle in ``os.system``-style callables. Entries
+that reference anything else degrade to misses. Set
+``KEYSTONE_CACHE_TRUST_ALL=1`` to disable the allowlist for caches holding
+user-defined transformer classes outside these prefixes.
 """
 
 from __future__ import annotations
 
+import io
 import logging
 import os
 import pickle
@@ -21,6 +31,89 @@ import tempfile
 from typing import Any, Optional
 
 logger = logging.getLogger("keystone_tpu")
+
+#: Exact non-class reconstruction callables array pickles need (measured by
+#: recording find_class over real fitted-transformer pickles). Everything
+#: else callable is denied — broad module prefixes would leave gadget chains
+#: (e.g. ``functools.partial(numpy.load, allow_pickle=True)`` re-enters
+#: unrestricted pickle), so functions are enumerated, never pattern-matched.
+_SAFE_CALLABLES = frozenset(
+    {
+        ("numpy._core.multiarray", "_reconstruct"),
+        ("numpy.core.multiarray", "_reconstruct"),
+        ("numpy._core.multiarray", "scalar"),
+        ("numpy.core.multiarray", "scalar"),
+        ("jax._src.array", "_reconstruct_array"),
+    }
+)
+
+#: Module roots whose *classes* (types only — never functions) may appear in
+#: an entry: array/dtype containers and stdlib collections. A type's
+#: constructor runs on unpickle, but these are data containers, not
+#: exec/eval/system-shaped.
+_SAFE_CLASS_ROOTS = ("keystone_tpu", "numpy", "jax", "jaxlib", "ml_dtypes", "collections")
+
+#: The handful of builtins pickles legitimately need for container types.
+_SAFE_BUILTINS = frozenset(
+    {
+        "complex", "frozenset", "set", "slice", "range", "bytearray",
+        "list", "dict", "tuple", "int", "float", "bool", "str", "bytes",
+        "object",
+    }
+)
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module: str, name: str):
+        if module == "builtins":
+            if name in _SAFE_BUILTINS:
+                return super().find_class(module, name)
+            raise pickle.UnpicklingError(
+                f"disk fit cache: builtins.{name} not allowlisted"
+            )
+        if (module, name) in _SAFE_CALLABLES:
+            return super().find_class(module, name)
+        root = module.split(".", 1)[0]
+        # Resolution itself imports the module and runs its top-level code,
+        # so outside the known roots the module must ALREADY be imported —
+        # an attacker-named module (including a planted .py on sys.path)
+        # never gets imported by a cache read.
+        import sys as _sys
+
+        if root not in _SAFE_CLASS_ROOTS and module not in _sys.modules:
+            raise pickle.UnpicklingError(
+                f"disk fit cache: module {module!r} not imported; refusing "
+                "to import it on behalf of a cache entry"
+            )
+        obj = super().find_class(module, name)
+        if root in _SAFE_CLASS_ROOTS:
+            if isinstance(obj, type):
+                return obj
+            raise pickle.UnpicklingError(
+                f"disk fit cache: {module}.{name} is not a class and not an "
+                "allowlisted reconstructor"
+            )
+        # User-defined transformers live outside the roots but are the
+        # store's whole purpose: require an actual subclass of the framework
+        # bases — ``os.system`` (not a class) and ``subprocess.Popen`` (a
+        # class, but not a Transformer) both fail.
+        from keystone_tpu.workflow.pipeline import Estimator, LabelEstimator, Transformer
+
+        if isinstance(obj, type) and issubclass(
+            obj, (Transformer, Estimator, LabelEstimator)
+        ):
+            return obj
+        raise pickle.UnpicklingError(
+            f"disk fit cache: {module}.{name} not allowlisted "
+            "(set KEYSTONE_CACHE_TRUST_ALL=1 for caches holding arbitrary "
+            "user-defined state)"
+        )
+
+
+def _load_entry(f) -> Any:
+    if os.environ.get("KEYSTONE_CACHE_TRUST_ALL") == "1":
+        return pickle.load(f)
+    return _RestrictedUnpickler(f).load()
 
 
 class DiskFitCache:
@@ -43,7 +136,11 @@ class DiskFitCache:
         # directory grows under everyone's.
         self._approx_total: Optional[int] = None
         self._puts_since_sweep = 0
-        os.makedirs(root, exist_ok=True)
+        # 0o700 on creation: pickled entries execute on load, so the dir
+        # must not be writable (or readable) by other users. Pre-existing
+        # dirs keep their mode — tightening a deliberately shared cache
+        # behind the owner's back would break it silently.
+        os.makedirs(root, mode=0o700, exist_ok=True)
 
     _SWEEP_EVERY = 32
 
@@ -96,7 +193,7 @@ class DiskFitCache:
         path = self._path(key)
         try:
             with open(path, "rb") as f:
-                fitted = pickle.load(f)
+                fitted = _load_entry(f)
         except FileNotFoundError:
             return None
         except Exception as e:  # corrupt/unpicklable entry: miss, don't die

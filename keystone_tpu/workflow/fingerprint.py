@@ -66,19 +66,43 @@ def array_fingerprint(a: np.ndarray) -> tuple:
         c = np.ascontiguousarray(a)  # bounded by limit even when it copies
         h.update(memoryview(c).cast("B"))
         return ("ndarray", a.shape, str(a.dtype), h.hexdigest())
-    # Over-limit: sample ~64 row-block chunks of ~1 MiB via axis-0 slices —
-    # views, so a non-contiguous multi-GB array is never materialized whole
-    # (only each small chunk is made contiguous).
+    # Over-limit: TWO independent deterministic samples, so a change must
+    # dodge both lattices to collide. Pass 1 walks ~64 row-block chunks of
+    # ~1 MiB via axis-0 slices (views; each chunk is made contiguous and
+    # hashed through a hard per-chunk cap, so a handful of huge rows —
+    # n0 < 64 with multi-MiB rows — can no longer turn the "bounded" path
+    # into a full-buffer hash). Per-chunk byte counts fold into the digest.
     h.update(str(a.nbytes).encode())
     n0 = a.shape[0]
     row_bytes = max(a.nbytes // max(n0, 1), 1)
     rows_per = max(1, (1 << 20) // row_bytes)
     stride = max(n0 // 64, rows_per)
-    for s in range(0, n0, stride):
+    cap = 1 << 20  # hashed bytes per chunk, regardless of row size
+    budget = 96 << 20  # whole-call ceiling, small-n0 case included
+    spent = 0
+    starts = list(range(0, n0, stride))
+    tail_start = max(n0 - rows_per, 0)
+    if tail_start not in starts:
+        starts.append(tail_start)
+    for s in starts:
+        if spent >= budget:
+            break
         chunk = np.ascontiguousarray(a[s : s + rows_per])
-        h.update(memoryview(chunk).cast("B"))
-    tail = np.ascontiguousarray(a[max(n0 - rows_per, 0) :])
-    h.update(memoryview(tail).cast("B"))
+        mv = memoryview(chunk).cast("B")[:cap]
+        h.update(str(chunk.nbytes).encode())
+        h.update(mv)
+        spent += len(mv)
+    # Pass 2: a strided ELEMENT probe across the whole array in logical
+    # C-order (``a.flat`` fancy-indexing — a ~65k-element gather that works
+    # for ANY memory layout and hashes every byte of each probed element),
+    # at a step derived from a prime probe count so it stays incommensurate
+    # with pass 1's row-block lattice. Logical order also keeps the digest
+    # layout-independent: the same matrix C- or F-contiguous hashes equal.
+    step = max(a.size // 65521, 1)
+    idx = np.arange(0, a.size, step)
+    probe = np.ascontiguousarray(a.flat[idx])
+    h.update(b"p2" + str(step).encode())
+    h.update(memoryview(probe).cast("B"))
     return ("ndarray-sampled", a.shape, str(a.dtype), h.hexdigest())
 
 

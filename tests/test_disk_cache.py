@@ -96,6 +96,52 @@ class TestStableSignatures:
         z[0, 0] += 1
         assert stable_value(x) != stable_value(z)
 
+    def test_sampled_fingerprint_bounded_and_probing(self, monkeypatch):
+        """Over-limit arrays with FEW, HUGE rows (n0 < 64) used to degrade to
+        a full-buffer hash; now the per-chunk cap bounds pass 1 and the
+        prime-strided element probe still sees changes past the cap."""
+        from keystone_tpu.config import config
+        from keystone_tpu.workflow.fingerprint import array_fingerprint
+
+        monkeypatch.setattr(config, "fingerprint_max_bytes", 1 << 20)
+        # 4 rows x 2 MiB: rows_per=1, so pass 1 hashes only the first 1 MiB
+        # of each row. A change in the second MiB must still flip the digest
+        # via the whole-array probe lattice (~32-element step here).
+        a = np.zeros((4, 512 * 1024), dtype=np.float32)
+        tag, shape, dt, dig = array_fingerprint(a)
+        assert tag == "ndarray-sampled"
+        b = a.copy()
+        b[0, 300 * 1024 : 300 * 1024 + 512] = 1.0  # byte offset ~1.2 MiB
+        assert array_fingerprint(b)[3] != dig
+        assert array_fingerprint(a.copy())[3] == dig  # deterministic
+
+    def test_sampled_fingerprint_layout_independent(self, monkeypatch):
+        """The same logical matrix, C- vs F-contiguous, must digest equal —
+        the cross-process cache key can't depend on who materialized it."""
+        from keystone_tpu.config import config
+        from keystone_tpu.workflow.fingerprint import array_fingerprint
+
+        monkeypatch.setattr(config, "fingerprint_max_bytes", 1 << 16)
+        rng = np.random.default_rng(3)
+        c = np.ascontiguousarray(rng.normal(size=(64, 2048)).astype(np.float32))
+        f = np.asfortranarray(c)
+        assert not f.flags.c_contiguous and f.flags.f_contiguous
+        assert array_fingerprint(c) == array_fingerprint(f)
+
+    def test_sampled_fingerprint_noncontiguous_probed(self, monkeypatch):
+        """Non-contiguous over-limit views get the element probe too: a
+        change past pass 1's per-chunk cap still flips the digest."""
+        from keystone_tpu.config import config
+        from keystone_tpu.workflow.fingerprint import array_fingerprint
+
+        monkeypatch.setattr(config, "fingerprint_max_bytes", 1 << 20)
+        base = np.zeros((4, 1024 * 1024), dtype=np.float32)
+        a = base[:, ::2]  # non-contiguous, 4 rows x 2 MiB
+        dig = array_fingerprint(a)[3]
+        base2 = base.copy()
+        base2[0, 600 * 1024 : 600 * 1024 + 1024] = 1.0  # past the 1 MiB cap
+        assert array_fingerprint(base2[:, ::2])[3] != dig
+
 
 class TestStructuralDigest:
     def test_digest_stable_across_rebuilds(self):
@@ -183,6 +229,92 @@ class TestDiskCache:
         PipelineEnv.reset()
         CountingEstimator(lam=1e-3).with_data(X.copy(), Y.copy()).fit()
         assert CountingEstimator.fits == 2  # refit, no crash
+
+    def test_malicious_entry_rejected(self, tmp_path):
+        """A planted pickle whose payload resolves a non-allowlisted callable
+        (the classic ``os.system`` reduce) must degrade to a miss, not run."""
+        import pickle
+
+        from keystone_tpu.workflow.disk_cache import DiskFitCache
+
+        class Evil:
+            def __reduce__(self):
+                return (os.system, ("echo pwned > /dev/null",))
+
+        cache = DiskFitCache(str(tmp_path / "store"))
+        path = cache._path("deadbeef")
+        with open(path, "wb") as f:
+            pickle.dump(Evil(), f)
+        assert cache.get("deadbeef") is None  # rejected and dropped
+        assert not os.path.exists(path)
+
+    def test_unimported_module_never_imported_by_cache_read(self, tmp_path):
+        """find_class must refuse to IMPORT unknown modules — even resolving
+        one runs its top-level code, so rejection has to come first."""
+        import pickle
+        import pickletools  # stdlib, importable, NOT in sys.modules' deps
+
+        from keystone_tpu.workflow.disk_cache import DiskFitCache
+
+        # Hand-craft a pickle whose GLOBAL names a module that is importable
+        # but not yet imported; loading must miss without importing it.
+        victim = "antigravity"  # stdlib easter egg; never imported by us
+        payload = (
+            b"\x80\x04" + b"c" + victim.encode() + b"\nfly\n" + b"."
+        )  # proto4, GLOBAL antigravity.fly, STOP
+        cache = DiskFitCache(str(tmp_path / "store"))
+        with open(cache._path("k"), "wb") as f:
+            f.write(payload)
+        assert cache.get("k") is None
+        assert victim not in sys.modules
+
+    def test_gadget_chain_callables_rejected(self, tmp_path):
+        """Allowlisted-module FUNCTIONS (numpy.load, functools.partial) are
+        denied — only enumerated reconstructors and classes resolve."""
+        import pickle
+
+        from keystone_tpu.workflow.disk_cache import DiskFitCache
+
+        class NumpyLoadGadget:
+            def __reduce__(self):
+                import numpy
+
+                return (numpy.load, ("/nonexistent.npy",))
+
+        class PartialGadget:
+            def __reduce__(self):
+                import functools
+
+                return (functools.partial, (print,))
+
+        cache = DiskFitCache(str(tmp_path / "store"))
+        for i, evil in enumerate((NumpyLoadGadget(), PartialGadget())):
+            with open(cache._path(f"g{i}"), "wb") as f:
+                pickle.dump(evil, f)
+            assert cache.get(f"g{i}") is None, type(evil).__name__
+
+    def test_restricted_unpickler_roundtrips_real_transformers(self, tmp_path):
+        """The allowlist must not break the normal path: a fitted keystone
+        transformer holding jax/numpy state loads back through it."""
+        from keystone_tpu.nodes.stats import StandardScaler
+        from keystone_tpu.workflow.disk_cache import DiskFitCache
+
+        X = np.random.default_rng(0).normal(size=(32, 4)).astype(np.float32)
+        fitted = StandardScaler().fit(X)
+        cache = DiskFitCache(str(tmp_path / "store"))
+        cache.put("k", fitted)
+        loaded = cache.get("k")
+        assert loaded is not None
+        np.testing.assert_allclose(
+            np.asarray(loaded.apply_batch(X)), np.asarray(fitted.apply_batch(X))
+        )
+
+    def test_cache_dir_created_private(self, tmp_path):
+        from keystone_tpu.workflow.disk_cache import DiskFitCache
+
+        root = tmp_path / "fresh"
+        DiskFitCache(str(root))
+        assert (root.stat().st_mode & 0o777) == 0o700
 
     @pytest.mark.slow
     def test_cross_process_reuse(self, tmp_path):

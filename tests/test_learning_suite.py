@@ -276,6 +276,24 @@ def test_block_ls_model_parallel_matches_data_parallel(rng):
         assert resid < 5e-3, (est.parallelism, resid)
 
 
+def test_block_ls_model_parallel_accepts_device_arrays(rng):
+    """Regression: np.asarray over a jax.Array is a read-only zero-copy
+    view, and the ring path's in-place intercept centering crashed on it
+    (the executor device_puts every pipeline input, so this is the normal
+    case, not the exotic one)."""
+    import jax.numpy as jnp
+
+    n, d, k = 128, 32, 2
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    Y = (X @ rng.normal(size=(d, k)).astype(np.float32) + 0.5).astype(np.float32)
+    est = BlockLeastSquaresEstimator(
+        block_size=16, num_iters=8, lam=1e-4, parallelism="model"
+    )
+    pred = np.asarray(est.fit(jnp.asarray(X), jnp.asarray(Y)).apply_batch(X))
+    resid = np.linalg.norm(pred - Y) / np.linalg.norm(Y)
+    assert resid < 5e-3, resid
+
+
 def test_block_ls_model_parallel_rejects_weights(rng):
     from keystone_tpu.nodes.learning import BlockWeightedLeastSquaresEstimator
 
