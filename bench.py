@@ -38,9 +38,12 @@ PLAUSIBLE_PEAK_TFLOPS = {"bf16": 200.0, "f32": 100.0}
 
 # (n, d, k, block, iters) per backend class — CPU emulation gets a smaller
 # problem so the gate finishes; the FLOP formula keeps the metric honest.
+# "quick" exists for the checkride's CPU dry-run (harness validation only;
+# its TFLOPS are not a perf claim).
 SCALE = {
     "tpu": dict(n=32768, d=8192, k=16, block=2048, iters=2),
     "cpu": dict(n=8192, d=2048, k=16, block=512, iters=2),
+    "quick": dict(n=1024, d=512, k=8, block=128, iters=2),
 }
 
 

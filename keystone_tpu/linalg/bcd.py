@@ -526,14 +526,24 @@ def block_coordinate_descent_streamed(
     )
     if start_epoch >= num_iters:
         return W, blocks
-    next_buf = put(0)
+    # KEYSTONE_STREAM_NO_OVERLAP=1 serializes transfer and compute — it
+    # exists so the checkride can MEASURE what double-buffering buys; it is
+    # never the right setting for real runs.
+    from keystone_tpu.config import env_flag
+
+    no_overlap = env_flag("KEYSTONE_STREAM_NO_OVERLAP")
+    next_buf = None if no_overlap else put(0)
     for epoch in range(start_epoch, num_iters):
         for i in range(nb):
-            cur = next_buf
-            # Prefetch the next block while this one computes (double
-            # buffering): H2D DMA overlaps the MXU work.
-            if epoch + 1 < num_iters or i + 1 < nb:
-                next_buf = put((i + 1) % nb)
+            if no_overlap:
+                cur = put(i)
+                cur.block_until_ready()
+            else:
+                cur = next_buf
+                # Prefetch the next block while this one computes (double
+                # buffering): H2D DMA overlaps the MXU work.
+                if epoch + 1 < num_iters or i + 1 < nb:
+                    next_buf = put((i + 1) % nb)
             if chols[i] is None:
                 R, W[i], chols[i] = first(cur, R, W[i], lam_arr, w_rows)
             else:

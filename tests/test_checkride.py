@@ -1,0 +1,116 @@
+"""The tpu-checkride harness must stay runnable while the chip is dead:
+every step executes on the CPU fallback, results persist per step, and a
+re-run resumes instead of repeating work (VERDICT r2 next-round #1)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CKR = os.path.join(REPO, "tools", "checkride.py")
+
+
+def _run(tmp_path, steps, timeout=420):
+    return subprocess.run(
+        [
+            sys.executable,
+            CKR,
+            "--quick",
+            "--state-dir",
+            str(tmp_path / "state"),
+            "--report",
+            str(tmp_path / "report.json"),
+            "--probe-timeout",
+            "3",  # the orchestrator itself must not wait on a dead chip
+            "--steps",
+            *steps,
+        ],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=REPO,
+    )
+
+
+@pytest.mark.slow
+def test_checkride_cpu_dryrun_and_resume(tmp_path):
+    steps = ["streamed_overlap", "memory_stats"]
+    proc = _run(tmp_path, steps)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads((tmp_path / "report.json").read_text())
+    for s in steps:
+        assert report["steps"][s]["ok"], report["steps"][s]
+        assert report["steps"][s]["backend"] == "cpu"
+    assert report["complete_on_tpu"] is False  # honesty: CPU is not evidence
+    # Per-step state persisted the moment each step finished.
+    for s in steps:
+        assert (tmp_path / "state" / f"step_{s}.json").exists()
+
+    # Resume: both steps skip (stderr says so, and it's fast because no
+    # subprocess backend init happens for skipped steps).
+    proc2 = _run(tmp_path, steps, timeout=120)
+    assert proc2.returncode == 0, proc2.stderr[-2000:]
+    assert proc2.stderr.count("skip") == len(steps)
+
+    # Deleting one step's state re-runs exactly that step.
+    (tmp_path / "state" / "step_memory_stats.json").unlink()
+    proc3 = _run(tmp_path, steps)
+    assert proc3.returncode == 0, proc3.stderr[-2000:]
+    assert "skip streamed_overlap" in proc3.stderr
+    assert "run memory_stats" in proc3.stderr
+
+
+@pytest.mark.slow
+def test_checkride_step_failure_is_recorded_not_fatal(tmp_path):
+    """A failing step writes an ok=false record, the ride continues to the
+    next step, and the exit code reports the failure."""
+    env = dict(os.environ)
+    env["KEYSTONE_CHECKRIDE_FAIL_STEP"] = "streamed_overlap"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            CKR,
+            "--quick",
+            "--state-dir",
+            str(tmp_path / "fstate"),
+            "--report",
+            str(tmp_path / "freport.json"),
+            "--probe-timeout",
+            "3",
+            "--steps",
+            "streamed_overlap",
+            "memory_stats",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+        env=env,
+    )
+    assert proc.returncode == 1  # failure surfaced
+    report = json.loads((tmp_path / "freport.json").read_text())
+    assert report["steps"]["streamed_overlap"]["ok"] is False
+    assert report["steps"]["memory_stats"]["ok"] is True  # ride continued
+
+
+@pytest.mark.slow
+def test_checkride_keeps_tpu_ok_priors(tmp_path):
+    """A tpu-ok prior is never downgraded by a CPU re-run."""
+    state = tmp_path / "state"
+    state.mkdir(parents=True)
+    # Pre-plant a bogus prior for one step with backend "tpu": the target
+    # here is cpu, so a tpu-ok prior must be KEPT (never downgraded).
+    (state / "step_streamed_overlap.json").write_text(
+        json.dumps({"ok": True, "backend": "tpu", "step": "streamed_overlap"})
+    )
+    proc = _run(tmp_path, ["streamed_overlap"])
+    assert proc.returncode == 0
+    assert "skip streamed_overlap (done on tpu)" in proc.stderr
+    report = json.loads((tmp_path / "report.json").read_text())
+    assert report["steps"]["streamed_overlap"]["backend"] == "tpu"
+    assert report["tpu_evidence_steps"] == ["streamed_overlap"]

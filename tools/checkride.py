@@ -1,0 +1,579 @@
+"""One-command, resumable live-chip evidence harness (`make tpu-checkride`).
+
+Two rounds of TPU numbers have been lost to dead-chip sessions; the next
+live window may be minutes long and unattended. This harness runs every
+measurement the VERDICT asks for — bench f32, bench bf16, an MFU block
+sweep, the Pallas FV Mosaic compile + parity-vs-XLA check, the streamed-BCD
+H2D-overlap measurement, HBM memory stats, and the `entry()` compile —
+checkpointing each step's JSON to a state dir the moment it finishes, so a
+mid-ride relay death keeps every completed result. Re-running skips steps
+that already succeeded ON TPU; steps whose stored result is a CPU fallback
+are retried whenever the chip comes back. The aggregate is rewritten to
+``TPU_REPORT.json`` after every step.
+
+The chip-down path still runs everything on the forced 8-device CPU mesh
+(each result tagged ``backend: "cpu"``) so the harness itself stays
+verified while the chip is dead — the CPU dry-run is a harness test, not a
+perf claim.
+
+Per the relay's known fragility (a timeout-killed TPU job has taken the
+tunnel down before), TPU liveness is re-probed between steps so a mid-ride
+death degrades the REST of the ride to CPU instead of eating one full
+timeout per remaining step.
+
+Reference parity: this is the rebuild's analog of the reference's published
+benchmark sweeps (SURVEY.md §6 / BASELINE.md north-star metric #2
+[unverified — empty reference mount]).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # repo-root bench.py: worker protocol, scales, plausible peaks
+
+STEPS = (
+    "bench_f32",
+    "bench_bf16",
+    "mfu_sweep",
+    "pallas_fv",
+    "streamed_overlap",
+    "memory_stats",
+    "entry_compile",
+)
+
+
+# ---------------------------------------------------------------------------
+# Orchestrator
+# ---------------------------------------------------------------------------
+
+
+def _state_path(state_dir: str, step: str) -> str:
+    return os.path.join(state_dir, f"step_{step}.json")
+
+
+def _load_state(state_dir: str, step: str):
+    try:
+        with open(_state_path(state_dir, step)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _save_state(state_dir: str, step: str, result: dict) -> None:
+    os.makedirs(state_dir, exist_ok=True)
+    tmp = _state_path(state_dir, step) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(tmp, _state_path(state_dir, step))
+
+
+def _write_report(state_dir: str, report_path: str, meta: dict) -> None:
+    steps = {}
+    for step in STEPS:
+        r = _load_state(state_dir, step)
+        if r is not None:
+            steps[step] = r
+    on_tpu = [s for s, r in steps.items() if r.get("backend") == "tpu" and r.get("ok")]
+    report = {
+        "meta": meta,
+        "tpu_evidence_steps": on_tpu,
+        "complete_on_tpu": sorted(on_tpu) == sorted(STEPS),
+        "steps": steps,
+    }
+    tmp = report_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=1)
+    os.replace(tmp, report_path)
+
+
+def _probe(timeout: float) -> dict:
+    from keystone_tpu.utils.platform import probe_backend
+
+    t0 = time.time()
+    info = probe_backend(timeout=timeout)
+    wall = round(time.time() - t0, 1)
+    if info is None:
+        return {"live": False, "platform": None, "probe_seconds": wall}
+    return {
+        "live": info.get("platform") != "cpu",
+        "platform": info.get("platform"),
+        "n_devices": info.get("n"),
+        "probe_seconds": wall,
+    }
+
+
+def _step_env(target: str, quick: bool) -> dict:
+    from keystone_tpu.utils.platform import cpu_mesh_env
+
+    if target == "tpu":
+        env = dict(os.environ)
+    else:
+        env = cpu_mesh_env(8)
+    if quick:
+        env["KEYSTONE_CHECKRIDE_QUICK"] = "1"
+    # Persistent XLA compile cache: a relay death after compile-but-before-
+    # measure doesn't forfeit the (slow) first compile on the next attempt.
+    # JAX reads this env var natively at import, so every child process —
+    # step subprocesses AND bench workers — gets the cache without any
+    # keystone setup call having to run first.
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".xla_compile_cache")
+    )
+    return env
+
+
+def _bench_scale_for(target: str, quick: bool) -> str:
+    if quick:
+        return "quick"
+    return "tpu" if target == "tpu" else "cpu"
+
+
+def _forced_failure(step: str):
+    """Test-only: KEYSTONE_CHECKRIDE_FAIL_STEP=<name> makes that step fail
+    so the record-failure-and-continue path stays covered."""
+    if os.environ.get("KEYSTONE_CHECKRIDE_FAIL_STEP") == step:
+        return {"ok": False, "error": "forced_failure_for_test"}
+    return None
+
+
+def run_bench_step(step: str, target: str, quick: bool, timeout: float) -> dict:
+    """bench_f32 / bench_bf16 measured via bench._run_worker directly from
+    the orchestrator. The orchestrator process NEVER initializes a JAX
+    backend, so the worker subprocess is the only process touching the chip
+    — libtpu allows a single owner, and a backend-holding middleman would
+    make every live-TPU bench fail with 'TPU already in use'."""
+    dtype = "bf16" if step.endswith("bf16") else "f32"
+    env = _step_env(target, quick)
+    r = bench._run_worker(env, _bench_scale_for(target, quick), dtype, timeout)
+    if r is None or r.get("value") is None:
+        return {"ok": False, "backend": target, "error": "bench worker failed"}
+    peak = bench.PLAUSIBLE_PEAK_TFLOPS["bf16" if dtype == "bf16" else "f32"]
+    return {
+        "ok": True,
+        "backend": r.get("backend", target),
+        "tflops_per_chip": r["value"],
+        "mfu_vs_plausible_peak": round(r["value"] / peak, 4),
+        "bench_line": r,
+    }
+
+
+def run_mfu_sweep(
+    step: str, target: str, quick: bool, timeout: float, state_dir: str
+) -> dict:
+    """The block/dtype sweep, also orchestrator-side (same single-owner
+    rule), checkpointing rows as they land: a mid-sweep death keeps every
+    completed row, and a re-run resumes from the surviving rows."""
+    scale = _bench_scale_for(target, quick)
+    if scale == "quick":
+        blocks = [64, 128]
+    elif scale == "cpu":
+        blocks = [512, 1024]
+    else:
+        blocks = [1024, 2048, 4096, 8192]
+
+    prior = _load_state(state_dir, step) or {}
+    rows = [
+        r
+        for r in prior.get("rows", [])
+        if "error" not in r and prior.get("scale") == scale
+    ]
+    done = {(r["dtype"], r["block"]) for r in rows}
+    backend = prior.get("backend", target)
+    for dtype in ("f32", "bf16"):
+        peak = bench.PLAUSIBLE_PEAK_TFLOPS["bf16" if dtype == "bf16" else "f32"]
+        seen = {b for d, b in done if d == dtype}
+        for block in blocks:
+            env = _step_env(target, quick)
+            env["KEYSTONE_BENCH_BLOCK"] = str(block)
+            # A block that clamps to an already-measured effective block
+            # would re-measure the same config; skip via the worker's
+            # clamp rule (largest divisor of d that is <= block).
+            r = bench._run_worker(env, scale, dtype, timeout)
+            if r is None or r.get("value") is None:
+                rows.append({"block": block, "dtype": dtype, "error": "failed"})
+                # Mid-sweep death: re-probe once and stop burning timeouts.
+                if target == "tpu" and not _probe(60)["live"]:
+                    partial = {
+                        "ok": bool(done),
+                        "backend": backend,
+                        "scale": scale,
+                        "rows": rows,
+                        "error": "tpu died mid-sweep",
+                    }
+                    _save_state(state_dir, step, dict(partial, step=step))
+                    return partial
+                continue
+            actual = r["detail"]["block"]
+            if actual in seen:
+                continue
+            seen.add(actual)
+            done.add((dtype, actual))
+            backend = r.get("backend", backend)
+            rows.append(
+                {
+                    "block": actual,
+                    "dtype": dtype,
+                    "tflops_per_chip": r["value"],
+                    "mfu_vs_plausible_peak": round(r["value"] / peak, 4),
+                    "seconds_per_solve": r["detail"]["seconds_per_solve"],
+                }
+            )
+            # Checkpoint after EVERY row — the whole point of the harness.
+            _save_state(
+                state_dir,
+                step,
+                {
+                    "ok": True,
+                    "backend": backend,
+                    "scale": scale,
+                    "rows": rows,
+                    "partial": True,
+                    "step": step,
+                },
+            )
+    ok_rows = [r for r in rows if "error" not in r]
+    best = max(ok_rows, key=lambda r: r["tflops_per_chip"], default=None)
+    return {
+        "ok": bool(ok_rows),
+        "backend": backend,
+        "scale": scale,
+        "rows": rows,
+        "best": best,
+    }
+
+
+def _run_step(step: str, target: str, quick: bool, timeout: float):
+    """Run one step in a subprocess; return its parsed JSON dict or an
+    error record. The subprocess boundary is what makes a hung backend
+    survivable and the state file authoritative."""
+    env = _step_env(target, quick)
+    cmd = [sys.executable, os.path.abspath(__file__), "--step", step]
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            cmd, env=env, capture_output=True, text=True, timeout=timeout
+        )
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "backend": target, "error": f"timeout>{timeout}s"}
+    except OSError as e:
+        return {"ok": False, "backend": target, "error": f"launch: {e}"}
+    from keystone_tpu.utils.platform import parse_json_line
+
+    parsed = parse_json_line(proc.stdout)
+    if parsed is None:
+        return {
+            "ok": False,
+            "backend": target,
+            "error": f"rc={proc.returncode}, no JSON",
+            "stderr_tail": (proc.stderr or "")[-1500:],
+        }
+    parsed.setdefault("ok", True)
+    parsed.setdefault("backend", target)
+    parsed["seconds"] = round(time.time() - t0, 1)
+    return parsed
+
+
+def orchestrate(args) -> int:
+    state_dir = args.state_dir
+    probe = _probe(args.probe_timeout)
+    target = "tpu" if probe["live"] else "cpu"
+    meta = {
+        "probe": probe,
+        "started": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "quick": args.quick,
+    }
+    print(f"checkride: target={target} probe={probe}", file=sys.stderr)
+
+    wanted = args.steps or list(STEPS)
+    for step in wanted:
+        prior = _load_state(state_dir, step)
+        if prior is not None and not args.force:
+            if prior.get("ok") and (prior.get("backend") == "tpu" or target == "cpu"):
+                print(
+                    f"checkride: skip {step} (done on {prior.get('backend')})",
+                    file=sys.stderr,
+                )
+                continue
+        print(f"checkride: run {step} on {target}", file=sys.stderr)
+        forced = _forced_failure(step)
+        if forced is not None:
+            result = dict(forced, backend=target)
+        elif step in ("bench_f32", "bench_bf16"):
+            result = run_bench_step(step, target, args.quick, args.step_timeout)
+        elif step == "mfu_sweep":
+            result = run_mfu_sweep(
+                step, target, args.quick, args.step_timeout, state_dir
+            )
+        else:
+            result = _run_step(step, target, args.quick, args.step_timeout)
+        result["step"] = step
+        _save_state(state_dir, step, result)
+        _write_report(state_dir, args.report, meta)
+        status = "ok" if result.get("ok") else f"FAIL ({result.get('error')})"
+        print(f"checkride: {step}: {status} [{result.get('backend')}]", file=sys.stderr)
+        # Mid-ride death check: if a TPU step failed, re-probe and degrade
+        # the rest of the ride rather than timing out step after step.
+        if target == "tpu" and not result.get("ok"):
+            probe = _probe(args.probe_timeout)
+            if not probe["live"]:
+                print("checkride: TPU died mid-ride; degrading to CPU", file=sys.stderr)
+                target = "cpu"
+                meta["degraded_mid_ride"] = True
+
+    _write_report(state_dir, args.report, meta)
+    with open(args.report) as f:
+        report = json.load(f)
+    ok_steps = [s for s in wanted if report["steps"].get(s, {}).get("ok")]
+    print(
+        json.dumps(
+            {
+                "metric": "checkride_steps_ok",
+                "value": len(ok_steps),
+                "unit": f"of {len(STEPS)} steps",
+                "complete_on_tpu": report["complete_on_tpu"],
+                "report": args.report,
+            }
+        )
+    )
+    return 0 if len(ok_steps) == len(wanted) else 1
+
+
+# ---------------------------------------------------------------------------
+# Steps (each runs in its own subprocess and prints ONE JSON line)
+# ---------------------------------------------------------------------------
+
+
+def _quick() -> bool:
+    return os.environ.get("KEYSTONE_CHECKRIDE_QUICK") == "1"
+
+
+def _backend() -> str:
+    from keystone_tpu.utils.platform import env_forces_cpu, force_cpu
+
+    if env_forces_cpu():
+        force_cpu()
+    import jax
+
+    return jax.default_backend()
+
+
+def step_pallas_fv() -> dict:
+    """Mosaic-compile the fused Fisher-vector kernel on TPU (interpret=True
+    off-TPU — then this step only validates the harness path) and check
+    parity + timing against the XLA backend."""
+    backend = _backend()
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.ops.fisher_vector_pallas import fisher_vectors_pallas
+    from keystone_tpu.nodes.images.external.fisher_vector import _fv_tpu
+
+    rng = np.random.default_rng(0)
+    if _quick() or backend != "tpu":
+        bsz, m, d, k = 2, 256, 64, 16
+    else:
+        bsz, m, d, k = 8, 2048, 64, 256  # the ImageNet configuration
+    X = rng.normal(size=(bsz, m, d)).astype(np.float32)
+    w = np.abs(rng.normal(size=(k,))).astype(np.float32) + 0.1
+    w /= w.sum()
+    mu = rng.normal(size=(k, d)).astype(np.float32)
+    var = np.abs(rng.normal(size=(k, d))).astype(np.float32) + 0.5
+
+    interpret = backend != "tpu"
+    t0 = time.perf_counter()
+    out_p = fisher_vectors_pallas(
+        jnp.asarray(X), jnp.asarray(w), jnp.asarray(mu), jnp.asarray(var),
+        interpret=interpret,
+    )
+    jax.block_until_ready(out_p)
+    compile_and_first = time.perf_counter() - t0
+    out_x = _fv_tpu(jnp.asarray(X), jnp.asarray(w), jnp.asarray(mu), jnp.asarray(var))
+    jax.block_until_ready(out_x)
+    err = float(jnp.max(jnp.abs(out_p - out_x)))
+    rel = err / max(float(jnp.max(jnp.abs(out_x))), 1e-30)
+
+    def timed(fn, *a):
+        reps, total = 0, 0.0
+        while total < 1.0 and reps < 10:
+            t = time.perf_counter()
+            jax.block_until_ready(fn(*a))
+            total += time.perf_counter() - t
+            reps += 1
+        return total / reps
+
+    Xj = jnp.asarray(X)
+    wj, muj, varj = jnp.asarray(w), jnp.asarray(mu), jnp.asarray(var)
+    t_pallas = timed(
+        lambda x: fisher_vectors_pallas(x, wj, muj, varj, interpret=interpret), Xj
+    )
+    t_xla = timed(lambda x: _fv_tpu(x, wj, muj, varj), Xj)
+    return {
+        "ok": rel < 1e-3,
+        "backend": backend,
+        "mosaic_compiled": not interpret,
+        "max_rel_err_vs_xla": rel,
+        "compile_plus_first_s": round(compile_and_first, 3),
+        "pallas_s": round(t_pallas, 5),
+        "xla_s": round(t_xla, 5),
+        "speedup_vs_xla": round(t_xla / t_pallas, 3) if t_pallas else None,
+        "config": {"batch": bsz, "m": m, "d": d, "k": k},
+    }
+
+
+def step_streamed_overlap() -> dict:
+    """Measure what double-buffered H2D buys: the same streamed solve with
+    and without prefetch overlap."""
+    backend = _backend()
+    import numpy as np
+
+    from keystone_tpu.linalg import RowMatrix, block_coordinate_descent_streamed
+
+    rng = np.random.default_rng(0)
+    if _quick():
+        n, d, k, block, iters = 512, 512, 4, 128, 2
+    elif backend == "tpu":
+        n, d, k, block, iters = 16384, 16384, 16, 2048, 2
+    else:
+        n, d, k, block, iters = 2048, 2048, 8, 512, 2
+    A = rng.normal(size=(n, d)).astype(np.float32)
+    B = RowMatrix.from_array(
+        (A @ rng.normal(size=(d, k)).astype(np.float32)).astype(np.float32)
+    )
+
+    def run_once() -> float:
+        t0 = time.perf_counter()
+        W, _ = block_coordinate_descent_streamed(
+            A, B, block_size=block, num_iters=iters, lam=1e-3
+        )
+        W[-1].block_until_ready()
+        np.asarray(W[-1][-1, -1])
+        return time.perf_counter() - t0
+
+    run_once()  # warmup/compile
+    overlapped = min(run_once() for _ in range(2))
+    os.environ["KEYSTONE_STREAM_NO_OVERLAP"] = "1"
+    try:
+        run_once()  # recompile-free but re-warm the path
+        serial = min(run_once() for _ in range(2))
+    finally:
+        del os.environ["KEYSTONE_STREAM_NO_OVERLAP"]
+    return {
+        "ok": True,
+        "backend": backend,
+        "overlapped_s": round(overlapped, 4),
+        "serial_s": round(serial, 4),
+        "overlap_speedup": round(serial / overlapped, 3),
+        "config": {"n": n, "d": d, "k": k, "block": block, "epochs": iters},
+    }
+
+
+def step_memory_stats() -> dict:
+    """HBM high-water of the bench solve (memory_stats is TPU-only; CPU
+    records availability=False so the step still validates)."""
+    backend = _backend()
+    import numpy as np
+
+    import jax
+
+    from keystone_tpu.linalg import RowMatrix, block_coordinate_descent
+
+    p = bench.SCALE["quick" if _quick() else ("tpu" if backend == "tpu" else "cpu")]
+    rng = np.random.default_rng(0)
+    A = rng.normal(size=(p["n"], p["d"])).astype(np.float32)
+    B = (A @ rng.normal(size=(p["d"], p["k"])).astype(np.float32)).astype(np.float32)
+    Ma, Mb = RowMatrix.from_array(A), RowMatrix.from_array(B)
+    W, _ = block_coordinate_descent(
+        Ma, Mb, block_size=p["block"], num_iters=p["iters"], lam=1e-3,
+        cache_grams=True,
+    )
+    W[-1].block_until_ready()
+    dev = jax.local_devices()[0]
+    stats = None
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        pass
+    picked = None
+    if stats:
+        picked = {
+            key: stats[key]
+            for key in (
+                "bytes_in_use",
+                "peak_bytes_in_use",
+                "bytes_limit",
+                "largest_alloc_size",
+            )
+            if key in stats
+        }
+    return {
+        "ok": True,
+        "backend": backend,
+        "memory_stats_available": bool(stats),
+        "memory": picked,
+        "config": p,
+    }
+
+
+def step_entry_compile() -> dict:
+    import jax
+
+    import __graft_entry__
+
+    t0 = time.perf_counter()
+    fn, args = __graft_entry__.entry()
+    build_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    return {
+        "ok": True,
+        "backend": jax.default_backend(),
+        "build_s": round(build_s, 2),
+        "compile_plus_first_s": round(compile_s, 2),
+        "out_shape": list(out.shape),
+    }
+
+
+STEP_FNS = {
+    "pallas_fv": step_pallas_fv,
+    "streamed_overlap": step_streamed_overlap,
+    "memory_stats": step_memory_stats,
+    "entry_compile": step_entry_compile,
+}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--step", choices=list(STEP_FNS), default=None)
+    ap.add_argument("--steps", nargs="+", choices=list(STEPS), default=None)
+    ap.add_argument("--state-dir", default=os.path.join(REPO, ".checkride"))
+    ap.add_argument("--report", default=os.path.join(REPO, "TPU_REPORT.json"))
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--force", action="store_true")
+    ap.add_argument("--probe-timeout", type=float, default=120.0)
+    # Generous per-step budget: a cold TPU compile through the relay can be
+    # slow, and killing live TPU work has taken the relay down before.
+    ap.add_argument("--step-timeout", type=float, default=2400.0)
+    args = ap.parse_args()
+
+    if args.step:
+        result = STEP_FNS[args.step]()
+        print(json.dumps(result), flush=True)
+        sys.exit(0 if result.get("ok") else 1)
+    sys.exit(orchestrate(args))
+
+
+if __name__ == "__main__":
+    main()
