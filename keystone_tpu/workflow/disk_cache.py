@@ -63,6 +63,18 @@ _SAFE_BUILTINS = frozenset(
 )
 
 
+def _class_root_ok(root: str, obj: type) -> bool:
+    """Per-root class rules. numpy is the sharp edge: ndarray SUBCLASSES
+    include ``numpy.memmap``, whose constructor creates/truncates arbitrary
+    files during REDUCE — so exactly ``ndarray`` itself plus the dtype and
+    scalar hierarchies are admitted, nothing derived."""
+    import numpy as _np
+
+    if root == "numpy":
+        return obj is _np.ndarray or issubclass(obj, (_np.dtype, _np.generic))
+    return True
+
+
 class _RestrictedUnpickler(pickle.Unpickler):
     def find_class(self, module: str, name: str):
         if module == "builtins":
@@ -87,11 +99,11 @@ class _RestrictedUnpickler(pickle.Unpickler):
             )
         obj = super().find_class(module, name)
         if root in _SAFE_CLASS_ROOTS:
-            if isinstance(obj, type):
+            if isinstance(obj, type) and _class_root_ok(root, obj):
                 return obj
             raise pickle.UnpicklingError(
-                f"disk fit cache: {module}.{name} is not a class and not an "
-                "allowlisted reconstructor"
+                f"disk fit cache: {module}.{name} is not an allowlisted "
+                "class or reconstructor"
             )
         # User-defined transformers live outside the roots but are the
         # store's whole purpose: require an actual subclass of the framework
@@ -111,7 +123,9 @@ class _RestrictedUnpickler(pickle.Unpickler):
 
 
 def _load_entry(f) -> Any:
-    if os.environ.get("KEYSTONE_CACHE_TRUST_ALL") == "1":
+    from keystone_tpu.config import env_flag
+
+    if env_flag("KEYSTONE_CACHE_TRUST_ALL"):
         return pickle.load(f)
     return _RestrictedUnpickler(f).load()
 

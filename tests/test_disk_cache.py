@@ -287,11 +287,21 @@ class TestDiskCache:
 
                 return (functools.partial, (print,))
 
+        class MemmapGadget:
+            def __reduce__(self):
+                import numpy
+
+                target = str(tmp_path / "victim.bin")
+                return (numpy.memmap, (target, "uint8", "w+", 0, (1,)))
+
         cache = DiskFitCache(str(tmp_path / "store"))
-        for i, evil in enumerate((NumpyLoadGadget(), PartialGadget())):
+        gadgets = (NumpyLoadGadget(), PartialGadget(), MemmapGadget())
+        for i, evil in enumerate(gadgets):
             with open(cache._path(f"g{i}"), "wb") as f:
                 pickle.dump(evil, f)
             assert cache.get(f"g{i}") is None, type(evil).__name__
+        # The memmap constructor must never have run (no file created).
+        assert not (tmp_path / "victim.bin").exists()
 
     def test_restricted_unpickler_roundtrips_real_transformers(self, tmp_path):
         """The allowlist must not break the normal path: a fitted keystone

@@ -82,7 +82,14 @@ def _write_report(state_dir: str, report_path: str, meta: dict) -> None:
         r = _load_state(state_dir, step)
         if r is not None:
             steps[step] = r
-    on_tpu = [s for s, r in steps.items() if r.get("backend") == "tpu" and r.get("ok")]
+    on_tpu = [
+        s
+        for s, r in steps.items()
+        if r.get("backend") == "tpu"
+        and r.get("ok")
+        and not r.get("partial")
+        and "error" not in r
+    ]
     report = {
         "meta": meta,
         "tpu_evidence_steps": on_tpu,
@@ -181,10 +188,15 @@ def run_mfu_sweep(
         blocks = [1024, 2048, 4096, 8192]
 
     prior = _load_state(state_dir, step) or {}
+    # Resume only rows measured at this scale AND on this backend target —
+    # in quick mode the scale is "quick" for both backends, and mixing
+    # CPU-measured rows into a TPU-tagged result would fake evidence.
     rows = [
         r
         for r in prior.get("rows", [])
-        if "error" not in r and prior.get("scale") == scale
+        if "error" not in r
+        and prior.get("scale") == scale
+        and prior.get("backend") == target
     ]
     done = {(r["dtype"], r["block"]) for r in rows}
     backend = prior.get("backend", target)
@@ -297,7 +309,13 @@ def orchestrate(args) -> int:
     for step in wanted:
         prior = _load_state(state_dir, step)
         if prior is not None and not args.force:
-            if prior.get("ok") and (prior.get("backend") == "tpu" or target == "cpu"):
+            # A partial or error-carrying prior is never "done" — the sweep's
+            # per-row checkpoints save ok=True mid-flight and must re-enter
+            # the resume path, not get skipped.
+            complete = (
+                prior.get("ok") and not prior.get("partial") and "error" not in prior
+            )
+            if complete and (prior.get("backend") == "tpu" or target == "cpu"):
                 print(
                     f"checkride: skip {step} (done on {prior.get('backend')})",
                     file=sys.stderr,
