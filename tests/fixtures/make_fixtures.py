@@ -1,0 +1,179 @@
+"""Deterministic real-format loader fixtures (run once; outputs committed).
+
+The reference keeps small real-format files under src/test/resources/ and
+tests loaders against them (SURVEY.md §4 fixtures row [unverified]); these
+are the rebuild's equivalent. Every file is generated from fixed seeds and
+closed-form byte patterns so loader tests can assert labels, ordering, and
+channel layout byte-exactly — no synthetic() fallback anywhere.
+
+Regenerate with:  python tests/fixtures/make_fixtures.py
+(The JPEG bytes are committed, so tests never depend on the local PIL
+encoder; only the *decoder* runs at test time, checked tolerantly.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import tarfile
+
+import numpy as np
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+
+# ---------------------------------------------------------------------------
+# Closed-form byte patterns shared with the tests (import both sides).
+# ---------------------------------------------------------------------------
+
+CIFAR_LABELS = [3, 8, 0, 6, 1, 9]
+MNIST_LABELS = [7, 2, 1, 0, 4]
+IMAGENET_SYNSETS = {  # synset -> (label, [solid RGB colors per image])
+    "n01440764": (0, [(220, 30, 30), (30, 220, 30)]),
+    "n02102040": (1, [(30, 30, 220), (200, 200, 40)]),
+}
+VOC_FIXTURES = {  # name -> (classes present, solid RGB color)
+    "000012": (["car"], (200, 40, 40)),
+    "000017": (["person", "horse"], (40, 200, 40)),
+    "000023": (["bicycle", "person", "person"], (40, 40, 200)),
+}
+NEWS_DOCS = {  # group -> {doc name -> exact text}
+    "rec.sport.hockey": {
+        "10001": "The goalie made a glove save in overtime.\n",
+        "10002": "Playoff season starts next week.\n",
+    },
+    "sci.space": {
+        "20001": "The rocket reached orbit after launch.\n",
+        "20002": "A satellite photographed the moon.\n",
+    },
+}
+AMAZON_ROWS = [  # (text, stars) -> expected label = stars > 3.5
+    ("Great product, works perfectly.", 5.0),
+    ("Terrible, broke after a day.", 1.0),
+    ("It is okay, nothing special.", 3.0),
+    ("Love it, best purchase this year.", 4.5),
+]
+TIMIT_N, TIMIT_D = 12, 40
+
+
+def cifar_pixel_bytes(rec: int) -> np.ndarray:
+    """Record `rec`'s 3072 channel-major pixel bytes: plane fill values
+    chosen per (record, channel) so the NHWC transpose is checkable."""
+    planes = [np.full(32 * 32, (rec * 40 + 17 * ch) % 256, np.uint8) for ch in range(3)]
+    return np.concatenate(planes)
+
+
+def mnist_image_bytes(idx: int) -> np.ndarray:
+    """28x28 uint8 where pixel (r, c) = (idx*13 + r*28 + c) % 256."""
+    base = np.arange(28 * 28, dtype=np.int64).reshape(28, 28)
+    return ((idx * 13 + base) % 256).astype(np.uint8)
+
+
+def _solid_jpeg(color, size=48) -> bytes:
+    from PIL import Image
+    import io
+
+    im = Image.new("RGB", (size, size), color)
+    buf = io.BytesIO()
+    im.save(buf, format="JPEG", quality=95)
+    return buf.getvalue()
+
+
+def main() -> None:
+    os.makedirs(ROOT, exist_ok=True)
+
+    # CIFAR-10 binary: 1 label byte + 3072 channel-major pixel bytes/record.
+    cdir = os.path.join(ROOT, "cifar")
+    os.makedirs(cdir, exist_ok=True)
+    with open(os.path.join(cdir, "data_batch.bin"), "wb") as f:
+        for i, label in enumerate(CIFAR_LABELS):
+            f.write(bytes([label]))
+            f.write(cifar_pixel_bytes(i).tobytes())
+
+    # MNIST IDX pair (big-endian magic + dims headers).
+    mdir = os.path.join(ROOT, "mnist")
+    os.makedirs(mdir, exist_ok=True)
+    n = len(MNIST_LABELS)
+    with open(os.path.join(mdir, "t10k-images-idx3-ubyte"), "wb") as f:
+        f.write(struct.pack(">HBB", 0, 0x08, 3))
+        f.write(struct.pack(">3I", n, 28, 28))
+        for i in range(n):
+            f.write(mnist_image_bytes(i).tobytes())
+    with open(os.path.join(mdir, "t10k-labels-idx1-ubyte"), "wb") as f:
+        f.write(struct.pack(">HBB", 0, 0x08, 1))
+        f.write(struct.pack(">I", n))
+        f.write(bytes(MNIST_LABELS))
+
+    # ImageNet: per-synset tar of JPEGs + one dir-layout synset + label map.
+    idir = os.path.join(ROOT, "imagenet", "train")
+    os.makedirs(idir, exist_ok=True)
+    with open(os.path.join(ROOT, "imagenet", "labels.txt"), "w") as f:
+        for synset, (label, _colors) in sorted(IMAGENET_SYNSETS.items()):
+            f.write(f"{synset} {label}\n")
+    for si, (synset, (_label, colors)) in enumerate(sorted(IMAGENET_SYNSETS.items())):
+        if si == 0:  # first synset as a tar archive
+            with tarfile.open(os.path.join(idir, synset + ".tar"), "w") as tf:
+                for j, color in enumerate(colors):
+                    data = _solid_jpeg(color)
+                    info = tarfile.TarInfo(f"{synset}_{j}.JPEG")
+                    info.size = len(data)
+                    import io
+
+                    tf.addfile(info, io.BytesIO(data))
+        else:  # second synset as a directory of JPEGs
+            sdir = os.path.join(idir, synset)
+            os.makedirs(sdir, exist_ok=True)
+            for j, color in enumerate(colors):
+                with open(os.path.join(sdir, f"{synset}_{j}.JPEG"), "wb") as f:
+                    f.write(_solid_jpeg(color))
+
+    # VOC: Annotations/<name>.xml + JPEGImages/<name>.jpg.
+    vdir = os.path.join(ROOT, "voc")
+    os.makedirs(os.path.join(vdir, "Annotations"), exist_ok=True)
+    os.makedirs(os.path.join(vdir, "JPEGImages"), exist_ok=True)
+    for name, (classes, color) in VOC_FIXTURES.items():
+        objs = "".join(
+            f"  <object><name>{c}</name><difficult>0</difficult></object>\n"
+            for c in classes
+        )
+        xml = (
+            f"<annotation>\n  <filename>{name}.jpg</filename>\n"
+            f"  <size><width>48</width><height>48</height><depth>3</depth></size>\n"
+            f"{objs}</annotation>\n"
+        )
+        with open(os.path.join(vdir, "Annotations", name + ".xml"), "w") as f:
+            f.write(xml)
+        with open(os.path.join(vdir, "JPEGImages", name + ".jpg"), "wb") as f:
+            f.write(_solid_jpeg(color))
+
+    # 20 Newsgroups: directory-per-class of plain-text docs.
+    ndir = os.path.join(ROOT, "newsgroups", "train")
+    for group, docs in NEWS_DOCS.items():
+        gdir = os.path.join(ndir, group)
+        os.makedirs(gdir, exist_ok=True)
+        for doc, text in docs.items():
+            with open(os.path.join(gdir, doc), "w") as f:
+                f.write(text)
+
+    # Amazon reviews: JSON-lines with reviewText/overall.
+    adir = os.path.join(ROOT, "amazon")
+    os.makedirs(adir, exist_ok=True)
+    with open(os.path.join(adir, "reviews.jsonl"), "w") as f:
+        for text, stars in AMAZON_ROWS:
+            f.write(json.dumps({"reviewText": text, "overall": stars}) + "\n")
+
+    # TIMIT: npz of frame features + labels (deterministic integers).
+    tdir = os.path.join(ROOT, "timit")
+    os.makedirs(tdir, exist_ok=True)
+    feats = (
+        np.arange(TIMIT_N * TIMIT_D, dtype=np.float64).reshape(TIMIT_N, TIMIT_D)
+        / 100.0
+    )
+    labels = (np.arange(TIMIT_N) * 7 % 24).astype(np.int64)
+    np.savez(os.path.join(tdir, "frames.npz"), features=feats, labels=labels)
+
+    print(f"fixtures written under {ROOT}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,175 @@
+"""Loader tests against committed real-format fixtures — no synthetic().
+
+Each test parses the actual on-disk format (CIFAR-10 .bin, MNIST IDX,
+per-synset ImageNet .tar + dir, VOC XML+JPEG, 20news dirs, Amazon JSONL,
+TIMIT npz) and asserts labels, ordering, and channel layout byte-exactly
+(tolerantly for lossy JPEG pixel content). The reference does the same
+against src/test/resources fixtures (SURVEY.md §4 [unverified]).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "fixtures"))
+import make_fixtures as fx  # noqa: E402  (shared byte-pattern definitions)
+
+from keystone_tpu.loaders.amazon import AmazonReviewsDataLoader
+from keystone_tpu.loaders.cifar import CifarLoader
+from keystone_tpu.loaders.imagenet import ImageNetLoader
+from keystone_tpu.loaders.mnist import MnistLoader
+from keystone_tpu.loaders.newsgroups import NewsgroupsDataLoader
+from keystone_tpu.loaders.timit import TimitFeaturesDataLoader
+from keystone_tpu.loaders.voc import VOCLoader, VOC_CLASSES
+
+DATA = os.path.join(os.path.dirname(__file__), "fixtures", "data")
+
+
+def test_cifar_binary_bytes_labels_and_channel_layout():
+    d = CifarLoader.load(os.path.join(DATA, "cifar", "data_batch.bin"))
+    n = len(fx.CIFAR_LABELS)
+    assert d.data.shape == (n, 32, 32, 3)
+    np.testing.assert_array_equal(d.labels, np.asarray(fx.CIFAR_LABELS, np.int32))
+    # Channel-major planes -> NHWC: plane ch of record i fills X[i,:,:,ch].
+    for i in range(n):
+        for ch in range(3):
+            want = ((i * 40 + 17 * ch) % 256) / 255.0
+            np.testing.assert_allclose(
+                np.asarray(d.data[i, :, :, ch], np.float64), want, atol=1e-7
+            )
+
+
+def test_cifar_rejects_truncated_file(tmp_path):
+    p = tmp_path / "bad.bin"
+    p.write_bytes(b"\x00" * 100)
+    with pytest.raises(ValueError):
+        CifarLoader.load(str(p))
+
+
+def test_mnist_idx_pair_bytes():
+    d = MnistLoader.load(os.path.join(DATA, "mnist", "t10k"))
+    n = len(fx.MNIST_LABELS)
+    assert d.data.shape == (n, 784)
+    np.testing.assert_array_equal(d.labels, np.asarray(fx.MNIST_LABELS, np.int32))
+    for i in range(n):
+        want = fx.mnist_image_bytes(i).reshape(-1).astype(np.float64) / 255.0
+        np.testing.assert_allclose(
+            np.asarray(d.data[i], np.float64), want, atol=1e-7
+        )
+
+
+def _mean_color(img):
+    return np.asarray(img, np.float64).mean(axis=(0, 1))
+
+
+def test_imagenet_tar_and_dir_synsets():
+    label_map = ImageNetLoader.load_label_map(
+        os.path.join(DATA, "imagenet", "labels.txt")
+    )
+    assert label_map == {s: l for s, (l, _c) in fx.IMAGENET_SYNSETS.items()}
+    d = ImageNetLoader.load(
+        os.path.join(DATA, "imagenet", "train"), label_map, size=32, workers=2
+    )
+    # Deterministic walk order: sorted entries (tar synset first), archive
+    # order within the tar, sorted filenames within the dir synset.
+    want_labels, want_colors = [], []
+    for synset, (label, colors) in sorted(fx.IMAGENET_SYNSETS.items()):
+        for c in colors:
+            want_labels.append(label)
+            want_colors.append(np.asarray(c, np.float64) / 255.0)
+    assert d.data.shape == (len(want_labels), 32, 32, 3)
+    np.testing.assert_array_equal(d.labels, np.asarray(want_labels, np.int32))
+    for i, want in enumerate(want_colors):  # JPEG-lossy tolerance
+        np.testing.assert_allclose(_mean_color(d.data[i]), want, atol=0.05)
+
+
+def test_imagenet_stream_matches_bulk_load():
+    label_map = ImageNetLoader.load_label_map(
+        os.path.join(DATA, "imagenet", "labels.txt")
+    )
+    root = os.path.join(DATA, "imagenet", "train")
+    bulk = ImageNetLoader.load(root, label_map, size=32, workers=2)
+    batches = list(
+        ImageNetLoader.stream_batches(
+            root, label_map, batch_size=3, size=32, workers=2
+        )
+    )
+    X = np.concatenate([b for b, _y in batches])
+    y = np.concatenate([y for _b, y in batches])
+    np.testing.assert_array_equal(y, np.asarray(bulk.labels))
+    np.testing.assert_allclose(
+        np.asarray(X, np.float64), np.asarray(bulk.data, np.float64), atol=1e-6
+    )
+
+
+def test_voc_xml_multilabels_and_images():
+    d = VOCLoader.load(
+        os.path.join(DATA, "voc", "JPEGImages"),
+        os.path.join(DATA, "voc", "Annotations"),
+        size=32,
+        workers=2,
+    )
+    names = sorted(fx.VOC_FIXTURES)  # loader orders by sorted annotation name
+    assert d.data.shape == (len(names), 32, 32, 3)
+    for i, name in enumerate(names):
+        classes, color = fx.VOC_FIXTURES[name]
+        want = np.zeros(len(VOC_CLASSES), np.int32)
+        for c in set(classes):  # duplicate <object>s collapse to one bit
+            want[VOC_CLASSES.index(c)] = 1
+        np.testing.assert_array_equal(np.asarray(d.labels[i]), want)
+        np.testing.assert_allclose(
+            _mean_color(d.data[i]), np.asarray(color, np.float64) / 255.0, atol=0.05
+        )
+
+
+def test_newsgroups_directory_layout():
+    d, classes = NewsgroupsDataLoader.load(
+        os.path.join(DATA, "newsgroups", "train")
+    )
+    groups = sorted(fx.NEWS_DOCS)
+    assert classes == groups
+    want_texts, want_labels = [], []
+    for gi, group in enumerate(groups):
+        for doc in sorted(fx.NEWS_DOCS[group]):
+            want_texts.append(fx.NEWS_DOCS[group][doc])
+            want_labels.append(gi)
+    assert list(d.data) == want_texts  # exact bytes, exact order
+    np.testing.assert_array_equal(d.labels, np.asarray(want_labels, np.int32))
+
+
+def test_newsgroups_test_split_label_alignment(tmp_path):
+    # A test split missing one class must keep training label indices.
+    src = os.path.join(DATA, "newsgroups", "train")
+    only = sorted(fx.NEWS_DOCS)[1]
+    os.symlink(os.path.join(src, only), tmp_path / only)
+    d, classes = NewsgroupsDataLoader.load(
+        str(tmp_path), classes=sorted(fx.NEWS_DOCS)
+    )
+    assert classes == sorted(fx.NEWS_DOCS)
+    np.testing.assert_array_equal(
+        d.labels, np.full(len(fx.NEWS_DOCS[only]), 1, np.int32)
+    )
+
+
+def test_amazon_jsonl_star_threshold():
+    d = AmazonReviewsDataLoader.load(os.path.join(DATA, "amazon", "reviews.jsonl"))
+    assert list(d.data) == [t for t, _s in fx.AMAZON_ROWS]
+    want = [1 if s > AmazonReviewsDataLoader.THRESHOLD else 0 for _t, s in fx.AMAZON_ROWS]
+    np.testing.assert_array_equal(d.labels, np.asarray(want, np.int32))
+
+
+def test_timit_npz_roundtrip():
+    d = TimitFeaturesDataLoader.load(os.path.join(DATA, "timit", "frames.npz"))
+    assert d.data.shape == (fx.TIMIT_N, fx.TIMIT_D)
+    want = (
+        np.arange(fx.TIMIT_N * fx.TIMIT_D, dtype=np.float64).reshape(
+            fx.TIMIT_N, fx.TIMIT_D
+        )
+        / 100.0
+    )
+    np.testing.assert_allclose(np.asarray(d.data, np.float64), want, atol=1e-6)
+    np.testing.assert_array_equal(
+        d.labels, (np.arange(fx.TIMIT_N) * 7 % 24).astype(np.int32)
+    )
