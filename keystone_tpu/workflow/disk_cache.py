@@ -123,9 +123,10 @@ class _RestrictedUnpickler(pickle.Unpickler):
 
 
 def _load_entry(f) -> Any:
-    from keystone_tpu.config import env_flag
-
-    if env_flag("KEYSTONE_CACHE_TRUST_ALL"):
+    # Strict "=1" on purpose (NOT env_flag): this knob disables the
+    # restricted unpickler entirely, so a mistyped spelling ("off",
+    # "disabled", ...) must fail closed (keep the allowlist), not open.
+    if os.environ.get("KEYSTONE_CACHE_TRUST_ALL") == "1":
         return pickle.load(f)
     return _RestrictedUnpickler(f).load()
 

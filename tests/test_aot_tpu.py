@@ -55,7 +55,7 @@ def _sds(shape, mesh, spec, dtype=jnp.float32):
 
 def _compiled_ok(compiled) -> bool:
     text = compiled.as_text()
-    assert "HloModule" in text or len(text) > 0
+    assert "HloModule" in text
     return True
 
 
@@ -114,7 +114,7 @@ def test_ring_bcd_step_compiles_for_v5e(mesh):
 
     fn = _ring_solve_fn(mesh, AXIS, None, _precision())
     n, d, k = 512, 256, 16
-    d_loc, kc = d // 8, k // 8 if k >= 8 else k
+    kc = k // 8 if k >= 8 else k
     compiled = fn.lower(
         _sds((n, d), mesh, P(None, AXIS)),
         _sds((n, 8 * kc), mesh, P(None, AXIS)),

@@ -114,3 +114,92 @@ def test_checkride_keeps_tpu_ok_priors(tmp_path):
     report = json.loads((tmp_path / "report.json").read_text())
     assert report["steps"]["streamed_overlap"]["backend"] == "tpu"
     assert report["tpu_evidence_steps"] == ["streamed_overlap"]
+
+
+def _sweep_module():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import importlib
+
+    import checkride
+
+    return importlib.reload(checkride)
+
+
+def test_mid_sweep_tpu_death_sets_degrade_flag(tmp_path, monkeypatch):
+    """A chip death mid-sweep with completed rows returns ok=True (the rows
+    are evidence) but must carry tpu_dead so the orchestrator degrades the
+    remaining ride instead of burning a full timeout per step."""
+    checkride = _sweep_module()
+    import bench
+
+    monkeypatch.setattr(checkride, "_probe", lambda t: {"live": False})
+    monkeypatch.setattr(bench, "_run_worker", lambda env, scale, dtype, timeout: None)
+
+    r = checkride.run_mfu_sweep("mfu_sweep", "tpu", True, 5.0, str(tmp_path))
+    assert r.get("tpu_dead") is True
+    assert r["ok"] is False  # no completed rows
+
+    rows = [
+        {
+            "block": 64,
+            "dtype": "f32",
+            "tflops_per_chip": 7.5,
+            "mfu_vs_plausible_peak": 0.4,
+            "seconds_per_solve": 0.01,
+        }
+    ]
+    seeded = tmp_path / "seeded"
+    seeded.mkdir()
+    (seeded / "step_mfu_sweep.json").write_text(
+        json.dumps(
+            {
+                "ok": True,
+                "backend": "tpu",
+                "scale": "quick",
+                "rows": rows,
+                "partial": True,
+                "step": "mfu_sweep",
+            }
+        )
+    )
+    r2 = checkride.run_mfu_sweep("mfu_sweep", "tpu", True, 5.0, str(seeded))
+    assert r2.get("tpu_dead") is True
+    assert r2["ok"] is True  # the checkpointed row survives as evidence
+    assert [row for row in r2["rows"] if "error" not in row] == rows
+    # The orchestrator's degrade condition must fire in BOTH cases.
+    assert not r["ok"] or r.get("tpu_dead")
+    assert not r2["ok"] or r2.get("tpu_dead")
+
+
+def test_cpu_rerun_preserves_partial_tpu_sweep_rows(tmp_path):
+    """A partial TPU sweep checkpoint must never be overwritten by a
+    CPU-degraded re-run — partial live-chip evidence is the harness's
+    whole purpose."""
+    checkride = _sweep_module()
+    rows = [
+        {
+            "block": 64,
+            "dtype": "f32",
+            "tflops_per_chip": 7.5,
+            "mfu_vs_plausible_peak": 0.4,
+            "seconds_per_solve": 0.01,
+        }
+    ]
+    (tmp_path / "step_mfu_sweep.json").write_text(
+        json.dumps(
+            {
+                "ok": True,
+                "backend": "tpu",
+                "scale": "quick",
+                "rows": rows,
+                "partial": True,
+                "step": "mfu_sweep",
+            }
+        )
+    )
+    r = checkride.run_mfu_sweep("mfu_sweep", "cpu", True, 5.0, str(tmp_path))
+    assert r.get("preserved_tpu_rows") is True
+    assert r["backend"] == "tpu" and r["rows"] == rows
+    # State on disk untouched (still the TPU rows).
+    saved = json.loads((tmp_path / "step_mfu_sweep.json").read_text())
+    assert saved["backend"] == "tpu" and saved["rows"] == rows

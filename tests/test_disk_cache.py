@@ -501,3 +501,35 @@ class TestNodeOptimizationMemo:
             and not isinstance(op.estimator, LeastSquaresEstimator)
         ]
         assert c1 and c2 and c1[0] is c2[0]
+
+
+def test_trust_all_knob_fails_closed_on_falsy_spellings(tmp_path, monkeypatch):
+    """KEYSTONE_CACHE_TRUST_ALL is a security knob: only the strict "1"
+    disables the restricted unpickler; "off"/"disabled"/"0" keep it."""
+    import glob
+    import pickle
+
+    import numpy as np
+
+    from keystone_tpu.workflow.disk_cache import DiskFitCache
+
+    cache = DiskFitCache(str(tmp_path))
+    key = "deadbeef" * 8
+    cache.put(key, np.arange(4.0))
+    entry = glob.glob(str(tmp_path / "**" / "*.pkl"), recursive=True)[0]
+
+    class Evil:
+        def __reduce__(self):
+            return (eval, ("['pwned']",))
+
+    for spelling, expect_blocked in [
+        ("off", True),
+        ("disabled", True),
+        ("0", True),
+        ("1", False),
+    ]:
+        with open(entry, "wb") as f:
+            pickle.dump(Evil(), f)
+        monkeypatch.setenv("KEYSTONE_CACHE_TRUST_ALL", spelling)
+        got = cache.get(key)  # rejected entries -> dropped, miss (None)
+        assert (got is None) == expect_blocked, (spelling, got)

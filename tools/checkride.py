@@ -188,6 +188,14 @@ def run_mfu_sweep(
         blocks = [1024, 2048, 4096, 8192]
 
     prior = _load_state(state_dir, step) or {}
+    if (
+        target == "cpu"
+        and prior.get("backend") == "tpu"
+        and any("error" not in r for r in prior.get("rows", []))
+    ):
+        # Never overwrite checkpointed live-chip rows with a CPU-degraded
+        # re-run — keeping partial TPU evidence is the point of the harness.
+        return dict(prior, preserved_tpu_rows=True)
     # Resume only rows measured at this scale AND on this backend target —
     # in quick mode the scale is "quick" for both backends, and mixing
     # CPU-measured rows into a TPU-tagged result would fake evidence.
@@ -220,6 +228,10 @@ def run_mfu_sweep(
                         "scale": scale,
                         "rows": rows,
                         "error": "tpu died mid-sweep",
+                        # ok may be True (completed rows survive), so the
+                        # orchestrator needs an explicit death signal to
+                        # degrade the rest of the ride.
+                        "tpu_dead": True,
                     }
                     _save_state(state_dir, step, dict(partial, step=step))
                     return partial
@@ -340,9 +352,10 @@ def orchestrate(args) -> int:
         print(f"checkride: {step}: {status} [{result.get('backend')}]", file=sys.stderr)
         # Mid-ride death check: if a TPU step failed, re-probe and degrade
         # the rest of the ride rather than timing out step after step.
-        if target == "tpu" and not result.get("ok"):
-            probe = _probe(args.probe_timeout)
-            if not probe["live"]:
+        if target == "tpu" and (not result.get("ok") or result.get("tpu_dead")):
+            # tpu_dead means the sweep itself just probed the chip dead —
+            # don't burn another probe_timeout re-confirming it.
+            if result.get("tpu_dead") or not _probe(args.probe_timeout)["live"]:
                 print("checkride: TPU died mid-ride; degrading to CPU", file=sys.stderr)
                 target = "cpu"
                 meta["degraded_mid_ride"] = True
