@@ -144,68 +144,144 @@ class Profiler:
         except Exception:
             return None
 
-    def profile(
-        self, graph: Graph, targets: Sequence[GraphId]
-    ) -> Dict[NodeId, NodeProfile]:
-        profiles: Dict[NodeId, NodeProfile] = {}
+    @staticmethod
+    def _execute_node(op: Operator, dep_vals: List[Any]) -> Any:
+        """Execute one non-dataset node on sampled inputs. Estimator fits
+        run on a COPY of the user's estimator: a sample fit is a profiling
+        probe, and its side effects (fitted state, dispatch fields like
+        ``last_choice``, counters) must not leak into the object the user
+        holds and the real execution will fit."""
+        if isinstance(op, EstimatorOperator):
+            import copy
+
+            try:
+                probe = copy.deepcopy(op.estimator)
+            except Exception:  # unpicklable estimator state: shallow guard
+                probe = copy.copy(op.estimator)
+            return EstimatorOperator(probe).execute(dep_vals)
+        return op.execute(dep_vals)
+
+    def _sampled_walk(self, graph: Graph, ids: Sequence[GraphId], on_node=None):
+        """Shared traversal core: row-sample dataset nodes, execute
+        everything reachable from ``ids`` in topological order. Returns
+        ({node: value}, {node: row-scale}, {node: rows-reliable}). A node's
+        scale only predicts its FULL row count when every prefix node
+        preserved row count at sample size; a row-changing node (sampler,
+        aggregator, windower) poisons reliability downstream. ``on_node(nid,
+        op, dep_vals, value, scale, dt)`` observes each executed node (the
+        profiling hook)."""
         values: Dict[GraphId, Any] = {}
         scales: Dict[GraphId, float] = {}
-        for nid in graph.reachable(targets):
+        rows_ok: Dict[GraphId, bool] = {}
+        if not ids:
+            return values, scales, rows_ok
+        for nid in graph.reachable(ids):
             op = graph.operators[nid]
             deps = graph.dependencies[nid]
             if any(isinstance(d, SourceId) for d in deps):
-                continue  # unbound inference path: not profiled
+                continue  # unbound inference path: no sample data
             if any(d not in values and isinstance(d, NodeId) for d in deps):
                 continue  # upstream skipped
             dep_vals = [values[d] for d in deps]
             if isinstance(op, DatasetOperator):
                 full = op.data
-                sampled = _sample(full, self.sample_rows)
+                value = _sample(full, self.sample_rows)
                 try:
-                    scale = max(len(full), 1) / max(len(sampled), 1)
+                    scale = max(len(full), 1) / max(len(value), 1)
                 except TypeError:
                     scale = 1.0
-                t0 = time.perf_counter()
-                values[nid] = sampled
-                dt = time.perf_counter() - t0
-                scales[nid] = scale
+                dt = 0.0
+                ok = True
             else:
-                # The fitted-transformer case (DelegatingOperator) carries
-                # its transformer as a dependency value, not an attribute.
-                transformer = getattr(op, "transformer", None)
-                batch_val = dep_vals[0] if dep_vals else None
-                if (
-                    transformer is None
-                    and isinstance(op, DelegatingOperator)
-                    and len(dep_vals) == 2
-                ):
-                    transformer, batch_val = dep_vals[0], dep_vals[1]
-                if transformer is not None and getattr(
-                    transformer, "jittable", False
-                ):
-                    # Warm up so the timed call excludes jit compilation —
-                    # compile time scaled by the FLOPs ratio would dominate
-                    # (and falsify) the ranking.
-                    warm = op.execute(dep_vals)
-                    if isinstance(warm, jax.Array):
-                        jax.block_until_ready(warm)
+                t0 = time.perf_counter()
+                value = self._execute_node(op, dep_vals)
+                jax.block_until_ready(value) if isinstance(
+                    value, jax.Array
+                ) else None
+                dt = time.perf_counter() - t0
+                scale = max([scales.get(d, 1.0) for d in deps], default=1.0)
+                ok = all(rows_ok.get(d, True) for d in deps)
+                if ok:
+                    in_rows = next(
+                        (
+                            len(v)
+                            for v in dep_vals
+                            if hasattr(v, "__len__")
+                        ),
+                        None,
+                    )
+                    try:
+                        out_rows = len(value)
+                    except TypeError:
+                        out_rows = None
+                    if (
+                        in_rows is not None
+                        and out_rows is not None
+                        and out_rows != in_rows
+                    ):
+                        ok = False  # row-changing node: scale no longer = n
+            values[nid], scales[nid], rows_ok[nid] = value, scale, ok
+            if on_node is not None:
+                on_node(nid, op, dep_vals, value, scale, dt)
+        return values, scales, rows_ok
+
+    def sample_values(
+        self, graph: Graph, needed: Sequence[GraphId]
+    ) -> tuple[
+        Dict[GraphId, Any], Dict[GraphId, float], Dict[GraphId, bool]
+    ]:
+        """Row-sampled prefix execution without timing: returns
+        ({node: value}, {node: row-scale}, {node: rows-reliable}) for
+        everything reachable from ``needed``. This is the stats channel of
+        the sampling profiler — how NodeOptimizationRule obtains (n, d) for
+        estimators fed by transformer subgraphs rather than
+        directly-attached datasets (the reference profiles sampled prefixes
+        for stats anywhere in the DAG; SURVEY.md §3.5)."""
+        return self._sampled_walk(graph, needed)
+
+    def profile(
+        self, graph: Graph, targets: Sequence[GraphId]
+    ) -> Dict[NodeId, NodeProfile]:
+        profiles: Dict[NodeId, NodeProfile] = {}
+
+        def on_node(nid, op, dep_vals, value, scale, dt):
+            if isinstance(op, DatasetOperator):
+                profiles[nid] = NodeProfile(
+                    seconds=dt, bytes=_value_bytes(value), scale=scale
+                )
+                return
+            # The fitted-transformer case (DelegatingOperator) carries
+            # its transformer as a dependency value, not an attribute.
+            transformer = getattr(op, "transformer", None)
+            batch_val = dep_vals[0] if dep_vals else None
+            if (
+                transformer is None
+                and isinstance(op, DelegatingOperator)
+                and len(dep_vals) == 2
+            ):
+                transformer, batch_val = dep_vals[0], dep_vals[1]
+            if transformer is not None and getattr(
+                transformer, "jittable", False
+            ):
+                # Re-time on the warmed path so the recorded seconds exclude
+                # jit compilation — compile time scaled by the FLOPs ratio
+                # would dominate (and falsify) the ranking. (The walk's
+                # first execute above was the warm-up.)
                 t0 = time.perf_counter()
                 out = op.execute(dep_vals)
-                jax.block_until_ready(out) if isinstance(out, jax.Array) else None
+                jax.block_until_ready(out) if isinstance(
+                    out, jax.Array
+                ) else None
                 dt = time.perf_counter() - t0
-                values[nid] = out
-                scales[nid] = max(
-                    [scales.get(d, 1.0) for d in deps], default=1.0
-                )
             flops_ratio = None
-            if not isinstance(op, DatasetOperator) and transformer is not None:
-                flops_ratio = self._flops_ratio(
-                    transformer, batch_val, scales[nid]
-                )
+            if transformer is not None:
+                flops_ratio = self._flops_ratio(transformer, batch_val, scale)
             profiles[nid] = NodeProfile(
                 seconds=dt,
-                bytes=_value_bytes(values[nid]),
-                scale=scales[nid],
+                bytes=_value_bytes(value),
+                scale=scale,
                 flops_ratio=flops_ratio,
             )
+
+        self._sampled_walk(graph, targets, on_node)
         return profiles

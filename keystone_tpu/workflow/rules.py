@@ -7,6 +7,7 @@ Ref: src/main/scala/workflow/{AutoCacheRule,NodeOptimizationRule}.scala
 
 from __future__ import annotations
 
+import logging
 import weakref
 from typing import Dict, List, Sequence
 
@@ -21,14 +22,28 @@ from keystone_tpu.workflow.operators import (
 from keystone_tpu.workflow.optimizer import Rule
 
 
+def _scaled_shape(value, scale: float):
+    """Full-size shape estimate from a row-sampled value: axis 0 scales by
+    the sample's row ratio, trailing dims are exact."""
+    shape = getattr(value, "shape", None)
+    if shape is None or len(shape) == 0:
+        return None
+    if scale == 1.0:
+        return tuple(shape)
+    return (int(round(shape[0] * scale)),) + tuple(shape[1:])
+
+
 class NodeOptimizationRule(Rule):
     """Swap optimizable estimators for concrete implementations chosen from
     data statistics at optimization time.
 
     An estimator opts in by defining ``optimize_node(self, data_shape) ->
-    estimator``; shapes are read from directly-attached dataset nodes (the
-    common with_data case). Estimators whose inputs are deeper subgraphs
-    keep their fit-time dispatch (e.g. LeastSquaresEstimator's cost model).
+    estimator``. Shapes are read from directly-attached dataset nodes when
+    available (the simple with_data case); estimators fed by deeper
+    transformer subgraphs get their (n, d) from ONE sampled prefix run per
+    apply (the reference's optimizer profiles sampled prefixes for stats
+    anywhere in the DAG — SURVEY.md §3.5), so cost-model dispatch happens
+    at optimization time, not fit time.
 
     The concrete replacement is memoized per (estimator, shapes): every
     optimizer pass over any copy of the graph swaps in the SAME concrete
@@ -36,14 +51,57 @@ class NodeOptimizationRule(Rule):
     cache entry — is stable across executions.
     """
 
-    def __init__(self):
+    def __init__(self, sample_rows: int = 64):
         self._memo: Dict[tuple, tuple] = {}
+        # Deep-graph shapes memoized by the deps' CONTENT-STABLE prefix
+        # digests: repeated optimizer passes over graph copies hit this
+        # instead of re-executing the sampled prefix. id-based prefixes
+        # digest to None and are never memoized — a recycled id must not
+        # serve stale shapes (same rule as the executor's fit cache).
+        self._shape_memo: Dict[tuple, List] = {}
+        self.sample_rows = sample_rows
 
     def clear_cache(self) -> None:
         self._memo.clear()
+        self._shape_memo.clear()
+
+    @staticmethod
+    def _dep_prefix_key(graph: Graph, deps: Sequence[GraphId]):
+        """(memo key, sampleable): the key is a tuple of content-stable
+        prefix digests (None when any prefix lacks content identity — then
+        shapes are recomputed each pass rather than risking a stale hit);
+        sampleable=False when a prefix reaches an unbound source, where a
+        sample run could never resolve the shapes."""
+        from keystone_tpu.workflow.graph import structural_digest
+
+        digests = []
+        for d in deps:
+            if not isinstance(d, NodeId):
+                return None, False
+            if graph.sources_of([d]):
+                return None, False
+            digests.append(structural_digest(graph, d))
+        if any(x is None for x in digests):
+            return None, True
+        return tuple(digests), True
+
+    def _sample_prefixes(self, graph: Graph, targets: Sequence[GraphId]):
+        """One row-sampled execution of every optimizable estimator's input
+        prefix; all deep-graph estimators in the DAG share the run."""
+        needed = []
+        for nid in graph.reachable(targets):
+            op = graph.operators[nid]
+            if isinstance(op, EstimatorOperator) and (
+                getattr(op.estimator, "optimize_node", None) is not None
+            ):
+                needed.extend(
+                    d for d in graph.dependencies[nid] if isinstance(d, NodeId)
+                )
+        return Profiler(self.sample_rows).sample_values(graph, needed)
 
     def apply(self, graph: Graph, targets: Sequence[GraphId]) -> Graph:
         out = graph
+        sampled = None  # lazy: only deep-graph estimators pay for the run
         for nid in graph.reachable(targets):
             op = graph.operators[nid]
             if not isinstance(op, EstimatorOperator):
@@ -51,14 +109,57 @@ class NodeOptimizationRule(Rule):
             optimize = getattr(op.estimator, "optimize_node", None)
             if optimize is None:
                 continue
+            deps = graph.dependencies[nid]
             shapes = []
-            for dep in graph.dependencies[nid]:
+            for dep in deps:
                 shape = None
                 if isinstance(dep, NodeId):
                     dep_op = graph.operators.get(dep)
                     if isinstance(dep_op, DatasetOperator):
                         shape = getattr(dep_op.data, "shape", None)
                 shapes.append(shape)
+            if shapes and any(s is None for s in shapes):
+                pkey, sampleable = self._dep_prefix_key(graph, deps)
+                if not sampleable:
+                    continue  # unbound prefix: nothing to sample or dispatch
+                memo_shapes = (
+                    self._shape_memo.get(pkey) if pkey is not None else None
+                )
+                if memo_shapes is not None:
+                    shapes = memo_shapes
+                else:
+                    if sampled is None:
+                        try:
+                            sampled = self._sample_prefixes(graph, targets)
+                        except Exception:
+                            # A prefix that can't run on a 64-row sample
+                            # must not crash optimization: affected
+                            # estimators keep their fit-time dispatch.
+                            logging.getLogger(__name__).warning(
+                                "sampled prefix run failed; deep-graph "
+                                "estimators keep fit-time dispatch",
+                                exc_info=True,
+                            )
+                            sampled = ({}, {}, {})
+                    values, scales, rows_ok = sampled
+                    shapes = [
+                        s
+                        if s is not None
+                        else (
+                            _scaled_shape(
+                                values.get(dep), scales.get(dep, 1.0)
+                            )
+                            # A row-changing prefix (sampler/aggregator)
+                            # makes scaled-n a lie; defer to fit-time.
+                            if rows_ok.get(dep, False)
+                            else None
+                        )
+                        for s, dep in zip(shapes, deps)
+                    ]
+                    if pkey is not None:
+                        if len(self._shape_memo) > 1024:
+                            self._shape_memo.clear()
+                        self._shape_memo[pkey] = shapes
             if not shapes or shapes[0] is None:
                 continue
             key = (id(op.estimator), tuple(shapes))
