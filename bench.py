@@ -49,14 +49,16 @@ SCALE = {
 
 def bcd_flops(n: int, d: int, k: int, block: int, iters: int) -> float:
     """FLOPs of block_coordinate_descent's device work with gram caching
-    (the default for multi-epoch solves): grams + Cholesky once per block,
-    then per-epoch residual/rhs gemms and triangular solves."""
+    (the default for multi-epoch solves): gram + Cholesky + explicit ridge
+    inverse once per block, then per-epoch residual/rhs gemms and one
+    inverse-multiply gemm (no triangular solves in the epoch loop)."""
     nb = d // block
-    once = 2.0 * n * block * block + block**3 / 3.0  # gram + Cholesky
+    # gram + Cholesky + inverse formation (two b×b triangular solves)
+    once = 2.0 * n * block * block + block**3 / 3.0 + 2.0 * block**3
     per_epoch = (
         2.0 * n * block * k  # residual restore  A_b @ W_b
         + 2.0 * n * block * k  # rhs  A_bᵀR
-        + 2.0 * block * block * k  # triangular solves
+        + 2.0 * block * block * k  # inverse-multiply solve gemm
         + 2.0 * n * block * k  # residual update
     )
     return nb * (once + per_epoch * iters)

@@ -42,31 +42,53 @@ from keystone_tpu.linalg.row_matrix import (
 # -- shared per-shard solver math (single source for every shard_map body) --
 
 
+def _donate(mesh: Mesh, *argnums: int):
+    """donate_argnums for the solver hot loops on real hardware: the old
+    residual/weight buffers are dead the moment the update returns, and
+    donating them caps the solver's HBM high-water at one live copy
+    (SURVEY.md §5 sanitizer row's donation/aliasing prescription). CPU
+    ignores donation with a per-call warning, so only device meshes opt in."""
+    if mesh.devices.flat[0].platform == "cpu":
+        return ()
+    return argnums
+
+
 def _local_weighted(a_b, w_rows, weighted: bool):
     return a_b * w_rows[:, None] if weighted else a_b
 
 
-def _local_gram_chol(a_b, aw, lam, precision, axis):
+def _local_gram_inv(a_b, aw, lam, precision, axis):
+    """Explicit ridge resolvent (AᵀA + λI)⁻¹ for the block.
+
+    The inverse — not the Cholesky factor — is the cached quantity: XLA
+    lowers triangular solves to a sequential substitution that dominates
+    BCD wall-clock on TPU, while multiplying by a precomputed inverse is
+    one MXU gemm. Forming the inverse costs a one-time pair of triangular
+    solves per block; the λ-regularized SPD gram keeps it well-conditioned,
+    and later epochs re-solve against the residual, so per-epoch solve
+    error self-corrects instead of accumulating."""
     gram = lax.psum(solver_matmul(aw.T, a_b, precision), axis)
     b = a_b.shape[1]
-    return jnp.linalg.cholesky(gram + lam * jnp.eye(b, dtype=gram.dtype))
+    chol = jnp.linalg.cholesky(gram + lam * jnp.eye(b, dtype=gram.dtype))
+    return cho_solve((chol, True), jnp.eye(b, dtype=gram.dtype))
 
 
-def _local_solve_update(a_b, aw, chol, r, w_b, precision, axis):
+def _local_solve_update(a_b, aw, inv, r, w_b, precision, axis):
     r_plus = r + solver_matmul(a_b, w_b, precision)
     rhs = lax.psum(solver_matmul(aw.T, r_plus, precision), axis)
-    w_b_new = cho_solve((chol, True), rhs)
+    w_b_new = solver_matmul(inv, rhs, precision)
     r_new = r_plus - solver_matmul(a_b, w_b_new, precision)
     return r_new, w_b_new
 
 
 @lru_cache(maxsize=None)
-def _gram_chol_fn(mesh: Mesh, axis: str, precision, weighted: bool):
-    """Per-block gram + Cholesky, computed once per block (epoch-invariant)."""
+def _gram_inv_fn(mesh: Mesh, axis: str, precision, weighted: bool):
+    """Per-block gram + ridge inverse, computed once per block
+    (epoch-invariant)."""
 
     def local(a_b, lam, w_rows):
         aw = _local_weighted(a_b, w_rows, weighted)
-        return _local_gram_chol(a_b, aw, lam, precision, axis)
+        return _local_gram_inv(a_b, aw, lam, precision, axis)
 
     sm = shard_map(
         local,
@@ -80,13 +102,13 @@ def _gram_chol_fn(mesh: Mesh, axis: str, precision, weighted: bool):
 
 @lru_cache(maxsize=None)
 def _cached_block_update_fn(mesh: Mesh, axis: str, precision, weighted: bool):
-    """BCD block update reusing a precomputed Cholesky factor: only the
-    residual/rhs gemms and two triangular solves remain in the epoch loop —
-    the dominant 2·n·b² gram FLOPs drop out after the first epoch."""
+    """BCD block update reusing the precomputed ridge inverse: only MXU
+    gemms remain in the epoch loop — the dominant 2·n·b² gram FLOPs drop
+    out after the first epoch, and no triangular solve ever runs in it."""
 
-    def local(a_b, chol, r, w_b, w_rows):
+    def local(a_b, inv, r, w_b, w_rows):
         aw = _local_weighted(a_b, w_rows, weighted)
-        return _local_solve_update(a_b, aw, chol, r, w_b, precision, axis)
+        return _local_solve_update(a_b, aw, inv, r, w_b, precision, axis)
 
     sm = shard_map(
         local,
@@ -95,22 +117,22 @@ def _cached_block_update_fn(mesh: Mesh, axis: str, precision, weighted: bool):
         out_specs=(P(axis), P()),
         check_vma=False,
     )
-    return jax.jit(sm)
+    return jax.jit(sm, donate_argnums=_donate(mesh, 2, 3))
 
 
 @lru_cache(maxsize=None)
 def _first_epoch_update_fn(mesh: Mesh, axis: str, precision, weighted: bool):
-    """Fused block update that also emits the gram Cholesky — the streamed
-    path's first epoch. Fusion keeps a_b in one XLA program so the block is
-    read from HBM once for gram + update instead of twice."""
+    """Fused block update that also emits the gram's ridge inverse — the
+    streamed path's first epoch. Fusion keeps a_b in one XLA program so the
+    block is read from HBM once for gram + update instead of twice."""
 
     def local(a_b, r, w_b, lam, w_rows):
         aw = _local_weighted(a_b, w_rows, weighted)
-        chol = _local_gram_chol(a_b, aw, lam, precision, axis)
+        inv = _local_gram_inv(a_b, aw, lam, precision, axis)
         r_new, w_b_new = _local_solve_update(
-            a_b, aw, chol, r, w_b, precision, axis
+            a_b, aw, inv, r, w_b, precision, axis
         )
-        return r_new, w_b_new, chol
+        return r_new, w_b_new, inv
 
     sm = shard_map(
         local,
@@ -119,7 +141,7 @@ def _first_epoch_update_fn(mesh: Mesh, axis: str, precision, weighted: bool):
         out_specs=(P(axis), P(), P()),
         check_vma=False,
     )
-    return jax.jit(sm)
+    return jax.jit(sm, donate_argnums=_donate(mesh, 1, 2))
 
 
 @lru_cache(maxsize=None)
@@ -148,7 +170,7 @@ def _block_update_fn(mesh: Mesh, axis: str, precision, weighted: bool):
         in_specs=(P(axis), P(axis), P(), P(), P(axis)),
         out_specs=(P(axis), P()),
     )
-    return jax.jit(sm)
+    return jax.jit(sm, donate_argnums=_donate(mesh, 1, 2))
 
 
 def block_coordinate_descent(
@@ -173,11 +195,13 @@ def block_coordinate_descent(
     (SURVEY.md §5 failure-detection row): deterministic re-execution from
     the last epoch boundary instead of RDD lineage.
 
-    ``cache_grams`` (default: auto) precomputes each block's gram Cholesky
-    once — grams are epoch-invariant, so multi-epoch solves drop the
-    dominant 2·n·b² FLOPs from every epoch after the first. Auto enables it
-    when num_iters > 1 and the (num_blocks · b²) factors fit a quarter of
-    the HBM budget.
+    ``cache_grams`` (default: auto) precomputes each block's gram ridge
+    INVERSE once — grams are epoch-invariant, so multi-epoch solves drop
+    the dominant 2·n·b² FLOPs from every epoch after the first, and the
+    per-epoch solve is a pure MXU gemm (TPU triangular solves are
+    sequential and would dominate otherwise). Auto enables it when
+    num_iters > 1 and the (num_blocks · b²) factors fit a quarter of the
+    HBM budget.
     """
     A._check_aligned(B)
     mesh, axis = A.mesh, config.data_axis
@@ -211,7 +235,10 @@ def block_coordinate_descent(
     lam_arr = jnp.asarray(lam, dtype=cdtype)
 
     W = [jnp.zeros((e - s, k), dtype=cdtype) for s, e in blocks]
-    R = B.data.astype(cdtype)
+    # jnp.array COPIES: astype is a no-op alias when dtypes already
+    # match, and the first update DONATES R — donating an alias of the
+    # caller's B.data would delete their labels out from under them.
+    R = jnp.array(B.data, dtype=cdtype)
     sharding = jax.sharding.NamedSharding(mesh, P(axis))
     fingerprint = None
     if checkpoint_dir is not None:
@@ -235,23 +262,23 @@ def block_coordinate_descent(
 
     a_blocks = [lax.slice_in_dim(A.data, s, e, axis=1) for s, e in blocks]
     if cache_grams and start_epoch < num_iters:
-        gram_chol = _gram_chol_fn(mesh, axis, _precision(), weighted)
+        gram_inv = _gram_inv_fn(mesh, axis, _precision(), weighted)
         cached_update = _cached_block_update_fn(
             mesh, axis, _precision(), weighted
         )
-        chols = []
+        invs = []
         for a_b in a_blocks:
-            c = gram_chol(a_b, lam_arr, w_rows)
+            c = gram_inv(a_b, lam_arr, w_rows)
             if throttle:
-                # The gram/Cholesky programs are mutually independent — an
+                # The gram/inverse programs are mutually independent — an
                 # un-serialized burst is exactly the concurrent-collectives
                 # pattern that deadlocks the CPU rendezvous.
                 c.block_until_ready()
-            chols.append(c)
+            invs.append(c)
         for epoch in range(start_epoch, num_iters):
             for i in range(len(blocks)):
                 R, W[i] = cached_update(
-                    a_blocks[i], chols[i], R, W[i], w_rows
+                    a_blocks[i], invs[i], R, W[i], w_rows
                 )
             if throttle:
                 R.block_until_ready()
@@ -505,8 +532,11 @@ def block_coordinate_descent_streamed(
     throttle = jax.default_backend() == "cpu"
 
     W = [jnp.zeros((e - s, k), dtype=cdtype) for s, e in blocks]
-    chols: List[Optional[jax.Array]] = [None] * nb
-    R = B.data.astype(cdtype)
+    invs: List[Optional[jax.Array]] = [None] * nb
+    # jnp.array COPIES: astype is a no-op alias when dtypes already
+    # match, and the first update DONATES R — donating an alias of the
+    # caller's B.data would delete their labels out from under them.
+    R = jnp.array(B.data, dtype=cdtype)
     fingerprint = None
     if checkpoint_dir is not None:
         if sparse:
@@ -519,7 +549,7 @@ def block_coordinate_descent_streamed(
         fingerprint = _make_fingerprint(
             B, d, block_size, lam, weighted, a_probe=a_probe, a_dtype=dtype
         )
-    # On resume, Cholesky factors rebuild lazily: the `first` update at the
+    # On resume, ridge inverses rebuild lazily: the `first` update at the
     # resumed epoch recomputes them as part of a normal update.
     start_epoch, W, R = _resume_or_default(
         checkpoint_dir, fingerprint, W, R, sharding
@@ -544,10 +574,10 @@ def block_coordinate_descent_streamed(
                 # buffering): H2D DMA overlaps the MXU work.
                 if epoch + 1 < num_iters or i + 1 < nb:
                     next_buf = put((i + 1) % nb)
-            if chols[i] is None:
-                R, W[i], chols[i] = first(cur, R, W[i], lam_arr, w_rows)
+            if invs[i] is None:
+                R, W[i], invs[i] = first(cur, R, W[i], lam_arr, w_rows)
             else:
-                R, W[i] = cached(cur, chols[i], R, W[i], w_rows)
+                R, W[i] = cached(cur, invs[i], R, W[i], w_rows)
             if throttle:
                 R.block_until_ready()
         if checkpoint_dir is not None:

@@ -86,22 +86,36 @@ class NodeOptimizationRule(Rule):
         return tuple(digests), True
 
     def _sample_prefixes(self, graph: Graph, targets: Sequence[GraphId]):
-        """One row-sampled execution of every optimizable estimator's input
-        prefix; all deep-graph estimators in the DAG share the run."""
+        """One row-sampled execution of the input prefixes of every
+        optimizable estimator that still NEEDS sampling — deep-graph deps
+        not already served by the shape memo or by direct dataset shapes.
+        All such estimators in the DAG share the run."""
         needed = []
         for nid in graph.reachable(targets):
             op = graph.operators[nid]
-            if isinstance(op, EstimatorOperator) and (
-                getattr(op.estimator, "optimize_node", None) is not None
+            if not isinstance(op, EstimatorOperator) or (
+                getattr(op.estimator, "optimize_node", None) is None
             ):
-                needed.extend(
-                    d for d in graph.dependencies[nid] if isinstance(d, NodeId)
-                )
+                continue
+            deps = graph.dependencies[nid]
+            if all(
+                isinstance(d, NodeId)
+                and isinstance(graph.operators.get(d), DatasetOperator)
+                for d in deps
+            ):
+                continue  # direct with_data case: shapes read off datasets
+            pkey, sampleable = self._dep_prefix_key(graph, deps)
+            if not sampleable:
+                continue  # unbound prefix: sampling can't resolve it
+            if pkey is not None and pkey in self._shape_memo:
+                continue  # already served without execution
+            needed.extend(d for d in deps if isinstance(d, NodeId))
         return Profiler(self.sample_rows).sample_values(graph, needed)
 
     def apply(self, graph: Graph, targets: Sequence[GraphId]) -> Graph:
         out = graph
         sampled = None  # lazy: only deep-graph estimators pay for the run
+        sample_ok = True
         for nid in graph.reachable(targets):
             op = graph.operators[nid]
             if not isinstance(op, EstimatorOperator):
@@ -131,6 +145,7 @@ class NodeOptimizationRule(Rule):
                     if sampled is None:
                         try:
                             sampled = self._sample_prefixes(graph, targets)
+                            sample_ok = True
                         except Exception:
                             # A prefix that can't run on a 64-row sample
                             # must not crash optimization: affected
@@ -141,6 +156,7 @@ class NodeOptimizationRule(Rule):
                                 exc_info=True,
                             )
                             sampled = ({}, {}, {})
+                            sample_ok = False
                     values, scales, rows_ok = sampled
                     shapes = [
                         s
@@ -156,7 +172,10 @@ class NodeOptimizationRule(Rule):
                         )
                         for s, dep in zip(shapes, deps)
                     ]
-                    if pkey is not None:
+                    # Legitimate deferrals memoize; a FAILED run must not —
+                    # a transient error would otherwise disable
+                    # optimize-time dispatch for this prefix forever.
+                    if pkey is not None and sample_ok:
                         if len(self._shape_memo) > 1024:
                             self._shape_memo.clear()
                         self._shape_memo[pkey] = shapes

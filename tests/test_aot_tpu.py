@@ -98,7 +98,7 @@ def test_bcd_streamed_first_and_cached_updates_compile_for_v5e(mesh):
     cached = _cached_block_update_fn(mesh, AXIS, _precision(), True)
     c2 = cached.lower(
         _sds((n, b), mesh, P(AXIS)),
-        _sds((b, b), mesh, P()),  # chol
+        _sds((b, b), mesh, P()),  # cached ridge inverse
         _sds((n, k), mesh, P(AXIS)),
         _sds((b, k), mesh, P()),
         _sds((n,), mesh, P(AXIS)),

@@ -582,3 +582,44 @@ class TestDeepGraphNodeOptimization:
         ]
         # real fit sees n=32; a scaled n=65536 would have picked "normal".
         assert all(isinstance(e, LeastSquaresEstimator) for e in kept)
+
+    def test_failed_sample_run_is_not_memoized(self):
+        """A transient sample failure must not permanently disable
+        optimize-time dispatch for that prefix."""
+        from keystone_tpu.nodes.learning.least_squares import LeastSquaresEstimator
+        from keystone_tpu.nodes.learning.linear_mapper import LinearMapEstimator
+        from keystone_tpu.workflow.operators import EstimatorOperator
+        from keystone_tpu.workflow.rules import NodeOptimizationRule
+
+        fail = {"on": True}
+
+        class Flaky(Transformer):
+            jittable = False
+
+            def signature(self):
+                return self.stable_signature()
+
+            def apply_batch(self, X):
+                if fail["on"]:
+                    raise RuntimeError("transient")
+                return X
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(131072, 48)).astype(np.float32)
+        Y = rng.normal(size=(131072, 8)).astype(np.float32)
+        est = LeastSquaresEstimator(lam=1e-3)
+        p = est.with_data(Flaky().to_pipeline()(X), Y)
+        rule = NodeOptimizationRule()
+        g1 = rule.apply(p.graph, [p.sink])  # fails -> fit-time dispatch kept
+        assert all(
+            isinstance(op.estimator, LeastSquaresEstimator)
+            for op in g1.operators.values()
+            if isinstance(op, EstimatorOperator)
+        )
+        fail["on"] = False
+        g2 = rule.apply(p.graph, [p.sink])  # retry succeeds -> dispatched
+        assert any(
+            isinstance(op.estimator, LinearMapEstimator)
+            for op in g2.operators.values()
+            if isinstance(op, EstimatorOperator)
+        )
