@@ -41,7 +41,14 @@ PLAUSIBLE_PEAK_TFLOPS = {"bf16": 200.0, "f32": 100.0}
 # "quick" exists for the checkride's CPU dry-run (harness validation only;
 # its TFLOPS are not a perf claim).
 SCALE = {
-    "tpu": dict(n=32768, d=8192, k=16, block=2048, iters=2),
+    "tpu": dict(n=32768, d=8192, k=16, block=4096, iters=2),
+    # Reference-scale dimensionality (TIMIT 528k / CIFAR 256k features,
+    # SURVEY.md §6): d >= 262144 exercises the many-block regime.
+    # f32 residency: A (n·d·4B = 2 GiB) + the solver's a_blocks partition
+    # copy (another 2 GiB — see bcd.py's slice-once note) + cached ridge
+    # inverses (d·block·4B = 2 GiB) ≈ 6 GiB of v5e's 16 GiB, leaving
+    # gram/Cholesky/inverse workspace headroom.
+    "tpu-xl": dict(n=2048, d=262144, k=16, block=2048, iters=2),
     "cpu": dict(n=8192, d=2048, k=16, block=512, iters=2),
     "quick": dict(n=1024, d=512, k=8, block=128, iters=2),
 }

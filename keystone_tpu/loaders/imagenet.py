@@ -21,6 +21,18 @@ from keystone_tpu.config import config
 from keystone_tpu.loaders.labeled_data import LabeledData
 
 
+def _pool_workers(requested: Optional[int]) -> int:
+    """Decode-pool size, capped at the host's core count. Measured on a
+    1-core host (NOTES_r2 §8): PIL decode throughput was NON-monotone in
+    worker count (343 img/s @4, 157 @8) because every worker beyond the
+    core count only adds GIL/scheduler thrash — decode is CPU-bound, not
+    IO-bound. Oversubscription is never useful here."""
+    cores = os.cpu_count() or 1
+    if requested is None:
+        return min(16, cores)
+    return max(1, min(requested, cores))
+
+
 def _decode(buf: bytes, size: int) -> np.ndarray:
     from PIL import Image
 
@@ -72,11 +84,26 @@ class ImageNetLoader:
         data_path: str,
         label_map: Dict[str, int],
         limit: Optional[int] = None,
+        shard: Optional[Tuple[int, int]] = None,
     ):
         """Lazily yield (jpeg_bytes, label) in deterministic walk order —
-        the streaming source both `load` and `stream_batches` consume."""
+        the streaming source both `load` and `stream_batches` consume.
+
+        ``shard=(h, H)`` is the multi-host ingest seam (SURVEY.md §7 hard
+        part 4): host h of H walks only entries h, h+H, h+2H, ... of the
+        sorted synset list, so H hosts decode disjoint slices whose union
+        is the full dataset — the per-host analog of the reference reading
+        one S3 tar shard per Spark executor. Pair with
+        ``utils.distributed`` (process_index/process_count) on real pods.
+        """
         count = 0
-        for entry in sorted(os.listdir(data_path)):
+        entries = sorted(os.listdir(data_path))
+        if shard is not None:
+            h, num_hosts = shard
+            if not 0 <= h < num_hosts:
+                raise ValueError(f"shard index {h} not in [0, {num_hosts})")
+            entries = entries[h::num_hosts]
+        for entry in entries:
             synset = entry[:-4] if entry.endswith(".tar") else entry
             label = label_map.get(synset)
             if label is None:
@@ -108,15 +135,17 @@ class ImageNetLoader:
         data_path: str,
         label_map: Dict[str, int],
         size: int = 256,
-        workers: int = 16,
+        workers: Optional[int] = None,
         limit: Optional[int] = None,
+        shard: Optional[Tuple[int, int]] = None,
     ) -> LabeledData:
         """`data_path`: directory of `<synset>.tar` archives or of
-        `<synset>/` subdirectories of JPEGs."""
+        `<synset>/` subdirectories of JPEGs. ``shard=(h, H)``: load only
+        host h's slice of the synset list (see iter_jobs)."""
         jobs: List[Tuple[bytes, int]] = list(
-            ImageNetLoader.iter_jobs(data_path, label_map, limit)
+            ImageNetLoader.iter_jobs(data_path, label_map, limit, shard)
         )
-        with ThreadPoolExecutor(max_workers=workers) as pool:
+        with ThreadPoolExecutor(max_workers=_pool_workers(workers)) as pool:
             images = _decode_batch([b for b, _l in jobs], size, pool)
         return LabeledData(
             images.astype(config.default_dtype, copy=False),
@@ -129,7 +158,7 @@ class ImageNetLoader:
         label_map: Dict[str, int],
         total: int,
         size: int = 256,
-        workers: int = 16,
+        workers: Optional[int] = None,
     ) -> np.ndarray:
         """~total images drawn a few per synset (decoded NHWC) — the
         class-balanced fitting sample for featurizer statistics (a prefix
@@ -154,7 +183,7 @@ class ImageNetLoader:
                 bufs.append(buf)
             if len(bufs) >= total:
                 break
-        with ThreadPoolExecutor(max_workers=workers) as pool:
+        with ThreadPoolExecutor(max_workers=_pool_workers(workers)) as pool:
             return _decode_batch(bufs[:total], size, pool)
 
     @staticmethod
@@ -163,9 +192,10 @@ class ImageNetLoader:
         label_map: Dict[str, int],
         batch_size: int = 256,
         size: int = 256,
-        workers: int = 16,
+        workers: Optional[int] = None,
         limit: Optional[int] = None,
         prefetch: int = 2,
+        shard: Optional[Tuple[int, int]] = None,
     ):
         """Decode-ahead (X, y) batch stream — the ingest-featurization
         overlap path (SURVEY.md §7 hard part 4).
@@ -198,7 +228,8 @@ class ImageNetLoader:
         def produce():
             try:
                 with ThreadPoolExecutor(
-                    max_workers=workers, thread_name_prefix="keystone-decode"
+                    max_workers=_pool_workers(workers),
+                    thread_name_prefix="keystone-decode",
                 ) as pool:
                     bufs: List[bytes] = []
                     labels: List[int] = []
@@ -213,7 +244,7 @@ class ImageNetLoader:
                         return put((X, y))
 
                     for buf, label in ImageNetLoader.iter_jobs(
-                        data_path, label_map, limit
+                        data_path, label_map, limit, shard
                     ):
                         if stop.is_set():
                             return

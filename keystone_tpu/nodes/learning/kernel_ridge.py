@@ -162,6 +162,13 @@ def _pcg_fn(mesh: Mesh, axis: str, gamma: float, max_iters: int, tol: float):
         )(B)
         trace_scale = jnp.trace(BtB) / m
         G = BtB + (lam + 1e-6 * trace_scale) * jnp.eye(m, dtype=W.dtype)
+        # NOTE: tried the BCD-style explicit G⁻¹ here (one-time inverse,
+        # gemm per iteration) — it NaNs: the whitened Nyström G's top
+        # eigenvalue is ~||B||² with only a λ floor below, cond can exceed
+        # 1/eps_f32, and an explicit f32 inverse breaks PCG symmetry until
+        # CG diverges. The two-pass cho_solve is the numerically safe form;
+        # PCG's whole point is few iterations, so the per-iteration trsm
+        # cost stays bounded.
         cholG = cho_factor(G)
 
         def btr(r):

@@ -175,9 +175,16 @@ class NodeOptimizationRule(Rule):
                     # Legitimate deferrals memoize; a FAILED run must not —
                     # a transient error would otherwise disable
                     # optimize-time dispatch for this prefix forever.
-                    if pkey is not None and sample_ok:
-                        if len(self._shape_memo) > 1024:
-                            self._shape_memo.clear()
+                    # Bounded by refusing inserts when full, NOT by
+                    # clearing: a mid-apply clear would strand estimators
+                    # that _sample_prefixes skipped on a memo hit, letting
+                    # them memoize all-None shapes from a run that never
+                    # sampled their deps.
+                    if (
+                        pkey is not None
+                        and sample_ok
+                        and len(self._shape_memo) < 1024
+                    ):
                         self._shape_memo[pkey] = shapes
             if not shapes or shapes[0] is None:
                 continue

@@ -31,6 +31,13 @@ def main() -> None:
                     default=[1024, 2048, 4096, 8192])
     ap.add_argument("--dtypes", nargs="+", default=["f32", "bf16"])
     ap.add_argument("--timeout", type=float, default=900.0)
+    ap.add_argument(
+        "--scale",
+        choices=["auto", "tpu", "tpu-xl"],
+        default="auto",
+        help="auto = tpu when live / cpu fallback; tpu-xl = the "
+        "reference-scale d=262144 config (live TPU only)",
+    )
     args = ap.parse_args()
 
     from keystone_tpu.utils.platform import cpu_mesh_env, probe_backend
@@ -40,7 +47,14 @@ def main() -> None:
         return info is not None and info.get("platform") != "cpu"
 
     live_tpu = probe_live_tpu()
-    scale_key = "tpu" if live_tpu else "cpu"
+    if args.scale == "auto":
+        scale_key = "tpu" if live_tpu else "cpu"
+    elif args.scale == "tpu-xl" and not live_tpu:
+        print("tpu-xl scale needs a live TPU; falling back to cpu scale",
+              file=sys.stderr)
+        scale_key = "cpu"
+    else:
+        scale_key = args.scale
     base_env = dict(os.environ) if live_tpu else cpu_mesh_env(8)
 
     rows = []
