@@ -142,6 +142,12 @@ def worker(scale_key: str, dtype: str) -> None:
 
     n_dev = len(jax.devices())
     backend = jax.default_backend()
+    # HBM high-water (TPU runtimes report it; CPU returns None) — the
+    # donation/aliasing evidence channel (SURVEY.md §5 sanitizer row).
+    try:
+        mem = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        mem = {}
     tflops_per_chip = bcd_flops(n, d, k, block, iters) / dt / 1e12 / n_dev
     peak = PLAUSIBLE_PEAK_TFLOPS["bf16" if dtype == "bf16" else "f32"]
     line = {
@@ -160,6 +166,7 @@ def worker(scale_key: str, dtype: str) -> None:
             "seconds_per_solve": round(dt, 4),
             "relative_residual": round(resid, 6),
             "devices": n_dev,
+            "peak_hbm_bytes": mem.get("peak_bytes_in_use"),
         },
     }
     if backend != "cpu" and tflops_per_chip > peak:
@@ -241,9 +248,12 @@ def main() -> None:
         elif info is None:
             error = "backend_init_dead_or_hung"
 
-    # CPU-mesh fallback: a real measurement, honestly labelled.
+    # CPU-mesh fallback: a real measurement, honestly labelled. TPU-sized
+    # scales degrade to the cpu scale — a d=262144 solve on the emulated
+    # mesh would only hit the run-timeout, not produce a number.
     env = cpu_mesh_env(8)
-    result = _run_worker(env, args.scale or "cpu", args.dtype, args.run_timeout)
+    fb_scale = "cpu" if (args.scale or "").startswith("tpu") else (args.scale or "cpu")
+    result = _run_worker(env, fb_scale, args.dtype, args.run_timeout)
     if result is not None:
         if error:
             result["backend_error"] = error

@@ -61,9 +61,18 @@ def _ring_solve_fn(mesh: Mesh, model_axis: str, data_axis, precision):
         d_loc = a_loc.shape[1]
         kc = b_chunk.shape[1]
         gram = maybe_psum(solver_matmul(a_loc.T, a_loc, precision))
-        chol = jnp.linalg.cholesky(
-            gram + lam * jnp.eye(d_loc, dtype=gram.dtype)
-        )
+        # Explicit ridge inverse ONCE per chip, outside the ring loop: the
+        # per-step solve becomes one MXU gemm instead of a sequential
+        # triangular solve (same rework + self-correction argument as
+        # bcd._local_gram_inv). The trace-scaled jitter floors cond even
+        # at the lam=0.0 default — an explicit f32 inverse of a singular
+        # gram would otherwise poison every ring step (the kernel_ridge
+        # NOTE's divergence mode); the shift it introduces is ~1e-6
+        # relative, inside solver tolerance.
+        eye = jnp.eye(d_loc, dtype=gram.dtype)
+        jitter = 1e-6 * (jnp.trace(gram) / d_loc)
+        chol = jnp.linalg.cholesky(gram + (lam + jitter) * eye)
+        inv = cho_solve((chol, True), eye)
         idx = lax.axis_index(model_axis)
         # Solver state in the accumulation dtype even when A stores bf16.
         w0 = jnp.zeros((d_loc, nshards * kc), dtype=b_chunk.dtype)
@@ -75,7 +84,7 @@ def _ring_solve_fn(mesh: Mesh, model_axis: str, data_axis, precision):
             w_old = lax.dynamic_slice(w, (0, j * kc), (d_loc, kc))
             r_plus = r + solver_matmul(a_loc, w_old, precision)
             rhs = maybe_psum(solver_matmul(a_loc.T, r_plus, precision))
-            w_new = cho_solve((chol, True), rhs)
+            w_new = solver_matmul(inv, rhs, precision)
             r_new = r_plus - solver_matmul(a_loc, w_new, precision)
             w = lax.dynamic_update_slice(w, w_new, (0, j * kc))
             r_next = lax.ppermute(

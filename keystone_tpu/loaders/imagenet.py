@@ -21,16 +21,7 @@ from keystone_tpu.config import config
 from keystone_tpu.loaders.labeled_data import LabeledData
 
 
-def _pool_workers(requested: Optional[int]) -> int:
-    """Decode-pool size, capped at the host's core count. Measured on a
-    1-core host (NOTES_r2 §8): PIL decode throughput was NON-monotone in
-    worker count (343 img/s @4, 157 @8) because every worker beyond the
-    core count only adds GIL/scheduler thrash — decode is CPU-bound, not
-    IO-bound. Oversubscription is never useful here."""
-    cores = os.cpu_count() or 1
-    if requested is None:
-        return min(16, cores)
-    return max(1, min(requested, cores))
+from keystone_tpu.loaders.labeled_data import decode_pool_workers as _pool_workers
 
 
 def _decode(buf: bytes, size: int) -> np.ndarray:

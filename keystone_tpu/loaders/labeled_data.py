@@ -5,8 +5,9 @@ Ref: src/main/scala/loaders/LabeledData.scala [unverified].
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
-from typing import Any
+from typing import Any, Optional
 
 
 @dataclass
@@ -17,3 +18,16 @@ class LabeledData:
     def __iter__(self):
         yield self.data
         yield self.labels
+
+
+def decode_pool_workers(requested: Optional[int]) -> int:
+    """Decode-pool size, capped at the host's core count — shared by every
+    image loader. Measured on a 1-core host (NOTES_r2 §8): PIL decode
+    throughput was NON-monotone in worker count (343 img/s @4, 157 @8)
+    because every worker beyond the core count only adds GIL/scheduler
+    thrash — decode is CPU-bound, not IO-bound. Oversubscription is never
+    useful here."""
+    cores = os.cpu_count() or 1
+    if requested is None:
+        return min(16, cores)
+    return max(1, min(requested, cores))

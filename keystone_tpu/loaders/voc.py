@@ -44,6 +44,9 @@ class VOCLoader:
         classes: Sequence[str] = tuple(VOC_CLASSES),
     ) -> LabeledData:
         """Returns LabeledData(NHWC images, (n, C) binary multilabels)."""
+        from keystone_tpu.loaders.labeled_data import decode_pool_workers
+
+        workers = decode_pool_workers(workers)
         index = {c: i for i, c in enumerate(classes)}
         names = sorted(
             f[:-4] for f in os.listdir(annotation_dir) if f.endswith(".xml")

@@ -16,10 +16,15 @@ from typing import Optional
 
 def force_cpu() -> None:
     """Pin this process to the CPU platform. config.update (not env) because
-    the sitecustomize-registered TPU plugin ignores JAX_PLATFORMS."""
+    the sitecustomize-registered TPU plugin ignores JAX_PLATFORMS, and the
+    backend is MATERIALIZED immediately: left lazy, the axon get_backend
+    wrapper can still initialize the TPU plugin at the first jit lowering —
+    a minutes-long hang when the chip is dead (the conftest does the same
+    devices() touch for the same reason)."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    jax.devices()
 
 
 def env_forces_cpu() -> bool:

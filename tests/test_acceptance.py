@@ -1,0 +1,50 @@
+"""The acceptance harness itself stays verified (VERDICT r2 #5): synthetic
+mode runs real pipelines against the CI floors and returns rc=0; a missing
+data root SKIPs rather than failing."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import acceptance  # noqa: E402
+
+
+def test_synthetic_subset_passes(capsys):
+    rc = acceptance.main(
+        ["--synthetic", "--pipelines", "MnistRandomFFT", "NewsgroupsPipeline"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out.count("PASS") == 2 and "FAIL" not in out
+
+
+def test_missing_data_skips(tmp_path, capsys):
+    rc = acceptance.main(
+        [str(tmp_path), "--pipelines", "MnistRandomFFT", "AmazonReviewsPipeline"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0  # skips are not failures
+    assert out.count("SKIP") == 2
+
+
+def test_real_data_path_runs_from_fixtures(capsys):
+    """Point the harness at the committed loader fixtures: tiny but REAL
+    newsgroups data exercises the real-data code path end-to-end (train
+    and test splits are the same fixture tree — harness plumbing, not a
+    quality claim)."""
+    import shutil
+    import tempfile
+
+    root = tempfile.mkdtemp()
+    src = os.path.join(REPO, "tests", "fixtures", "data", "newsgroups", "train")
+    os.makedirs(os.path.join(root, "newsgroups"))
+    shutil.copytree(src, os.path.join(root, "newsgroups", "train"))
+    shutil.copytree(src, os.path.join(root, "newsgroups", "test"))
+    rc = acceptance.main([root, "--pipelines", "NewsgroupsPipeline"])
+    out = capsys.readouterr().out
+    # 4 docs train=test: the pipeline must run end-to-end; the verdict line
+    # must carry a real value (tiny data may or may not clear the floor).
+    assert "NewsgroupsPipeline" in out and "SKIP" not in out
+    assert rc in (0, 1)

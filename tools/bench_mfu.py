@@ -72,8 +72,9 @@ def main() -> None:
                     {"block": block, "dtype": dtype, "error": "run failed"}
                 ))
                 # A mid-sweep TPU death would otherwise cost one full
-                # timeout per remaining config — re-probe and degrade.
-                if scale_key == "tpu" and not probe_live_tpu():
+                # timeout per remaining config (tpu AND tpu-xl scales) —
+                # re-probe and degrade.
+                if scale_key != "cpu" and not probe_live_tpu():
                     print("TPU died mid-sweep; falling back to the CPU "
                           "scale for the rest", file=sys.stderr)
                     scale_key = "cpu"

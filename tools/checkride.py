@@ -43,6 +43,7 @@ import bench  # repo-root bench.py: worker protocol, scales, plausible peaks
 STEPS = (
     "bench_f32",
     "bench_bf16",
+    "bench_xl",
     "mfu_sweep",
     "pallas_fv",
     "streamed_overlap",
@@ -160,7 +161,23 @@ def run_bench_step(step: str, target: str, quick: bool, timeout: float) -> dict:
     make every live-TPU bench fail with 'TPU already in use'."""
     dtype = "bf16" if step.endswith("bf16") else "f32"
     env = _step_env(target, quick)
-    r = bench._run_worker(env, _bench_scale_for(target, quick), dtype, timeout)
+    if step == "bench_xl":
+        # Reference-scale d=262144 (SURVEY.md §6 TIMIT/CIFAR dims). Only
+        # meaningful on a live chip at full scale; --quick keeps the quick
+        # harness-validation scale even on TPU (a multi-minute XL solve
+        # would burn the short live window quick mode protects), and the
+        # chip-down path skips outright — its config would duplicate
+        # bench_f32 byte for byte.
+        if target != "tpu":
+            return {
+                "ok": True,
+                "backend": target,
+                "skipped": "off-tpu: would duplicate bench_f32's config",
+            }
+        scale = "tpu-xl" if not quick else _bench_scale_for(target, quick)
+    else:
+        scale = _bench_scale_for(target, quick)
+    r = bench._run_worker(env, scale, dtype, timeout)
     if r is None or r.get("value") is None:
         return {"ok": False, "backend": target, "error": "bench worker failed"}
     peak = bench.PLAUSIBLE_PEAK_TFLOPS["bf16" if dtype == "bf16" else "f32"]
@@ -337,7 +354,7 @@ def orchestrate(args) -> int:
         forced = _forced_failure(step)
         if forced is not None:
             result = dict(forced, backend=target)
-        elif step in ("bench_f32", "bench_bf16"):
+        elif step in ("bench_f32", "bench_bf16", "bench_xl"):
             result = run_bench_step(step, target, args.quick, args.step_timeout)
         elif step == "mfu_sweep":
             result = run_mfu_sweep(
