@@ -40,14 +40,19 @@ sys.path.insert(0, REPO)
 
 import bench  # repo-root bench.py: worker protocol, scales, plausible peaks
 
+# Ordered by evidence value per live-chip minute, fragile-first: pallas_fv
+# (the one class never captured on silicon) right after the headline bench;
+# bench_xl LAST among measurements — its 2 GiB operands have preceded two
+# relay deaths (r3: the ride died on the first step after it), so it must
+# not sit in front of unique evidence.
 STEPS = (
     "bench_f32",
-    "bench_bf16",
-    "bench_xl",
-    "mfu_sweep",
     "pallas_fv",
+    "bench_bf16",
+    "mfu_sweep",
     "streamed_overlap",
     "memory_stats",
+    "bench_xl",
     "entry_compile",
 )
 
@@ -234,7 +239,10 @@ def run_mfu_sweep(
             # A block that clamps to an already-measured effective block
             # would re-measure the same config; skip via the worker's
             # clamp rule (largest divisor of d that is <= block).
-            r = bench._run_worker(env, scale, dtype, timeout)
+            # Cap each ROW well below the step timeout: a healthy row takes
+            # <5 min, and the r3 ride burned 40 min of a dying relay's last
+            # window on one wedged row before the death probe could fire.
+            r = bench._run_worker(env, scale, dtype, min(timeout, 900.0))
             if r is None or r.get("value") is None:
                 rows.append({"block": block, "dtype": dtype, "error": "failed"})
                 # Mid-sweep death: re-probe once and stop burning timeouts.
@@ -283,13 +291,20 @@ def run_mfu_sweep(
             )
     ok_rows = [r for r in rows if "error" not in r]
     best = max(ok_rows, key=lambda r: r["tflops_per_chip"], default=None)
-    return {
+    result = {
         "ok": bool(ok_rows),
         "backend": backend,
         "scale": scale,
         "rows": rows,
         "best": best,
     }
+    if len(ok_rows) < len(rows):
+        # A row timed out on a LIVE chip (the per-row cap above exists to
+        # trigger exactly this). Without this marker the step would be
+        # finalized as done-on-TPU and the lost row never retried; the
+        # resume filter already drops error rows, so a re-run retries them.
+        result["partial"] = True
+    return result
 
 
 def _run_step(step: str, target: str, quick: bool, timeout: float):

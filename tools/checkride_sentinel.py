@@ -1,0 +1,80 @@
+"""Sentinel loop: relaunch the resumable checkride whenever the chip returns.
+
+The axon relay has died mid-session in all three rounds, and each live
+window arrives unannounced. This loop probes the TPU on a fixed cadence
+(short-timeout subprocess, no backend state left behind) and, the moment a
+probe succeeds, runs `tools/checkride.py` — which resumes from the state
+dir, keeps every checkpointed TPU row, and re-runs only the steps whose
+stored result is a CPU fallback. Exits when TPU_REPORT.json reaches
+``complete_on_tpu`` (or after --max-hours).
+
+Usage: nohup python tools/checkride_sentinel.py >> sentinel.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _report_complete(report_path: str) -> bool:
+    try:
+        with open(report_path) as f:
+            return bool(json.load(f).get("complete_on_tpu"))
+    except (OSError, ValueError):
+        return False
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=1500.0,
+                    help="seconds between probes (default 25 min)")
+    ap.add_argument("--probe-timeout", type=float, default=120.0)
+    ap.add_argument("--max-hours", type=float, default=10.0)
+    ap.add_argument("--report", default=os.path.join(REPO, "TPU_REPORT.json"))
+    args = ap.parse_args()
+
+    deadline = time.time() + args.max_hours * 3600.0
+    while time.time() < deadline:
+        if _report_complete(args.report):
+            print("sentinel: report complete_on_tpu; done", flush=True)
+            return
+        from keystone_tpu.utils.platform import probe_backend
+
+        info = probe_backend(timeout=args.probe_timeout)
+        print(f"sentinel: probe={info}", flush=True)
+        if info is not None and info.get("platform") == "tpu":
+            remaining = deadline - time.time()
+            if remaining < 300.0:
+                break  # not enough window left to do useful ride work
+            # Live window — spend it on the ride, not on sleeping. Bound by
+            # the remaining budget; a killed ride keeps checkpointed steps.
+            try:
+                rc = subprocess.call(
+                    [
+                        sys.executable,
+                        os.path.join(REPO, "tools", "checkride.py"),
+                        "--report",
+                        args.report,
+                    ],
+                    timeout=remaining,
+                )
+            except subprocess.TimeoutExpired:
+                rc = "timeout"
+            print(f"sentinel: checkride rc={rc}", flush=True)
+            if _report_complete(args.report):
+                print("sentinel: report complete_on_tpu; done", flush=True)
+                return
+        time.sleep(args.interval)
+    print("sentinel: max-hours reached", flush=True)
+
+
+if __name__ == "__main__":
+    main()
