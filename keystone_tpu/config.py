@@ -54,6 +54,16 @@ class Config:
     shard_data_batches: bool = True
     # Minimum rows before sharding is worth the placement overhead.
     shard_min_rows: int = 64
+    # Feature blocks whose gram ridge inverses are factorized together in
+    # ONE batched XLA program (batched Cholesky + triangular solves over a
+    # leading block axis). TPU lowers a single b×b factorization to a
+    # sequential panel loop; batching amortizes that loop across blocks —
+    # the dominant cost of many-block solves (d ≫ block). Transient memory
+    # per batched call: factor_batch · b² · 4B on top of the inverse cache.
+    # None = auto: 16 on accelerators; per-block (fused gram+factor) on CPU,
+    # where batched decompositions measured 2.3× SLOWER than independent
+    # per-block programs. An explicit int forces that chunk on any backend.
+    factor_batch: int | None = None
     # Whole-pipeline auto-caching (profile a sample run, persist the best
     # time-saved-per-byte intermediates under a budget). Opt-in: profiling
     # costs a sample execution per optimization.

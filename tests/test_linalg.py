@@ -179,6 +179,29 @@ def test_bcd_cached_grams_matches_uncached(rng):
     )
 
 
+def test_bcd_batched_factor_ragged_and_chunked(rng):
+    """Batched factor phase: ragged tail block + factor_batch smaller than
+    the block count must still match the uncached solve digit-for-digit."""
+    from keystone_tpu.config import config
+
+    A, B, _ = _problem(rng, d=26)  # blocks of 8 -> 3 equal + ragged 2-wide
+    Ma, Mb = RowMatrix.from_array(A), RowMatrix.from_array(B)
+    old = config.factor_batch
+    config.factor_batch = 2  # forces two batched chunks + tail path
+    try:
+        W_c, _ = block_coordinate_descent(
+            Ma, Mb, block_size=8, num_iters=4, lam=0.2, cache_grams=True
+        )
+    finally:
+        config.factor_batch = old
+    W_p, _ = block_coordinate_descent(
+        Ma, Mb, block_size=8, num_iters=4, lam=0.2, cache_grams=False
+    )
+    np.testing.assert_allclose(
+        assemble_blocks(W_c), assemble_blocks(W_p), rtol=1e-4, atol=1e-4
+    )
+
+
 def test_bcd_cached_grams_weighted(rng):
     A, B, _ = _problem(rng)
     w = rng.uniform(0.5, 2.0, size=A.shape[0]).astype(np.float32)
