@@ -52,6 +52,7 @@ STEPS = (
     "mfu_sweep",
     "streamed_overlap",
     "memory_stats",
+    "acceptance_synthetic",
     "bench_xl",
     "entry_compile",
 )
@@ -307,6 +308,63 @@ def run_mfu_sweep(
     return result
 
 
+def run_acceptance_step(
+    step: str, target: str, quick: bool, timeout: float
+) -> dict:
+    """All canonical pipelines end-to-end (`tools/acceptance.py --synthetic`)
+    — on TPU this is the silicon wall-time + quality-floor evidence for the
+    whole pipeline layer, not just the solver inner loop (SURVEY.md §2.11 /
+    §7 stage-2 acceptance).
+
+    Orchestrator-side like the bench steps: acceptance.py is the DIRECT
+    child and the only process that initializes a backend (a backend-holding
+    middleman would break the live-TPU single-owner rule), and a timeout
+    kill reaches it rather than orphaning a grandchild on the chip."""
+    env = _step_env(target, quick)
+    cmd = [
+        sys.executable,
+        os.path.join(REPO, "tools", "acceptance.py"),
+        "--synthetic",
+        "--json",
+    ]
+    if quick:
+        # Protect a minutes-long unattended window: two representative
+        # pipelines (dense FFT front end + conv/solver vertical), not all.
+        cmd += ["--pipelines", "MnistRandomFFT", "RandomPatchCifar"]
+    try:
+        proc = subprocess.run(
+            cmd, env=env, capture_output=True, text=True, timeout=timeout
+        )
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "backend": target, "error": f"timeout>{timeout}s"}
+    except OSError as e:
+        return {"ok": False, "backend": target, "error": f"launch: {e}"}
+    rows = []
+    for line in proc.stdout.splitlines():
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict) and "pipeline" in parsed:
+            rows.append(parsed)
+    # The rows report the backend the child ACTUALLY ran on; a silent CPU
+    # fallback under a TPU target must not be saved as TPU evidence (it
+    # would flip complete_on_tpu on fake silicon numbers).
+    seen = {r.get("backend") for r in rows if r.get("backend")}
+    backend = seen.pop() if len(seen) == 1 else ("mixed" if seen else target)
+    result = {
+        "ok": proc.returncode == 0 and bool(rows) and backend == target,
+        "backend": backend,
+        "pipelines_passed": sum(1 for r in rows if r.get("ok")),
+        "pipelines_total": len(rows),
+        "rows": rows,
+        "rc": proc.returncode,
+    }
+    if not result["ok"]:
+        result["stderr_tail"] = (proc.stderr or "")[-1500:]
+    return result
+
+
 def _run_step(step: str, target: str, quick: bool, timeout: float):
     """Run one step in a subprocess; return its parsed JSON dict or an
     error record. The subprocess boundary is what makes a hung backend
@@ -374,6 +432,10 @@ def orchestrate(args) -> int:
         elif step == "mfu_sweep":
             result = run_mfu_sweep(
                 step, target, args.quick, args.step_timeout, state_dir
+            )
+        elif step == "acceptance_synthetic":
+            result = run_acceptance_step(
+                step, target, args.quick, args.step_timeout
             )
         else:
             result = _run_step(step, target, args.quick, args.step_timeout)
