@@ -258,9 +258,10 @@ def main(argv=None) -> int:
             out = runner(root)
         except Exception as e:  # a crash is a FAIL, not an abort
             err = f"{type(e).__name__}: {e}"
-            rows.append((name, key, None, floor, "ERROR", 0.0, err))
+            dt = time.time() - t0
+            rows.append((name, key, None, floor, "ERROR", dt, err))
             failures += 1
-            emit(name, key, None, floor, "ERROR", time.time() - t0, err)
+            emit(name, key, None, floor, "ERROR", dt, err)
             continue
         dt = time.time() - t0
         if out is None:
