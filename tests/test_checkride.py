@@ -39,7 +39,8 @@ def _run(tmp_path, steps, timeout=420):
 
 @pytest.mark.slow
 def test_checkride_cpu_dryrun_and_resume(tmp_path):
-    steps = ["streamed_overlap", "memory_stats"]
+    steps = ["streamed_overlap", "memory_stats", "featurize",
+             "factor_primitives", "acceptance_synthetic"]
     proc = _run(tmp_path, steps)
     assert proc.returncode == 0, proc.stderr[-2000:]
     report = json.loads((tmp_path / "report.json").read_text())
@@ -51,7 +52,7 @@ def test_checkride_cpu_dryrun_and_resume(tmp_path):
     for s in steps:
         assert (tmp_path / "state" / f"step_{s}.json").exists()
 
-    # Resume: both steps skip (stderr says so, and it's fast because no
+    # Resume: every step skips (stderr says so, and it's fast because no
     # subprocess backend init happens for skipped steps).
     proc2 = _run(tmp_path, steps, timeout=120)
     assert proc2.returncode == 0, proc2.stderr[-2000:]
@@ -114,6 +115,45 @@ def test_checkride_keeps_tpu_ok_priors(tmp_path):
     report = json.loads((tmp_path / "report.json").read_text())
     assert report["steps"]["streamed_overlap"]["backend"] == "tpu"
     assert report["tpu_evidence_steps"] == ["streamed_overlap"]
+
+
+@pytest.mark.slow
+def test_quick_scale_prior_satisfies_quick_but_not_full_evidence(tmp_path):
+    """A --quick TPU result must satisfy a quick re-run, never count as
+    full-scale TPU evidence in the report, and never block a full ride."""
+    checkride = _sweep_module()
+    state = tmp_path / "state"
+    state.mkdir()
+    (state / "step_streamed_overlap.json").write_text(
+        json.dumps({"ok": True, "backend": "tpu", "quick_scale": True,
+                    "step": "streamed_overlap"})
+    )
+    report_path = tmp_path / "report.json"
+    checkride._write_report(str(state), str(report_path), {})
+    report = json.loads(report_path.read_text())
+    assert report["tpu_evidence_steps"] == []  # toy scale is not evidence
+    assert report["complete_on_tpu"] is False
+
+    proc = _run(tmp_path, ["streamed_overlap"])  # --quick run: skip is fine
+    assert proc.returncode == 0
+    assert "skip streamed_overlap (done on tpu)" in proc.stderr
+
+    # The central claim: a FULL (non --quick) ride must NOT be blocked by
+    # the toy-scale prior — it re-runs the step at full scale.
+    proc_full = subprocess.run(
+        [
+            sys.executable, CKR,
+            "--state-dir", str(state),
+            "--report", str(report_path),
+            "--probe-timeout", "3",
+            "--steps", "streamed_overlap",
+        ],
+        capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert proc_full.returncode == 0, proc_full.stderr[-2000:]
+    assert "run streamed_overlap" in proc_full.stderr
+    saved = json.loads((state / "step_streamed_overlap.json").read_text())
+    assert not saved.get("quick_scale")
 
 
 def _sweep_module():
