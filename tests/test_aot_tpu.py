@@ -106,6 +106,26 @@ def test_bcd_streamed_first_and_cached_updates_compile_for_v5e(mesh):
     assert _compiled_ok(c2)
 
 
+def test_batched_factor_phase_compiles_for_v5e(mesh):
+    """The batched factor phase (gram-only + batched Cholesky/trsm over a
+    leading block axis) must XLA:TPU-compile — it is the accelerator
+    default for multi-block cached solves."""
+    from keystone_tpu.linalg.bcd import _batched_ridge_inv_fn, _gram_only_fn
+    from keystone_tpu.linalg.row_matrix import _precision
+
+    n, b, g = 1024, 128, 16
+    gram_only = _gram_only_fn(mesh, AXIS, _precision(), False)
+    c1 = gram_only.lower(
+        _sds((n, b), mesh, P(AXIS)),
+        _sds((), mesh, P()),
+        _sds((n,), mesh, P(AXIS)),
+    ).compile()
+    assert _compiled_ok(c1)
+    batched = _batched_ridge_inv_fn(mesh)
+    c2 = batched.lower(_sds((g, b, b), mesh, P())).compile()
+    assert _compiled_ok(c2)
+
+
 def test_ring_bcd_step_compiles_for_v5e(mesh):
     """The mp ring: ppermute over the model axis must lower to a TPU
     collective-permute inside a while loop."""

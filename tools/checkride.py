@@ -40,21 +40,23 @@ sys.path.insert(0, REPO)
 
 import bench  # repo-root bench.py: worker protocol, scales, plausible peaks
 
-# Ordered by evidence value per live-chip minute, fragile-first: pallas_fv
-# (the one class never captured on silicon) right after the headline bench;
-# bench_xl LAST among measurements — its 2 GiB operands have preceded two
-# relay deaths (r3: the ride died on the first step after it), so it must
-# not sit in front of unique evidence.
+# Ordered by evidence value per live-chip minute: one step of every CLASS
+# before more rows of an already-captured class (a ~40 min window should
+# yield maximal evidence diversity) — pallas_fv (never yet captured on
+# silicon) right after the headline bench, the multi-row sweep after every
+# unique class, and bench_xl LAST among measurements: its 2 GiB operands
+# have preceded two relay deaths (r3: the ride died on the first step
+# after it), so it must not sit in front of unique evidence.
 STEPS = (
     "bench_f32",
     "pallas_fv",
     "bench_bf16",
-    "mfu_sweep",
     "streamed_overlap",
     "memory_stats",
     "featurize",
     "factor_primitives",
     "acceptance_synthetic",
+    "mfu_sweep",
     "bench_xl",
     "entry_compile",
 )
