@@ -31,6 +31,8 @@ import time
 
 import numpy as np
 
+REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+
 BASELINE_NODE_TFLOPS = 0.3
 # v5e peak: ~197 bf16 / ~99 f32 TFLOPS per chip. Anything measured above
 # this is a transport lie, not a fast program.
@@ -208,6 +210,61 @@ def _run_worker(env: dict, scale_key: str, dtype: str, timeout: float):
     return None
 
 
+def _checkride_checkpoint(scale_key: str, dtype: str):
+    """Checkpointed live-chip bench line for this scale+dtype, if the
+    resumable checkride (tools/checkride.py) captured one earlier.
+
+    The relay dies for whole sessions: when the driver's end-of-round bench
+    lands on a dead chip, the round's REAL silicon measurement may already
+    sit in .checkride/. Serving it — provenance-tagged, config-matched, and
+    only after the live attempt failed — beats reporting a CPU number for a
+    round that did produce TPU evidence."""
+    step = {"tpu-xl": "bench_xl"}.get(
+        scale_key, {"f32": "bench_f32", "bf16": "bench_bf16"}.get(dtype)
+    )
+    if step is None:
+        return None
+    path = os.path.join(REPO_DIR, ".checkride", f"step_{step}.json")
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        mtime = os.path.getmtime(path)
+        age_h = (time.time() - mtime) / 3600.0
+        # A checkpoint can outlive its round (the state dir is committed
+        # for resume): past this age it is some PREVIOUS round's silicon,
+        # not a substitute for this one's.
+        if age_h > 36.0:
+            return None
+        line = rec.get("bench_line")
+        if not (
+            rec.get("backend") == "tpu"
+            and rec.get("ok")
+            and not rec.get("quick_scale")
+            and isinstance(line, dict)
+        ):
+            return None
+        det = line.get("detail") or {}
+        cfg = SCALE[scale_key]
+        # The checkpoint must describe the CURRENT benchmark config — a
+        # stale file from an older scale definition is not this config's
+        # number (epochs shift the once-vs-per-epoch FLOP split).
+        if det.get("dtype") != dtype or any(
+            det.get(key) != cfg[key] for key in ("n", "d", "k", "block")
+        ) or det.get("epochs") != cfg["iters"]:
+            return None
+        line = dict(line)
+    except (OSError, ValueError, AttributeError, TypeError, KeyError):
+        # Malformed/legacy state must degrade to the CPU fallback, never
+        # break the one-JSON-line contract.
+        return None
+    line["source"] = "checkride_checkpoint"
+    line["measured_at"] = time.strftime(
+        "%Y-%m-%dT%H:%M:%S", time.localtime(mtime)
+    )
+    line["age_hours"] = round(age_h, 1)
+    return line
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--worker", action="store_true")
@@ -247,6 +304,18 @@ def main() -> None:
             error = "tpu_run_failed_or_hung"
         elif info is None:
             error = "backend_init_dead_or_hung"
+        else:
+            # Probe came back alive but CPU-only: in this environment that
+            # means the TPU plugin degraded, not that no TPU exists.
+            error = "backend_reports_cpu_only"
+        if error is not None:
+            # Dead/hung chip, but the checkride may have measured this very
+            # config on silicon earlier in the round.
+            ckpt = _checkride_checkpoint(args.scale or "tpu", args.dtype)
+            if ckpt is not None:
+                ckpt["backend_error"] = error
+                print(json.dumps(ckpt))
+                return
 
     # CPU-mesh fallback: a real measurement, honestly labelled. TPU-sized
     # scales degrade to the cpu scale — a d=262144 solve on the emulated

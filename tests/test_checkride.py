@@ -165,6 +165,73 @@ def _sweep_module():
     return importlib.reload(checkride)
 
 
+def test_bench_serves_checkride_checkpoint_only_when_config_matches(
+    tmp_path, monkeypatch
+):
+    """bench.py's dead-chip fallback may serve a checkpointed live-chip
+    line ONLY for the current config: stale scales, quick-scale toys, and
+    CPU-tagged records must all be rejected (they would fake a round
+    number)."""
+    sys.path.insert(0, REPO)
+    import importlib
+
+    import bench
+
+    bench = importlib.reload(bench)
+    monkeypatch.setattr(bench, "REPO_DIR", str(tmp_path))
+    state = tmp_path / ".checkride"
+    state.mkdir()
+    cfg = bench.SCALE["tpu"]
+    good_line = {
+        "metric": "bcd_solver_tflops_per_chip",
+        "value": 7.0,
+        "detail": {"n": cfg["n"], "d": cfg["d"], "k": cfg["k"],
+                   "block": cfg["block"], "epochs": cfg["iters"],
+                   "dtype": "f32"},
+    }
+    rec = {"ok": True, "backend": "tpu", "bench_line": good_line}
+    p = state / "step_bench_f32.json"
+
+    p.write_text(json.dumps(rec))
+    out = bench._checkride_checkpoint("tpu", "f32")
+    assert out is not None and out["source"] == "checkride_checkpoint"
+    assert out["value"] == 7.0 and "measured_at" in out
+
+    # Wrong dtype request → no serve.
+    assert bench._checkride_checkpoint("tpu", "bf16") is None
+    # Stale config (block from an older scale definition) → no serve.
+    stale = json.loads(json.dumps(rec))
+    stale["bench_line"]["detail"]["block"] = cfg["block"] // 2
+    p.write_text(json.dumps(stale))
+    assert bench._checkride_checkpoint("tpu", "f32") is None
+    # Toy-scale (--quick) record → no serve.
+    quick = json.loads(json.dumps(rec))
+    quick["quick_scale"] = True
+    p.write_text(json.dumps(quick))
+    assert bench._checkride_checkpoint("tpu", "f32") is None
+    # CPU-tagged record → no serve.
+    cpu = json.loads(json.dumps(rec))
+    cpu["backend"] = "cpu"
+    p.write_text(json.dumps(cpu))
+    assert bench._checkride_checkpoint("tpu", "f32") is None
+    # Different epoch count (FLOP split changes) → no serve.
+    ep = json.loads(json.dumps(rec))
+    ep["bench_line"]["detail"]["epochs"] = cfg["iters"] + 1
+    p.write_text(json.dumps(ep))
+    assert bench._checkride_checkpoint("tpu", "f32") is None
+    # Previous-round checkpoint (too old) → no serve.
+    p.write_text(json.dumps(rec))
+    old = bench.time.time() - 48 * 3600
+    os.utime(p, (old, old))
+    assert bench._checkride_checkpoint("tpu", "f32") is None
+    # Malformed state (JSON array) degrades silently, never raises.
+    p.write_text("[1, 2, 3]")
+    assert bench._checkride_checkpoint("tpu", "f32") is None
+    p.write_text(json.dumps({"ok": True, "backend": "tpu",
+                             "bench_line": {"detail": None}}))
+    assert bench._checkride_checkpoint("tpu", "f32") is None
+
+
 def test_mid_sweep_tpu_death_sets_degrade_flag(tmp_path, monkeypatch):
     """A chip death mid-sweep with completed rows returns ok=True (the rows
     are evidence) but must carry tpu_dead so the orchestrator degrades the
