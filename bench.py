@@ -228,7 +228,10 @@ def _checkride_checkpoint(scale_key: str, dtype: str):
     try:
         with open(path) as f:
             rec = json.load(f)
-        mtime = os.path.getmtime(path)
+        # In-record wall-clock stamp only: the state dir is committed, so
+        # file mtime is checkout time on a fresh clone — trusting it would
+        # re-date a previous round's silicon. No stamp = no serve.
+        mtime = float(rec["saved_at"])
         age_h = (time.time() - mtime) / 3600.0
         # A checkpoint can outlive its round (the state dir is committed
         # for resume): past this age it is some PREVIOUS round's silicon,

@@ -189,7 +189,8 @@ def test_bench_serves_checkride_checkpoint_only_when_config_matches(
                    "block": cfg["block"], "epochs": cfg["iters"],
                    "dtype": "f32"},
     }
-    rec = {"ok": True, "backend": "tpu", "bench_line": good_line}
+    rec = {"ok": True, "backend": "tpu", "bench_line": good_line,
+           "saved_at": bench.time.time()}
     p = state / "step_bench_f32.json"
 
     p.write_text(json.dumps(rec))
@@ -219,10 +220,16 @@ def test_bench_serves_checkride_checkpoint_only_when_config_matches(
     ep["bench_line"]["detail"]["epochs"] = cfg["iters"] + 1
     p.write_text(json.dumps(ep))
     assert bench._checkride_checkpoint("tpu", "f32") is None
-    # Previous-round checkpoint (too old) → no serve.
-    p.write_text(json.dumps(rec))
-    old = bench.time.time() - 48 * 3600
-    os.utime(p, (old, old))
+    # Previous-round checkpoint (too old) → no serve. The stamp lives IN
+    # the record: mtime is checkout time on a fresh clone and is ignored.
+    aged = json.loads(json.dumps(rec))
+    aged["saved_at"] = bench.time.time() - 48 * 3600
+    p.write_text(json.dumps(aged))
+    assert bench._checkride_checkpoint("tpu", "f32") is None
+    # Unstamped legacy record (mtime would look fresh) → no serve.
+    unstamped = json.loads(json.dumps(rec))
+    del unstamped["saved_at"]
+    p.write_text(json.dumps(unstamped))
     assert bench._checkride_checkpoint("tpu", "f32") is None
     # Malformed state (JSON array) degrades silently, never raises.
     p.write_text("[1, 2, 3]")

@@ -56,3 +56,33 @@ def test_multiclass_evaluator():
     np.testing.assert_allclose(m.total_accuracy, 4 / 6)
     np.testing.assert_allclose(m.per_class_accuracy, [0.5, 1.0, 2 / 3])
     assert 0.0 < m.macro_f1 <= 1.0
+
+
+def test_auto_block_size_resolution_and_fit(rng, monkeypatch):
+    """block_size="auto" picks a single exact block for d <= the backend
+    cap (matching the fixed-default behavior), shrinks under the HBM
+    envelope at huge d, and fits identically to an explicit block size."""
+    from keystone_tpu.config import config
+    from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
+    from keystone_tpu.nodes.learning.block_least_squares import (
+        resolve_block_size,
+    )
+
+    assert resolve_block_size(512, 100000) == 512  # explicit wins
+    # CPU backend (the test env): cap is the historical 4096 default.
+    assert resolve_block_size("auto", 24) == 128
+    assert resolve_block_size("auto", 3000) == 4096  # single exact block
+    assert resolve_block_size("auto", 10000) == 4096
+    # HBM envelope: d*b*4 must fit a quarter of the budget.
+    monkeypatch.setattr(config, "hbm_budget_bytes", 12 * (1 << 30))
+    assert resolve_block_size("auto", 262144) == 2048
+    assert resolve_block_size("auto", 524288) == 1024
+
+    X = rng.normal(size=(200, 24)).astype(np.float32)
+    Y = rng.normal(size=(200, 3)).astype(np.float32)
+    auto = BlockLeastSquaresEstimator(num_iters=2, lam=0.2).fit(X, Y)
+    fixed = BlockLeastSquaresEstimator(
+        block_size=4096, num_iters=2, lam=0.2
+    ).fit(X, Y)
+    np.testing.assert_allclose(auto.W, fixed.W, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(auto.b, fixed.b, rtol=1e-5, atol=1e-5)

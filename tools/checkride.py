@@ -81,6 +81,10 @@ def _load_state(state_dir: str, step: str):
 
 def _save_state(state_dir: str, step: str, result: dict) -> None:
     os.makedirs(state_dir, exist_ok=True)
+    # Wall-clock stamp INSIDE the record: the state dir is committed, and a
+    # fresh checkout resets mtimes — bench.py's freshness guard must see
+    # the measurement time, not the checkout time.
+    result.setdefault("saved_at", time.time())
     tmp = _state_path(state_dir, step) + ".tmp"
     with open(tmp, "w") as f:
         json.dump(result, f, indent=1)
