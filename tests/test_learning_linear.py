@@ -68,11 +68,13 @@ def test_auto_block_size_resolution_and_fit(rng, monkeypatch):
         resolve_block_size,
     )
 
+    import jax
+
+    cap = 4096 if jax.default_backend() == "cpu" else 8192
     assert resolve_block_size(512, 100000) == 512  # explicit wins
-    # CPU backend (the test env): cap is the historical 4096 default.
     assert resolve_block_size("auto", 24) == 128
-    assert resolve_block_size("auto", 3000) == 4096  # single exact block
-    assert resolve_block_size("auto", 10000) == 4096
+    assert resolve_block_size("auto", 3000) == min(4096, cap)  # exact block
+    assert resolve_block_size("auto", 10000) == cap
     # HBM envelope: d*b*4 must fit a quarter of the budget.
     monkeypatch.setattr(config, "hbm_budget_bytes", 12 * (1 << 30))
     assert resolve_block_size("auto", 262144) == 2048

@@ -1,0 +1,168 @@
+"""North-star projection: ImageNet SIFT+LCS+FV+BWLS on a v5e-64, from
+measured single-chip rates.
+
+BASELINE.md's authoritative target is "ImageNet FV+BlockLS end-to-end
+<= 10 min on TPU v5e-64, >= 10x the published 16-node EC2 baseline". No
+64-chip slice exists in this environment, so this tool does the honest
+next-best thing: a stage-by-stage bottleneck model whose inputs are the
+checkride's MEASURED single-chip numbers (TPU_REPORT.json) wherever they
+exist, with every remaining constant printed as a labelled assumption.
+Stages with no silicon measurement are reported as REQUIRED rates (what
+the hosts/chips must sustain for the 10-min budget), not as claims.
+
+This is a PROJECTION, not a measurement — the output says so. It
+self-upgrades: re-run after the sentinel captures more TPU steps and the
+"assumed" rows flip to "measured(tpu)".
+
+Workload constants follow the reference pipeline (SURVEY.md §2.11
+ImageNetSiftLcsFV [unverified]): N=1.28M train images, two descriptor
+branches (SIFT + LCS) -> PCA(64) -> GMM(k=256) Fisher vectors -> 64k-dim
+features -> BlockWeightedLeastSquares(k=1000).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # bcd_flops — the same FLOP model the measured TFLOPS uses
+
+N_IMAGES = 1_281_167
+K_CLASSES = 1000
+D_FEATURES = 65_536
+SOLVER_EPOCHS = 3
+SOLVER_BLOCK = 4096
+CHIPS = 64
+# Data-parallel BCD psums one b×b gram per block per epoch over ICI; on a
+# 64-chip torus that collective overlaps poorly only at small n/chip.
+# 0.8 is a stated assumption, not a measurement.
+SCALING_EFFICIENCY = 0.8
+DESCRIPTORS_PER_IMAGE = 2048  # dense-SIFT grid at 256px, step 4 (assumed)
+
+
+def _report_steps() -> dict:
+    try:
+        with open(os.path.join(REPO, "TPU_REPORT.json")) as f:
+            return json.load(f).get("steps", {})
+    except (OSError, ValueError):
+        return {}
+
+
+K_GMM = 256  # GMM components per branch (2 branches x 2*64*256 = 64k dims)
+
+
+def _tpu(steps: dict, name: str):
+    rec = steps.get(name)
+    if (
+        rec
+        and rec.get("backend") == "tpu"
+        and rec.get("ok")
+        and not rec.get("quick_scale")  # toy-scale rides are not evidence
+    ):
+        return rec
+    return None
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget-min", type=float, default=10.0)
+    args = ap.parse_args()
+    steps = _report_steps()
+    rows = []
+
+    # --- Solver: measured TFLOPS/chip × 64 chips × stated efficiency ----
+    solver_flops = bench.bcd_flops(
+        N_IMAGES, D_FEATURES, K_CLASSES, SOLVER_BLOCK, SOLVER_EPOCHS
+    )
+    b = _tpu(steps, "bench_bf16") or _tpu(steps, "bench_f32")
+    if b:
+        tflops = b["tflops_per_chip"]
+        dtype = b["bench_line"]["detail"]["dtype"]
+        solver_s = solver_flops / (tflops * 1e12 * CHIPS * SCALING_EFFICIENCY)
+        rows.append(
+            {
+                "stage": f"BWLS solve (d=64k, k=1000, {SOLVER_EPOCHS} epochs)",
+                "minutes": round(solver_s / 60, 2),
+                "basis": f"measured(tpu) {tflops} TFLOPS/chip ({dtype}) "
+                f"x {CHIPS} chips x {SCALING_EFFICIENCY} eff (assumed)",
+            }
+        )
+    else:
+        rows.append(
+            {
+                "stage": "BWLS solve",
+                "minutes": None,
+                "basis": "awaiting silicon (run make tpu-checkride)",
+            }
+        )
+
+    # --- Fisher-vector encode on chip (both branches) -------------------
+    fv = _tpu(steps, "pallas_fv")
+    if fv:
+        per_batch = min(
+            t for t in (fv.get("pallas_s"), fv.get("xla_s")) if t
+        )
+        bsz = fv["config"]["batch"]
+        m = fv["config"]["m"]
+        k_meas = fv["config"]["k"]
+        # Rescale the measured batch to the ImageNet shape: descriptor
+        # count AND GMM component count (FV cost is linear in both), then
+        # double for the two branches.
+        per_img = (
+            per_batch / bsz * (DESCRIPTORS_PER_IMAGE / m) * (K_GMM / k_meas) * 2
+        )
+        fv_s = N_IMAGES * per_img / CHIPS
+        rows.append(
+            {
+                "stage": "FV encode (SIFT+LCS branches)",
+                "minutes": round(fv_s / 60, 2),
+                "basis": f"measured(tpu) {per_batch:.4f}s per {bsz}x{m} batch, "
+                f"{DESCRIPTORS_PER_IMAGE} desc/img (assumed) x {CHIPS} chips",
+            }
+        )
+    else:
+        rows.append(
+            {
+                "stage": "FV encode",
+                "minutes": None,
+                "basis": "awaiting silicon (pallas_fv step not yet on tpu)",
+            }
+        )
+
+    # --- Host-side decode + SIFT/LCS: reported as a REQUIREMENT ---------
+    # No silicon/host-fleet measurement exists; instead of assuming one,
+    # state what the hosts must sustain to fit the budget.
+    budget_s = args.budget_min * 60
+    spent = sum(r["minutes"] or 0 for r in rows) * 60
+    remaining = max(budget_s - spent, 0.0)
+    req = N_IMAGES / remaining if remaining > 0 else float("inf")
+    rows.append(
+        {
+            "stage": "host decode+SIFT+LCS (required, not claimed)",
+            "minutes": round(remaining / 60, 2),
+            "basis": f"REQUIREMENT: fleet must sustain {req:,.0f} img/s "
+            "aggregate in the remaining budget (single-core native decode "
+            "measured 273 img/s at 512->256px, NOTES_r3 §7; dense SIFT "
+            "unmeasured)",
+        }
+    )
+
+    total_measured = sum(r["minutes"] or 0 for r in rows[:-1])
+    out = {
+        "metric": "imagenet_northstar_projection_minutes",
+        "note": "PROJECTION from measured single-chip rates; not a measurement",
+        "target_minutes": args.budget_min,
+        "baseline_minutes": 100.0,
+        "chip_stages_minutes": round(total_measured, 2),
+        "stages": rows,
+    }
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()
