@@ -1,10 +1,17 @@
-"""Dense SIFT extractor node backed by the native C++ library.
+"""Dense SIFT extractor node: native (C++) and on-chip (XLA) backends.
 
 Ref: src/main/scala/nodes/images/external/SIFTExtractor.scala — the JNI
 wrapper transformer around VLFeat.getSIFTs (SURVEY.md §2.5, §3.4)
 [unverified]. Input NHWC (or NHW1) grayscale batch; output
 (n, num_keypoints, 128) descriptor sets — the dense grid is static per
 image shape, so downstream stages see fixed shapes (no ragged batching).
+
+Backends with identical math (parity-tested against each other):
+- "native": the clean-room C++ kernel (reference-parity path; host CPU);
+- "xla": ops/sift_xla.py — grouped 1-D convolutions on the default
+  backend. On TPU this removes the last host-side featurization stage
+  (the host keeps only JPEG decode) and lets the SIFT→PCA→FV branch fuse
+  into device programs.
 """
 
 from __future__ import annotations
@@ -16,29 +23,53 @@ from keystone_tpu.workflow import Transformer
 
 
 class SIFTExtractor(Transformer):
-    jittable = False  # host/native compute; output feeds device stages
-
-    def __init__(self, step: int = 4, bin_size: int = 4, scale_factor: float = 1.0):
+    def __init__(
+        self,
+        step: int = 4,
+        bin_size: int = 4,
+        scale_factor: float = 1.0,
+        backend: str = "native",
+    ):
+        if backend not in ("native", "xla"):
+            raise ValueError(f"unknown backend {backend!r}")
         self.step = step
         self.bin_size = bin_size
         self.scale_factor = scale_factor
-        if not native.available():
+        self.backend = backend
+        # Host/native compute breaks jittable chains; the xla backend is a
+        # pure jnp program and fuses with downstream device stages.
+        self.jittable = backend == "xla"
+        if backend == "native" and not native.available():
             raise RuntimeError(
                 "native library unavailable "
                 f"(build error: {native.build_error()}); "
-                "run `make` in keystone_tpu/native"
+                "run `make` in keystone_tpu/native, or use backend='xla'"
             )
 
     def signature(self):
+        # Backend excluded: it changes where identical math runs, not the
+        # result (same convention as FisherVector.signature).
         return self.stable_signature(self.step, self.bin_size, self.scale_factor)
 
     def apply_batch(self, X):
-        X = np.asarray(X, dtype=np.float32)
-        if X.ndim == 4:
-            if X.shape[-1] != 1:
+        if np.ndim(X) == 4:
+            if np.shape(X)[-1] != 1:
                 raise ValueError("SIFTExtractor expects grayscale input")
             X = X[..., 0]
-        descs = native.dense_sift(X, step=self.step, bin_size=self.bin_size)
+        if self.backend == "xla":
+            import jax.numpy as jnp
+
+            from keystone_tpu.ops.sift_xla import dense_sift_xla
+
+            descs = dense_sift_xla(
+                jnp.asarray(X), step=self.step, bin_size=self.bin_size
+            )
+        else:
+            descs = native.dense_sift(
+                np.asarray(X, dtype=np.float32),
+                step=self.step,
+                bin_size=self.bin_size,
+            )
         if self.scale_factor != 1.0:
             descs = descs * self.scale_factor
         return descs

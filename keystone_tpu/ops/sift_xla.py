@@ -1,0 +1,146 @@
+"""Dense SIFT as two grouped 1-D convolutions — the on-chip twin of the
+native kernel.
+
+Ref: src/main/scala/nodes/images/external/SIFTExtractor.scala /
+utils.external.VLFeat.getSIFTs (SURVEY.md §2.3, §3.4) [unverified]. The
+reference extracts descriptors in native C on executor CPUs; the clean-room
+C++ parity port lives in native/src/sift.cpp. This module is the
+TPU-native PERFORMANCE path with identical math, exploiting that every
+per-pixel weight in the descriptor sum factorizes:
+
+    desc[ky,kx,cy,cx,b]
+      = Σ_{yy,xx} ori[ky·s+yy, kx·s+xx, b] · G(yy,xx) · wy(yy,cy) · wx(xx,cx)
+
+with G a centered Gaussian (separable: G = gy(yy)·gx(xx)) and wy/wx the
+bilinear cell weights. So the whole extraction is:
+
+  1. per-pixel gradients (edge-clamped central differences — VPU),
+  2. soft orientation binning into 8 channels (VPU),
+  3. a stride-`step` 1-D conv along y with 4 per-channel filters
+     (gy·wy(·,cy)), then the same along x (gx·wx(·,cx)) — grouped convs
+     the MXU executes natively,
+  4. L2 → 0.2-clamp → re-L2 normalization per descriptor.
+
+Running SIFT on chip removes the last host-side featurization stage of
+the ImageNet/VOC pipelines (the host keeps only JPEG decode — see
+tools/northstar.py), and the whole SIFT→PCA→FV branch fuses into device
+programs. Parity vs the native kernel is oracle-tested in
+tests/test_descriptors.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+ORI_BINS = 8
+SPATIAL_BINS = 4
+DESC_DIM = SPATIAL_BINS * SPATIAL_BINS * ORI_BINS  # 128
+
+
+def _cell_kernels(bin_size: int) -> np.ndarray:
+    """(SPATIAL_BINS, span) separable 1-D weights: Gaussian × bilinear.
+
+    Row c gives, for each offset within the span, the weight of spatial
+    cell c along that axis — exactly the factorized form of the native
+    kernel's per-pixel weighting (sift.cpp descriptor_at)."""
+    span = SPATIAL_BINS * bin_size
+    center = 0.5 * (span - 1)
+    sigma = 0.5 * span
+    off = np.arange(span)
+    gauss = np.exp(-((off - center) ** 2) / (2.0 * sigma * sigma))
+    # Position in cell units (bilinear support over adjacent cells).
+    pos = (off + 0.5) / bin_size - 0.5
+    cells = np.arange(SPATIAL_BINS)[:, None]
+    w = np.maximum(0.0, 1.0 - np.abs(pos[None, :] - cells))
+    return (w * gauss[None, :]).astype(np.float32)  # (4, span)
+
+
+def _gradients(im: jnp.ndarray):
+    """Edge-clamped central differences, matching the native kernel: at
+    borders the clamped index makes the difference one-sided (still ×0.5)."""
+    padx = jnp.pad(im, ((0, 0), (0, 0), (1, 1)), mode="edge")
+    pady = jnp.pad(im, ((0, 0), (1, 1), (0, 0)), mode="edge")
+    gx = 0.5 * (padx[:, :, 2:] - padx[:, :, :-2])
+    gy = 0.5 * (pady[:, 2:, :] - pady[:, :-2, :])
+    return gx, gy
+
+
+def _orientation_channels(gx: jnp.ndarray, gy: jnp.ndarray) -> jnp.ndarray:
+    """(n, h, w) gradients → (n, h, w, 8) soft-assigned magnitude channels
+    (linear interpolation between the two adjacent orientation bins)."""
+    mag = jnp.sqrt(gx * gx + gy * gy)
+    theta = jnp.arctan2(gy, gx)
+    theta = jnp.where(theta < 0, theta + 2.0 * np.pi, theta)
+    fbin = theta * (ORI_BINS / (2.0 * np.pi))
+    bins = jnp.arange(ORI_BINS, dtype=fbin.dtype)
+    dist = jnp.abs(fbin[..., None] - bins)
+    circ = jnp.minimum(dist, ORI_BINS - dist)
+    return mag[..., None] * jnp.maximum(0.0, 1.0 - circ)
+
+
+@partial(jax.jit, static_argnames=("step", "bin_size"))
+def dense_sift_xla(
+    images: jnp.ndarray, step: int = 4, bin_size: int = 4
+) -> jnp.ndarray:
+    """(n, h, w) grayscale → (n, nkp, 128) dense SIFT, all on device."""
+    images = jnp.asarray(images, dtype=jnp.float32)
+    n, h, w = images.shape
+    span = SPATIAL_BINS * bin_size
+    if h < span or w < span:
+        raise ValueError(
+            f"image ({h}x{w}) smaller than the {span}px descriptor support"
+        )
+    ori = _orientation_channels(*_gradients(images))  # (n, h, w, 8)
+
+    k1d = _cell_kernels(bin_size)  # (4, span)
+    # y-pass: grouped conv, each of the 8 orientation channels produces 4
+    # cell-y responses. OHWI filters: O = 8·4 (group-major), I = 1.
+    fy = jnp.asarray(
+        np.tile(k1d[:, :, None, None], (ORI_BINS, 1, 1, 1))
+    )  # (32, span, 1, 1)
+    out = lax.conv_general_dilated(
+        ori,
+        fy,
+        window_strides=(step, 1),
+        padding="VALID",
+        dimension_numbers=("NHWC", "OHWI", "NHWC"),
+        feature_group_count=ORI_BINS,
+    )  # (n, ny, w, 32) channels ordered (b, cy)
+    # x-pass: each (b, cy) channel produces 4 cell-x responses.
+    fx = jnp.asarray(
+        np.tile(k1d[:, None, :, None], (ORI_BINS * SPATIAL_BINS, 1, 1, 1))
+    )  # (128, 1, span, 1)
+    out = lax.conv_general_dilated(
+        out,
+        fx,
+        window_strides=(1, step),
+        padding="VALID",
+        dimension_numbers=("NHWC", "OHWI", "NHWC"),
+        feature_group_count=ORI_BINS * SPATIAL_BINS,
+    )  # (n, ny, nx, 128) channels ordered (b, cy, cx)
+    ny, nx = out.shape[1], out.shape[2]
+
+    # Native layout is (cy, cx, b); conv output is (b, cy, cx). Permute.
+    b_i, cy_i, cx_i = np.meshgrid(
+        np.arange(ORI_BINS),
+        np.arange(SPATIAL_BINS),
+        np.arange(SPATIAL_BINS),
+        indexing="ij",
+    )
+    native_index = (cy_i * SPATIAL_BINS + cx_i) * ORI_BINS + b_i
+    perm = np.empty(DESC_DIM, dtype=np.int32)
+    perm[native_index.ravel()] = np.arange(DESC_DIM)
+    desc = out.reshape(n, ny * nx, DESC_DIM)[..., jnp.asarray(perm)]
+
+    # L2 → 0.2 clamp → re-L2. The floored denominator keeps zero
+    # descriptors at zero (native behavior) without a where() that would
+    # evaluate a 0/0 branch under debug_nans.
+    norm = jnp.linalg.norm(desc, axis=-1, keepdims=True)
+    desc = jnp.minimum(desc / jnp.maximum(norm, 1e-12), 0.2)
+    norm2 = jnp.linalg.norm(desc, axis=-1, keepdims=True)
+    return desc / jnp.maximum(norm2, 1e-12)

@@ -42,6 +42,7 @@ class ImageNetSiftLcsFVConfig:
     label_map_path: Optional[str] = None
     sift_step: int = 4
     sift_bin: int = 4
+    sift_backend: str = "native"
     lcs_step: int = 4
     lcs_bin: int = 4
     pca_dims: int = 64
@@ -73,7 +74,8 @@ class ImageNetSiftLcsFVConfig:
 
 def build_featurizer(conf: ImageNetSiftLcsFVConfig, train_images) -> Pipeline:
     sift_front = GrayScaler().and_then(
-        SIFTExtractor(step=conf.sift_step, bin_size=conf.sift_bin)
+        SIFTExtractor(step=conf.sift_step, bin_size=conf.sift_bin,
+                      backend=conf.sift_backend)
     )
     lcs_front = LCSExtractor(step=conf.lcs_step, bin_size=conf.lcs_bin).to_pipeline()
     branches = [
@@ -286,6 +288,8 @@ def main(argv=None):
     p.add_argument("--augment-crop", type=int, default=0,
                    help="crop side in pixels (0 = 7/8 of the image side)")
     p.add_argument("--fv-backend", choices=["tpu", "pallas", "native"], default="tpu")
+    p.add_argument("--sift-backend", choices=["native", "xla"], default="native",
+                   help="xla runs dense SIFT on the device (host keeps only decode)")
     p.add_argument("--stream", action="store_true",
                    help="out-of-core: stream images, hold only features")
     p.add_argument("--stream-batch", type=int, default=256)
@@ -307,6 +311,7 @@ def main(argv=None):
             augment=a.augment,
             augment_crop=a.augment_crop,
             fv_backend=a.fv_backend,
+            sift_backend=a.sift_backend,
             stream=a.stream,
             stream_batch=a.stream_batch,
             fit_sample_images=a.fit_sample_images,

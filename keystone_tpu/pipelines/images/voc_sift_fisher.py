@@ -36,6 +36,7 @@ class VOCSIFTFisherConfig:
     test_annotation_dir: Optional[str] = None
     sift_step: int = 4
     sift_bin: int = 4
+    sift_backend: str = "native"
     pca_dims: int = 64
     gmm_k: int = 16
     gmm_iters: int = 20
@@ -52,7 +53,8 @@ class VOCSIFTFisherConfig:
 def build_featurizer(conf: VOCSIFTFisherConfig, train_images) -> Pipeline:
     """Fit PCA + GMM on training descriptors; return the full featurizer."""
     front = GrayScaler().and_then(
-        SIFTExtractor(step=conf.sift_step, bin_size=conf.sift_bin)
+        SIFTExtractor(step=conf.sift_step, bin_size=conf.sift_bin,
+                      backend=conf.sift_backend)
     )
     return fit_fisher_featurizer(
         front,
@@ -122,6 +124,8 @@ def main(argv=None):
     p.add_argument("--gmm-k", type=int, default=16)
     p.add_argument("--lam", type=float, default=1e-3)
     p.add_argument("--fv-backend", choices=["tpu", "pallas", "native"], default="tpu")
+    p.add_argument("--sift-backend", choices=["native", "xla"], default="native",
+                   help="xla runs dense SIFT on the device (host keeps only decode)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--synthetic-n", type=int, default=192)
     a = p.parse_args(argv)
@@ -135,6 +139,7 @@ def main(argv=None):
             gmm_k=a.gmm_k,
             lam=a.lam,
             fv_backend=a.fv_backend,
+            sift_backend=a.sift_backend,
             seed=a.seed,
             synthetic_n=a.synthetic_n,
         )

@@ -210,6 +210,24 @@ def test_pallas_fv_mosaic_compiles_at_imagenet_config(mesh):
     assert _compiled_ok(compiled)
 
 
+def test_dense_sift_xla_compiles_for_v5e(mesh):
+    """The on-chip dense SIFT (grouped 1-D convs) must XLA:TPU-compile —
+    it is the --sift-backend xla path that moves the last host-side
+    featurization stage onto the chips."""
+    import functools
+
+    from keystone_tpu.ops.sift_xla import dense_sift_xla
+
+    fn = functools.partial(dense_sift_xla, step=4, bin_size=4)
+    # The batch-sharded input carries the v5e topology — without a
+    # sharding the lowering would silently target the default (CPU)
+    # backend and prove nothing.
+    c = jax.jit(fn).lower(
+        _sds((8, 256, 256), mesh, P(AXIS))
+    ).compile()
+    assert _compiled_ok(c)
+
+
 def test_convolver_compiles_for_v5e(mesh):
     """The image-pipeline hot op (conv_general_dilated in bf16 compute) on
     the v5e target."""

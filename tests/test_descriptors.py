@@ -93,3 +93,57 @@ def test_hog_rejects_tiny_images(rng):
     X = rng.uniform(size=(1, 12, 12, 1)).astype(np.float32)
     with pytest.raises(ValueError, match="too small for HOG"):
         HogExtractor(cell_size=8)(X)
+
+
+class TestXlaSift:
+    """The on-chip dense SIFT must match the clean-room native kernel —
+    same grid, same soft binning, same normalization."""
+
+    def _native_or_skip(self):
+        from keystone_tpu import native
+
+        if not native.available():
+            pytest.skip(f"native lib unavailable: {native.build_error()}")
+        return native
+
+    def test_parity_with_native_kernel(self, rng):
+        native = self._native_or_skip()
+        from keystone_tpu.ops.sift_xla import dense_sift_xla
+
+        imgs = rng.uniform(size=(3, 48, 40)).astype(np.float32)
+        ref = native.dense_sift(imgs, step=4, bin_size=4)
+        got = np.asarray(dense_sift_xla(imgs, step=4, bin_size=4))
+        assert got.shape == ref.shape  # same dense grid
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+    def test_parity_nondefault_geometry(self, rng):
+        native = self._native_or_skip()
+        from keystone_tpu.ops.sift_xla import dense_sift_xla
+
+        imgs = rng.uniform(size=(2, 37, 53)).astype(np.float32)
+        ref = native.dense_sift(imgs, step=5, bin_size=3)
+        got = np.asarray(dense_sift_xla(imgs, step=5, bin_size=3))
+        assert got.shape == ref.shape
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+    def test_flat_image_zero_descriptors(self):
+        from keystone_tpu.ops.sift_xla import dense_sift_xla
+
+        flat = np.full((1, 32, 32), 0.5, dtype=np.float32)
+        d = np.asarray(dense_sift_xla(flat, step=4, bin_size=4))
+        np.testing.assert_allclose(d, 0.0, atol=1e-7)
+
+    def test_extractor_backend_xla_matches_native(self, rng):
+        self._native_or_skip()
+        from keystone_tpu.nodes.images.external import SIFTExtractor
+
+        imgs = rng.uniform(size=(2, 40, 40, 1)).astype(np.float32)
+        a = SIFTExtractor(step=4, backend="native")(imgs)
+        b = SIFTExtractor(step=4, backend="xla")(imgs)
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=2e-4, atol=2e-4
+        )
+        assert SIFTExtractor(step=4, backend="xla").jittable
+        assert not SIFTExtractor(step=4, backend="native").jittable
+        with pytest.raises(ValueError):
+            SIFTExtractor(backend="cuda")
