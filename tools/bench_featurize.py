@@ -81,11 +81,42 @@ def measure(batch: int, filters: int, dtype: str, reps: int) -> dict:
     }
 
 
+def measure_sift(batch: int, size: int, reps: int) -> dict:
+    """On-chip dense SIFT (ops/sift_xla.py) img/s at the ImageNet geometry
+    — the --sift-backend xla rate the north-star projection bounds."""
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.ops.sift_xla import dense_sift_xla
+
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(
+        rng.uniform(size=(batch, size, size)).astype(np.float32)
+    )
+    out = dense_sift_xla(imgs, step=4, bin_size=4)  # compile + warm-up
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = dense_sift_xla(imgs, step=4, bin_size=4)
+        float(jnp.sum(out[0, 0]))  # force completion + tiny fetch
+    dt = (time.perf_counter() - t0) / reps
+    return {
+        "kernel": "dense_sift_xla",
+        "batch": batch,
+        "size": size,
+        "desc_per_img": int(out.shape[1]),
+        "images_per_sec": round(batch / dt, 1),
+        "seconds_per_batch": round(dt, 4),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--filters", type=int, default=1024)
     ap.add_argument("--batch", type=int, default=2048)
     ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--sift-batch", type=int, default=64)
+    ap.add_argument("--sift-size", type=int, default=256)
     ap.add_argument(
         "--dtypes", nargs="+", choices=["f32", "bf16"], default=["f32", "bf16"]
     )
@@ -97,6 +128,7 @@ def main() -> None:
     rows = [
         measure(args.batch, args.filters, d, args.reps) for d in args.dtypes
     ]
+    rows.append(measure_sift(args.sift_batch, args.sift_size, args.reps))
     print(
         json.dumps(
             {
