@@ -244,6 +244,10 @@ def _checkride_checkpoint(scale_key: str, dtype: str):
             and rec.get("ok")
             and not rec.get("quick_scale")
             and isinstance(line, dict)
+            # A checkpoint carrying suspect_timing measured above plausible
+            # peak — a transport lie must not be replayed as the round's
+            # silicon number just because the live attempt failed.
+            and not line.get("suspect_timing")
         ):
             return None
         det = line.get("detail") or {}

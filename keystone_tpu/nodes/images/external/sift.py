@@ -46,12 +46,25 @@ class SIFTExtractor(Transformer):
                 "run `make` in keystone_tpu/native, or use backend='xla'"
             )
 
+    # Descriptor-math version: bump whenever either backend's numerics
+    # change (r4: HIGHEST-precision convs + sub-floor norm guard in the xla
+    # path). Without it, disk-cached fits keyed on old drifted descriptors
+    # would keep being served — the cache key deliberately excludes code.
+    DESCRIPTOR_VERSION = 2
+
     def signature(self):
         # Backend excluded: it changes where identical math runs, not the
         # result (same convention as FisherVector.signature).
-        return self.stable_signature(self.step, self.bin_size, self.scale_factor)
+        return self.stable_signature(
+            self.step, self.bin_size, self.scale_factor, self.DESCRIPTOR_VERSION
+        )
 
     def apply_batch(self, X):
+        # Nested-list inputs need one dtype-free asarray before the ellipsis
+        # index below; ndarrays AND jax tracers (this node is jittable on the
+        # xla backend) already index natively and must pass through untouched.
+        if not hasattr(X, "ndim"):
+            X = np.asarray(X)
         if np.ndim(X) == 4:
             if np.shape(X)[-1] != 1:
                 raise ValueError("SIFTExtractor expects grayscale input")

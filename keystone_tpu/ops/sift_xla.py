@@ -103,6 +103,11 @@ def dense_sift_xla(
     fy = jnp.asarray(
         np.tile(k1d[:, :, None, None], (ORI_BINS, 1, 1, 1))
     )  # (32, span, 1, 1)
+    # HIGHEST precision: on TPU the default conv precision is bf16-class,
+    # which would let xla-backend descriptors drift past the native-parity
+    # tolerance while SIFTExtractor.signature() treats the backends as
+    # cache-identical. These convs are a rounding error next to FV/solver
+    # FLOPs, so full f32 costs nothing that matters.
     out = lax.conv_general_dilated(
         ori,
         fy,
@@ -110,6 +115,7 @@ def dense_sift_xla(
         padding="VALID",
         dimension_numbers=("NHWC", "OHWI", "NHWC"),
         feature_group_count=ORI_BINS,
+        precision=lax.Precision.HIGHEST,
     )  # (n, ny, w, 32) channels ordered (b, cy)
     # x-pass: each (b, cy) channel produces 4 cell-x responses.
     fx = jnp.asarray(
@@ -122,6 +128,7 @@ def dense_sift_xla(
         padding="VALID",
         dimension_numbers=("NHWC", "OHWI", "NHWC"),
         feature_group_count=ORI_BINS * SPATIAL_BINS,
+        precision=lax.Precision.HIGHEST,
     )  # (n, ny, nx, 128) channels ordered (b, cy, cx)
     ny, nx = out.shape[1], out.shape[2]
 
@@ -137,10 +144,13 @@ def dense_sift_xla(
     perm[native_index.ravel()] = np.arange(DESC_DIM)
     desc = out.reshape(n, ny * nx, DESC_DIM)[..., jnp.asarray(perm)]
 
-    # L2 → 0.2 clamp → re-L2. The floored denominator keeps zero
-    # descriptors at zero (native behavior) without a where() that would
-    # evaluate a 0/0 branch under debug_nans.
+    # L2 → 0.2 clamp → re-L2, with the native kernel's norm guard: a
+    # descriptor whose norm is at/below the floor stays exactly zero
+    # (sift.cpp skips normalization entirely there) — without the guard, a
+    # sub-1e-12 sum would amplify to a unit-norm noise descriptor after
+    # renormalization. The floored denominator keeps the division NaN-free
+    # under debug_nans; the where() only selects, never divides by zero.
     norm = jnp.linalg.norm(desc, axis=-1, keepdims=True)
     desc = jnp.minimum(desc / jnp.maximum(norm, 1e-12), 0.2)
     norm2 = jnp.linalg.norm(desc, axis=-1, keepdims=True)
-    return desc / jnp.maximum(norm2, 1e-12)
+    return jnp.where(norm > 1e-12, desc / jnp.maximum(norm2, 1e-12), 0.0)

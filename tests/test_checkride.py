@@ -237,6 +237,55 @@ def test_bench_serves_checkride_checkpoint_only_when_config_matches(
     p.write_text(json.dumps({"ok": True, "backend": "tpu",
                              "bench_line": {"detail": None}}))
     assert bench._checkride_checkpoint("tpu", "f32") is None
+    # Transport lie (suspect_timing on the stored line) → no serve, even
+    # under a legacy ok=True record saved before checkride rejected them.
+    suspect = json.loads(json.dumps(rec))
+    suspect["bench_line"]["suspect_timing"] = True
+    p.write_text(json.dumps(suspect))
+    assert bench._checkride_checkpoint("tpu", "f32") is None
+
+
+def test_suspect_timing_rejected_at_capture(monkeypatch):
+    """checkride must refuse to record a worker line measured above
+    plausible peak: run_bench_step marks the step failed (so resume
+    re-measures and the report excludes it) and run_mfu_sweep records an
+    error row instead of letting the lie win the 'best' pick."""
+    checkride = _sweep_module()
+    import bench
+
+    lie = {
+        "metric": "bcd_solver_tflops_per_chip",
+        "value": 400.0,
+        "backend": "tpu",
+        "suspect_timing": True,
+        "detail": {"block": 4096, "seconds_per_solve": 0.01},
+    }
+    monkeypatch.setattr(
+        bench, "_run_worker", lambda env, scale, dtype, timeout: dict(lie)
+    )
+    rec = checkride.run_bench_step("bench_f32", "tpu", False, 10.0)
+    assert rec["ok"] is False and "suspect_timing" in rec["error"]
+
+
+def test_suspect_timing_sweep_rows_become_error_rows(tmp_path, monkeypatch):
+    checkride = _sweep_module()
+    import bench
+
+    lie = {
+        "value": 400.0,
+        "backend": "tpu",
+        "suspect_timing": True,
+        "detail": {"block": 4096, "seconds_per_solve": 0.01},
+    }
+    monkeypatch.setattr(
+        bench, "_run_worker", lambda env, scale, dtype, timeout: dict(lie)
+    )
+    state = tmp_path / "state"
+    state.mkdir()
+    rec = checkride.run_mfu_sweep("mfu_sweep", "tpu", False, 10.0, str(state))
+    assert rec["ok"] is False  # no clean rows survived
+    assert all(r.get("error") == "suspect_timing" for r in rec["rows"])
+    assert rec["best"] is None  # the lie never wins the best pick
 
 
 def test_mid_sweep_tpu_death_sets_degrade_flag(tmp_path, monkeypatch):

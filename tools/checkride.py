@@ -195,6 +195,17 @@ def run_bench_step(step: str, target: str, quick: bool, timeout: float) -> dict:
     r = bench._run_worker(env, scale, dtype, timeout)
     if r is None or r.get("value") is None:
         return {"ok": False, "backend": target, "error": "bench worker failed"}
+    if r.get("suspect_timing"):
+        # A reading above plausible peak is a transport lie. ok=False keeps
+        # all three consumers honest at once: the resume check re-measures
+        # instead of skipping, _write_report excludes it from TPU evidence,
+        # and bench.py's replay guard never sees an ok checkpoint to serve.
+        return {
+            "ok": False,
+            "backend": r.get("backend", target),
+            "error": "suspect_timing: measured above plausible peak",
+            "bench_line": r,
+        }
     peak = bench.PLAUSIBLE_PEAK_TFLOPS["bf16" if dtype == "bf16" else "f32"]
     return {
         "ok": True,
@@ -253,6 +264,14 @@ def run_mfu_sweep(
             # <5 min, and the r3 ride burned 40 min of a dying relay's last
             # window on one wedged row before the death probe could fire.
             r = bench._run_worker(env, scale, dtype, min(timeout, 900.0))
+            if r is not None and r.get("suspect_timing"):
+                # Same transport-lie guard as run_bench_step: a row above
+                # plausible peak must not be checkpointed as evidence (it
+                # would win the "best" pick and be preserved forever).
+                rows.append(
+                    {"block": block, "dtype": dtype, "error": "suspect_timing"}
+                )
+                continue
             if r is None or r.get("value") is None:
                 rows.append({"block": block, "dtype": dtype, "error": "failed"})
                 # Mid-sweep death: re-probe once and stop burning timeouts.
