@@ -64,6 +64,13 @@ class Config:
     # where batched decompositions measured 2.3× SLOWER than independent
     # per-block programs. An explicit int forces that chunk on any backend.
     factor_batch: int | None = None
+    # Scan-fused BCD epochs: when feature blocks tile d exactly, the solver
+    # runs the whole factor phase + epoch loop as three XLA programs (stack,
+    # batched factor, scanned epochs) instead of one dispatch per (block,
+    # epoch). Per-program launch latency through the TPU relay rivals the
+    # skinny per-epoch gemms it wraps, so dispatch count is a first-order
+    # solver cost. None/True = on; False = force the legacy per-block loop.
+    fused_epochs: bool | None = None
     # Whole-pipeline auto-caching (profile a sample run, persist the best
     # time-saved-per-byte intermediates under a budget). Opt-in: profiling
     # costs a sample execution per optimization.

@@ -127,24 +127,122 @@ def _gram_only_fn(mesh: Mesh, axis: str, precision, weighted: bool):
     return jax.jit(sm)
 
 
+def _batched_spd_inv(grams):
+    """Batched SPD inverse over a leading block axis — THE single source for
+    the Cholesky→triangular-solves inverse used by every batched factor
+    body. XLA lowers a single b×b factorization to a sequential panel loop
+    that dominates many-block factor phases on TPU; the batch dimension runs
+    those loops in parallel, amortizing the sequential lowering."""
+    chol = jnp.linalg.cholesky(grams)
+    eye = jnp.broadcast_to(
+        jnp.eye(grams.shape[-1], dtype=grams.dtype), grams.shape
+    )
+    y = solve_triangular(chol, eye, lower=True)
+    return solve_triangular(chol, y, lower=True, trans=1)
+
+
 @lru_cache(maxsize=None)
 def _batched_ridge_inv_fn(mesh: Mesh):
-    """Batched SPD inverse over a leading block axis: one XLA program
-    factorizes `factor_batch` blocks at once. XLA lowers a single b×b
-    Cholesky/triangular solve to a sequential panel loop that dominates
-    many-block factor phases on TPU; the batch dimension runs those loops
-    in parallel, amortizing the sequential lowering across blocks."""
-
-    def inv(grams):
-        g, b, _ = grams.shape
-        chol = jnp.linalg.cholesky(grams)
-        eye = jnp.broadcast_to(jnp.eye(b, dtype=grams.dtype), (g, b, b))
-        y = solve_triangular(chol, eye, lower=True)
-        return solve_triangular(chol, y, lower=True, trans=1)
-
+    """One XLA program factorizing `factor_batch` stacked grams at once."""
     # Donate the gram stack — dead once the inverses exist; caps the factor
     # phase's transient at one stack instead of two.
-    return jax.jit(inv, donate_argnums=_donate(mesh, 0))
+    return jax.jit(_batched_spd_inv, donate_argnums=_donate(mesh, 0))
+
+
+@lru_cache(maxsize=None)
+def _stack_blocks_fn(mesh: Mesh, axis: str, nb: int):
+    """(rows, d) → (nb, rows, d/nb) stacked equal-size column blocks, in one
+    program. This is the fused path's analog of the a_blocks partition (same
+    one-extra-copy-of-A aggregate cost), laid out so a `lax.scan` can carry
+    the epoch loop over the leading block axis."""
+
+    def local(a):
+        r, d = a.shape
+        return jnp.moveaxis(a.reshape(r, nb, d // nb), 1, 0)
+
+    sm = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=P(axis),
+        out_specs=P(None, axis),
+        check_vma=False,
+    )
+    return jax.jit(sm)
+
+
+@lru_cache(maxsize=None)
+def _fused_factor_fn(mesh: Mesh, axis: str, precision, weighted: bool):
+    """All blocks' ridge inverses in ONE program: batched psum'd grams
+    (one big MXU batch-gemm) into batched Cholesky + triangular solves.
+    The single dispatch matters as much as the batching — through the
+    relay transport, per-program launch latency between many small factor
+    programs was a real slice of solver wall-clock."""
+
+    def local(a3, lam, w_rows):  # a3: (chunk, rows_shard, b)
+        aw = a3 * w_rows[None, :, None] if weighted else a3
+        gram = lax.psum(
+            solver_matmul(jnp.swapaxes(aw, 1, 2), a3, precision), axis
+        )
+        b = a3.shape[2]
+        return _batched_spd_inv(gram + lam * jnp.eye(b, dtype=gram.dtype))
+
+    sm = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(None, axis), P(), P(axis)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sm)
+
+
+@lru_cache(maxsize=None)
+def _fused_epochs_fn(
+    mesh: Mesh, axis: str, precision, weighted: bool, num_epochs: int,
+    cached: bool,
+):
+    """The whole multi-epoch BCD sweep as ONE XLA program: scan over blocks
+    inside scan over epochs, per-shard under shard_map.
+
+    This is the TPU-shaped fix for the dispatch-bound solver: the legacy
+    loop launches one program per (block, epoch) — each launch a host→relay
+    round trip whose latency rivals the skinny per-epoch gemms it wraps.
+    Fused, the solve is a single launch regardless of nb·epochs, XLA
+    pipelines the scan body's gemms back-to-back on the MXU, and the psum
+    schedule is fixed at compile time (also immune to the CPU in-process
+    rendezvous deadlock that forces the legacy loop to throttle).
+
+    ``cached=True`` consumes precomputed ridge inverses (xs carries them);
+    ``cached=False`` re-derives gram+Cholesky per block visit — the
+    single-epoch / factor-cache-disabled mode."""
+
+    def local(a3, invs, r, w3, lam, w_rows):
+        def block_step(rc, xs):
+            a_b, inv, w_b = xs
+            aw = _local_weighted(a_b, w_rows, weighted)
+            if not cached:
+                inv = _local_gram_inv(a_b, aw, lam, precision, axis)
+            r_new, w_new = _local_solve_update(
+                a_b, aw, inv, rc, w_b, precision, axis
+            )
+            return r_new, w_new
+
+        def epoch_step(carry, _):
+            rc, w3c = carry
+            rc, w3c = lax.scan(block_step, rc, (a3, invs, w3c))
+            return (rc, w3c), None
+
+        (r, w3), _ = lax.scan(epoch_step, (r, w3), None, length=num_epochs)
+        return r, w3
+
+    sm = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(None, axis), P(), P(axis), P(), P(), P(axis)),
+        out_specs=(P(axis), P()),
+        check_vma=False,
+    )
+    return jax.jit(sm, donate_argnums=_donate(mesh, 2, 3))
 
 
 @lru_cache(maxsize=None)
@@ -220,6 +318,17 @@ def _block_update_fn(mesh: Mesh, axis: str, precision, weighted: bool):
     return jax.jit(sm, donate_argnums=_donate(mesh, 1, 2))
 
 
+def _factor_chunk() -> int:
+    """Blocks factorized per batched XLA program — THE single chunk policy
+    for both the legacy and fused factor phases. Auto: batching amortizes
+    TPU's sequential factorization lowering, but measured 2.3× slower than
+    independent per-block programs on the CPU backend — there, per-block.
+    An explicit config.factor_batch forces that chunk on any backend."""
+    if config.factor_batch is None:
+        return 1 if jax.default_backend() == "cpu" else 16
+    return max(1, int(config.factor_batch))
+
+
 def _factor_blocks(
     a_blocks, blocks, lam_arr, w_rows, mesh, axis, weighted, throttle
 ) -> list:
@@ -235,13 +344,7 @@ def _factor_blocks(
     n_eq = len(blocks)
     if n_eq > 1 and blocks[-1][1] - blocks[-1][0] != blocks[0][1] - blocks[0][0]:
         n_eq -= 1  # ragged tail handled per-block below
-    if config.factor_batch is None:
-        # Auto: batching amortizes TPU's sequential factorization lowering,
-        # but measured 2.3× slower than independent per-block programs on
-        # the CPU backend — there, keep the fused per-block path.
-        chunk = 1 if jax.default_backend() == "cpu" else 16
-    else:
-        chunk = max(1, int(config.factor_batch))
+    chunk = _factor_chunk()
     invs: list = []
     # A singleton final chunk would pay a fresh (1,b,b) batched compile and
     # lose gram/factor fusion; leave it to the fused per-block path below.
@@ -361,6 +464,22 @@ def block_coordinate_descent(
     # on CPU only; TPU keeps full async pipelining.
     throttle = jax.default_backend() == "cpu"
 
+    # Fused scan path: when the blocks tile d exactly, the entire solve —
+    # factor phase and every (block, epoch) update — runs in three XLA
+    # programs instead of one program per block visit. See _fused_epochs_fn
+    # for why dispatch count is a first-order solver cost on this hardware.
+    # A ragged tail block (d % block_size != 0) keeps the legacy loop.
+    if (
+        config.fused_epochs is not False
+        and d % block_size == 0
+        and start_epoch < num_iters
+    ):
+        return _solve_fused(
+            A, blocks, lam_arr, w_rows, W, R, num_iters, start_epoch,
+            cache_grams, weighted, checkpoint_dir, fingerprint, mesh, axis,
+            throttle,
+        )
+
     a_blocks = [lax.slice_in_dim(A.data, s, e, axis=1) for s, e in blocks]
     if cache_grams and start_epoch < num_iters:
         cached_update = _cached_block_update_fn(
@@ -391,6 +510,57 @@ def block_coordinate_descent(
     if checkpoint_dir is not None:
         wait_for_checkpoints(checkpoint_dir)
     return W, blocks
+
+
+def _solve_fused(
+    A, blocks, lam_arr, w_rows, W, R, num_iters, start_epoch, cache_grams,
+    weighted, checkpoint_dir, fingerprint, mesh, axis, throttle,
+):
+    """The scan-fused solve body: stacked blocks → (optional) one batched
+    factor program → one epochs program (or one per epoch when
+    checkpointing). Returns the same (W blocks, ranges) as the legacy loop."""
+    precision = _precision()
+    nb = len(blocks)
+    a3 = _stack_blocks_fn(mesh, axis, nb)(A.data)
+    if cache_grams:
+        # Chunked like _factor_blocks (shared _factor_chunk policy): bounds
+        # the factor transient to chunk·b² buffers instead of nb·b².
+        chunk = _factor_chunk()
+        factor = _fused_factor_fn(mesh, axis, precision, weighted)
+        if chunk >= nb:
+            invs = factor(a3, lam_arr, w_rows)
+        else:
+            parts = []
+            for c0 in range(0, nb, chunk):
+                part = factor(a3[c0 : c0 + chunk], lam_arr, w_rows)
+                if throttle:
+                    # An unserialized burst of independent collective
+                    # programs deadlocks the CPU in-process rendezvous
+                    # (same guard as _factor_blocks).
+                    part.block_until_ready()
+                parts.append(part)
+            invs = jnp.concatenate(parts, axis=0)
+    else:
+        # Dummy scan operand: the uncached body re-derives each block's
+        # inverse in-place; scan only needs a leading-nb structure to carry.
+        invs = jnp.zeros((nb, 1, 1), dtype=R.dtype)
+    W3 = jnp.stack(W)
+    if checkpoint_dir is None:
+        step = _fused_epochs_fn(
+            mesh, axis, precision, weighted, num_iters - start_epoch,
+            cache_grams,
+        )
+        R, W3 = step(a3, invs, R, W3, lam_arr, w_rows)
+    else:
+        step = _fused_epochs_fn(mesh, axis, precision, weighted, 1, cache_grams)
+        for epoch in range(start_epoch, num_iters):
+            R, W3 = step(a3, invs, R, W3, lam_arr, w_rows)
+            _save_epoch(
+                checkpoint_dir, epoch + 1,
+                [W3[i] for i in range(nb)], R, fingerprint,
+            )
+        wait_for_checkpoints(checkpoint_dir)
+    return [W3[i] for i in range(nb)], blocks
 
 
 def _make_fingerprint(

@@ -363,3 +363,128 @@ def test_gram_and_atb_fused(rng):
     g, ab = Ma.gram_and_atb(Mb)
     np.testing.assert_allclose(g, A.T @ A, rtol=1e-5, atol=1e-4)
     np.testing.assert_allclose(ab, A.T @ B, rtol=1e-5, atol=1e-4)
+
+
+# -- fused scan path vs legacy per-block loop --------------------------------
+
+
+def _both_paths(rng, **kwargs):
+    from keystone_tpu.config import config
+
+    A, B, _ = _problem(rng, n=240, d=32)
+    Ma, Mb = RowMatrix.from_array(A), RowMatrix.from_array(B)
+    config.fused_epochs = None  # auto: fused (blocks tile d)
+    W_f, blocks = block_coordinate_descent(Ma, Mb, **kwargs)
+    config.fused_epochs = False
+    try:
+        W_l, _ = block_coordinate_descent(Ma, Mb, **kwargs)
+    finally:
+        config.fused_epochs = None
+    return A, B, W_f, W_l, blocks
+
+
+def test_fused_matches_legacy_cached(rng):
+    A, B, W_f, W_l, blocks = _both_paths(
+        rng, block_size=8, num_iters=4, lam=0.15, cache_grams=True
+    )
+    assert len(blocks) == 4
+    np.testing.assert_allclose(
+        assemble_blocks(W_f), assemble_blocks(W_l), rtol=1e-4, atol=1e-4
+    )
+    # And both agree with the direct ridge oracle after enough epochs.
+    W_oracle = _ridge_oracle(A, B, 0.15)
+    np.testing.assert_allclose(
+        assemble_blocks(W_f), W_oracle, rtol=5e-2, atol=5e-2
+    )
+
+
+def test_fused_matches_legacy_uncached(rng):
+    _, _, W_f, W_l, _ = _both_paths(
+        rng, block_size=16, num_iters=2, lam=0.3, cache_grams=False
+    )
+    np.testing.assert_allclose(
+        assemble_blocks(W_f), assemble_blocks(W_l), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_fused_matches_legacy_weighted(rng):
+    from keystone_tpu.config import config
+
+    A, B, _ = _problem(rng, n=160, d=16)
+    w = (1.0 + rng.uniform(size=(160,))).astype(np.float32)
+    Ma, Mb = RowMatrix.from_array(A), RowMatrix.from_array(B)
+    kwargs = dict(block_size=8, num_iters=3, lam=0.2, row_weights=w)
+    W_f, _ = block_coordinate_descent(Ma, Mb, **kwargs)
+    config.fused_epochs = False
+    try:
+        W_l, _ = block_coordinate_descent(Ma, Mb, **kwargs)
+    finally:
+        config.fused_epochs = None
+    np.testing.assert_allclose(
+        assemble_blocks(W_f), assemble_blocks(W_l), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_fused_single_block_and_ragged_fallback(rng):
+    # nb=1 exercises the scan's degenerate length; ragged d falls back to
+    # the legacy loop (same answer either way).
+    A, B, _ = _problem(rng, n=120, d=20)
+    Ma, Mb = RowMatrix.from_array(A), RowMatrix.from_array(B)
+    W1, blocks1 = block_coordinate_descent(
+        Ma, Mb, block_size=20, num_iters=2, lam=0.1
+    )
+    assert len(blocks1) == 1
+    W2, blocks2 = block_coordinate_descent(
+        Ma, Mb, block_size=12, num_iters=6, lam=0.1  # ragged: 12 + 8
+    )
+    assert [e - s for s, e in blocks2] == [12, 8]
+    W_oracle = _ridge_oracle(A, B, 0.1)
+    np.testing.assert_allclose(
+        assemble_blocks(W2), W_oracle, rtol=5e-2, atol=5e-2
+    )
+
+
+def test_fused_checkpoint_resume_across_paths(rng, tmp_path):
+    """A fused solve checkpoints per epoch with the same fingerprint as the
+    legacy loop: 2 epochs fused + resume to 4 == 4 epochs straight (legacy),
+    in either direction."""
+    from keystone_tpu.config import config
+
+    A, B, _ = _problem(rng, n=120, d=16)
+    Ma, Mb = RowMatrix.from_array(A), RowMatrix.from_array(B)
+    kwargs = dict(block_size=8, lam=0.1)
+    W_ref, _ = block_coordinate_descent(Ma, Mb, num_iters=4, **kwargs)
+
+    ck = str(tmp_path / "ck")
+    block_coordinate_descent(
+        Ma, Mb, num_iters=2, checkpoint_dir=ck, **kwargs
+    )
+    config.fused_epochs = False  # resume the fused checkpoint on the legacy path
+    try:
+        W_res, _ = block_coordinate_descent(
+            Ma, Mb, num_iters=4, checkpoint_dir=ck, **kwargs
+        )
+    finally:
+        config.fused_epochs = None
+    np.testing.assert_allclose(
+        assemble_blocks(W_res), assemble_blocks(W_ref), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_fused_factor_chunking_matches_whole_batch(rng):
+    """config.factor_batch bounds the fused factor phase's transient (and
+    forces per-block factorization on request) without changing results."""
+    from keystone_tpu.config import config
+
+    A, B, _ = _problem(rng, n=200, d=32)
+    Ma, Mb = RowMatrix.from_array(A), RowMatrix.from_array(B)
+    kwargs = dict(block_size=8, num_iters=3, lam=0.2, cache_grams=True)
+    W_whole, _ = block_coordinate_descent(Ma, Mb, **kwargs)  # auto chunk
+    config.factor_batch = 2  # 4 blocks → two chunked factor programs
+    try:
+        W_chunk, _ = block_coordinate_descent(Ma, Mb, **kwargs)
+    finally:
+        config.factor_batch = None
+    np.testing.assert_allclose(
+        assemble_blocks(W_whole), assemble_blocks(W_chunk), rtol=1e-5, atol=1e-5
+    )
