@@ -318,15 +318,28 @@ def _block_update_fn(mesh: Mesh, axis: str, precision, weighted: bool):
     return jax.jit(sm, donate_argnums=_donate(mesh, 1, 2))
 
 
-def _factor_chunk() -> int:
+def _factor_chunk(block_size: Optional[int] = None) -> int:
     """Blocks factorized per batched XLA program — THE single chunk policy
     for both the legacy and fused factor phases. Auto: batching amortizes
     TPU's sequential factorization lowering, but measured 2.3× slower than
     independent per-block programs on the CPU backend — there, per-block.
-    An explicit config.factor_batch forces that chunk on any backend."""
-    if config.factor_batch is None:
-        return 1 if jax.default_backend() == "cpu" else 16
-    return max(1, int(config.factor_batch))
+    An explicit config.factor_batch forces that chunk on any backend.
+
+    The auto chunk is additionally MEMORY-capped: XLA's batched
+    triangular-solve lowering holds a handful of (chunk, b, b) HLO temps,
+    so an uncapped chunk·b² OOMs HBM at large blocks — the deviceless v5e
+    AOT compile of the ImageNet bench shape (chunk 8 · b 8192) demanded
+    >16 GiB of temps. Capping chunk·b² at 128M f32 elements (512 MB per
+    temp) keeps the factor transient ~1-2 GiB: b=8192 factors per-block,
+    b≤2896 keeps the full batch of 16."""
+    if config.factor_batch is not None:
+        return max(1, int(config.factor_batch))
+    if jax.default_backend() == "cpu":
+        return 1
+    chunk = 16
+    if block_size:
+        chunk = min(chunk, max(1, (128 << 20) // (block_size * block_size)))
+    return chunk
 
 
 def _factor_blocks(
@@ -344,7 +357,7 @@ def _factor_blocks(
     n_eq = len(blocks)
     if n_eq > 1 and blocks[-1][1] - blocks[-1][0] != blocks[0][1] - blocks[0][0]:
         n_eq -= 1  # ragged tail handled per-block below
-    chunk = _factor_chunk()
+    chunk = _factor_chunk(blocks[0][1] - blocks[0][0])
     invs: list = []
     # A singleton final chunk would pay a fresh (1,b,b) batched compile and
     # lose gram/factor fusion; leave it to the fused per-block path below.
@@ -525,7 +538,7 @@ def _solve_fused(
     if cache_grams:
         # Chunked like _factor_blocks (shared _factor_chunk policy): bounds
         # the factor transient to chunk·b² buffers instead of nb·b².
-        chunk = _factor_chunk()
+        chunk = _factor_chunk(blocks[0][1] - blocks[0][0])
         factor = _fused_factor_fn(mesh, axis, precision, weighted)
         if chunk >= nb:
             invs = factor(a3, lam_arr, w_rows)

@@ -66,6 +66,11 @@ class AmazonReviewsDataLoader:
                 r.shuffle(words)
                 texts.append(" ".join(words))
                 labels.append(1 if pos else 0)
-            return LabeledData(texts, np.asarray(labels, dtype=np.int32))
+            from keystone_tpu.loaders.synthetic import with_label_noise
+
+            labels = with_label_noise(
+                np.asarray(labels, dtype=np.int32), 2, r
+            )
+            return LabeledData(texts, labels)
 
         return make(n, 1), make(max(n // 4, 100), 2)

@@ -54,6 +54,9 @@ class CifarLoader:
             r = np.random.default_rng(seed + off)
             y = r.integers(0, num_classes, size=count)
             X = protos[y] + 0.25 * r.normal(size=(count, 32, 32, 3))
+            from keystone_tpu.loaders.synthetic import with_label_noise
+
+            y = with_label_noise(y, num_classes, r)
             return LabeledData(
                 np.clip(X, 0, 1).astype(config.default_dtype),
                 y.astype(np.int32),

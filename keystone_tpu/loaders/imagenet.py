@@ -300,6 +300,9 @@ class ImageNetLoader:
             base = textures[y][..., None]  # (count, size, size, 1)
             tint = 0.5 + 0.5 * r.uniform(size=(count, 1, 1, 3))
             X = base * tint + 0.15 * r.normal(size=(count, size, size, 3))
+            from keystone_tpu.loaders.synthetic import with_label_noise
+
+            y = with_label_noise(y, num_classes, r)
             return LabeledData(
                 np.clip(X, 0, 1).astype(config.default_dtype),
                 y.astype(np.int32),

@@ -69,6 +69,9 @@ class MnistLoader:
                 protos[y], ((0, 0), (0, dim - 784))
             )
             X = X + 0.35 * r.normal(size=X.shape)
+            from keystone_tpu.loaders.synthetic import with_label_noise
+
+            y = with_label_noise(y, num_classes, r)
             return LabeledData(
                 X.astype(config.default_dtype), y.astype(np.int32)
             )

@@ -86,7 +86,12 @@ class NewsgroupsDataLoader:
                 r.shuffle(words)
                 texts.append(" ".join(words))
                 labels.append(c)
-            return LabeledData(texts, np.asarray(labels, dtype=np.int32))
+            from keystone_tpu.loaders.synthetic import with_label_noise
+
+            labels = with_label_noise(
+                np.asarray(labels, dtype=np.int32), num_classes, r
+            )
+            return LabeledData(texts, labels)
 
         names = [t[0] for t in _TOPICS[:num_classes]]
         return make(n, 1), make(max(n // 4, 100), 2), names

@@ -62,6 +62,9 @@ class TimitFeaturesDataLoader:
                 )
             X = np.concatenate(frames, axis=1)
             assert X.shape[1] == dim
+            from keystone_tpu.loaders.synthetic import with_label_noise
+
+            y = with_label_noise(y, num_phones, r)
             return LabeledData(
                 X.astype(config.default_dtype), y.astype(np.int32)
             )

@@ -96,6 +96,9 @@ class VOCLoader:
                     patch = textures[c][top : top + s, left : left + s]
                     ch = c % 3
                     X[i, top : top + s, left : left + s, ch] += patch
+            from keystone_tpu.loaders.synthetic import with_label_noise
+
+            Y = with_label_noise(Y, num_classes, r)
             return LabeledData(
                 np.clip(X, 0, 1).astype(config.default_dtype), Y
             )

@@ -10,8 +10,16 @@ block weighted least squares; top-5 error via TopKClassifier.
 
 TPU notes: each branch's PCA→FV→normalize tail fuses into one XLA
 computation; the gathered 2·(2·k·pca_dims)-dim features feed the
-psum-reduced weighted BCD solver. With k=256, pca=64: 64k-dim features —
-the reference's headline scale.
+psum-reduced weighted BCD solver.
+
+Full-scale config (the REAL-DATA default via resolve_scale, matching
+BASELINE.json "64k-dim"):
+
+    pca_dims=64  gmm_k=256  → feature_dim = 2·(2·256·64) = 65,536
+    solver: weighted BCD, block_size=auto (HBM-safe, 8192 cap), 3 epochs
+
+Synthetic/CI runs default to gmm_k=16 (4,096-dim) so smoke tests stay
+fast; pass --gmm-k 256 to force the headline scale anywhere.
 """
 
 from __future__ import annotations
@@ -46,13 +54,18 @@ class ImageNetSiftLcsFVConfig:
     lcs_step: int = 4
     lcs_bin: int = 4
     pca_dims: int = 64
-    gmm_k: int = 16
+    # None = resolve by data source (resolve_scale): REAL data gets the
+    # reference headline config — gmm_k=256 → 2·(2·256·64) = 65,536-dim
+    # gathered features (BASELINE.json "64k-dim"), 3 solver epochs — while
+    # the synthetic/CI path keeps gmm_k=16 (4,096-dim) so smoke runs stay
+    # minutes, not hours. An explicit value always wins.
+    gmm_k: Optional[int] = None
     gmm_iters: int = 20
     descriptor_sample: int = 200_000
     lam: float = 1e-3
     mixture_weight: float = 0.5
-    block_size: int = 4096
-    num_iters: int = 2
+    block_size: "int | str" = "auto"  # resolve_block_size: HBM-safe, 8192 cap
+    num_iters: Optional[int] = None
     top_k: int = 5
     # Test-time augmentation: score center+corner crops (flipped too) per
     # image and average (Ref: AugmentedExamplesEvaluator, SURVEY.md §2.10).
@@ -70,6 +83,22 @@ class ImageNetSiftLcsFVConfig:
     stream: bool = False
     stream_batch: int = 256
     fit_sample_images: int = 512
+
+
+def resolve_scale(conf: ImageNetSiftLcsFVConfig) -> ImageNetSiftLcsFVConfig:
+    """Fill gmm_k/num_iters by data source: the real-data path defaults to
+    the reference's full-scale config (64k-dim features, 3 epochs), the
+    synthetic path to CI scale. Called once at the top of run()."""
+    from dataclasses import replace
+
+    real = conf.data_path is not None
+    return replace(
+        conf,
+        gmm_k=conf.gmm_k if conf.gmm_k is not None else (256 if real else 16),
+        num_iters=(
+            conf.num_iters if conf.num_iters is not None else (3 if real else 2)
+        ),
+    )
 
 
 def build_featurizer(conf: ImageNetSiftLcsFVConfig, train_images) -> Pipeline:
@@ -214,6 +243,7 @@ def run_streamed(conf: ImageNetSiftLcsFVConfig) -> dict:
 
 
 def run(conf: ImageNetSiftLcsFVConfig) -> dict:
+    conf = resolve_scale(conf)
     if conf.stream:
         return run_streamed(conf)
     if conf.data_path:
@@ -279,7 +309,9 @@ def main(argv=None):
     p.add_argument("--test-data", dest="test_data_path")
     p.add_argument("--label-map", dest="label_map_path")
     p.add_argument("--pca-dims", type=int, default=64)
-    p.add_argument("--gmm-k", type=int, default=16)
+    p.add_argument("--gmm-k", type=int, default=None,
+                   help="GMM components per branch (default: 256 with real "
+                   "data = the reference's 64k-dim config; 16 synthetic)")
     p.add_argument("--lam", type=float, default=1e-3)
     p.add_argument("--mixture-weight", type=float, default=0.5)
     p.add_argument("--top-k", type=int, default=5)

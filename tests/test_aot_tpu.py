@@ -240,3 +240,70 @@ def test_convolver_compiles_for_v5e(mesh):
     )
     compiled = jax.jit(conv.apply_batch).lower(x).compile()
     assert "convolution" in compiled.as_text()
+
+
+def test_fused_solver_programs_compile_for_v5e(mesh):
+    """The r4 scan-fused solve (stack → batched factor → scanned epochs)
+    — the three programs the bench now times — must XLA:TPU-compile."""
+    from keystone_tpu.linalg.bcd import (
+        _fused_epochs_fn,
+        _fused_factor_fn,
+        _stack_blocks_fn,
+    )
+    from keystone_tpu.linalg.row_matrix import _precision
+
+    n, d, b, k, nb = 1024, 512, 128, 16, 4
+    stack = _stack_blocks_fn(mesh, AXIS, nb)
+    c0 = stack.lower(_sds((n, d), mesh, P(AXIS))).compile()
+    assert _compiled_ok(c0)
+    factor = _fused_factor_fn(mesh, AXIS, _precision(), False)
+    c1 = factor.lower(
+        _sds((nb, n, b), mesh, P(None, AXIS)),
+        _sds((), mesh, P()),
+        _sds((n,), mesh, P(AXIS)),
+    ).compile()
+    assert "all-reduce" in c1.as_text()
+    epochs = _fused_epochs_fn(mesh, AXIS, _precision(), False, 3, True)
+    c2 = epochs.lower(
+        _sds((nb, n, b), mesh, P(None, AXIS)),
+        _sds((nb, b, b), mesh, P()),
+        _sds((n, k), mesh, P(AXIS)),
+        _sds((nb, b, k), mesh, P()),
+        _sds((), mesh, P()),
+        _sds((n,), mesh, P(AXIS)),
+    ).compile()
+    text = c2.as_text()
+    assert "while" in text  # the scanned epoch/block loops
+    assert "all-reduce" in text
+
+
+@pytest.mark.slow
+def test_fused_solver_compiles_at_imagenet_bench_shape(mesh):
+    """bench.SCALE['tpu-imagenet'] (n=8192, d=65536, k=1000, block=8192):
+    the at-shape silicon bench the north star consumes must not hit its
+    first XLA:TPU compile inside a live window."""
+    import bench as bench_mod
+    from keystone_tpu.linalg.bcd import _fused_epochs_fn, _fused_factor_fn
+    from keystone_tpu.linalg.row_matrix import _precision
+
+    p = bench_mod.SCALE["tpu-imagenet"]
+    n, d, k, b = p["n"], p["d"], p["k"], p["block"]
+    nb = d // b
+    one = Mesh(np.array(mesh.devices.flat[:1]), (AXIS,))
+    factor = _fused_factor_fn(one, AXIS, _precision(), False)
+    c1 = factor.lower(
+        _sds((nb, n, b), one, P(None, AXIS)),
+        _sds((), one, P()),
+        _sds((n,), one, P(AXIS)),
+    ).compile()
+    assert _compiled_ok(c1)
+    epochs = _fused_epochs_fn(one, AXIS, _precision(), False, p["iters"], True)
+    c2 = epochs.lower(
+        _sds((nb, n, b), one, P(None, AXIS)),
+        _sds((nb, b, b), one, P()),
+        _sds((n, k), one, P(AXIS)),
+        _sds((nb, b, k), one, P()),
+        _sds((), one, P()),
+        _sds((n,), one, P(AXIS)),
+    ).compile()
+    assert _compiled_ok(c2)

@@ -147,3 +147,24 @@ class TestXlaSift:
         assert not SIFTExtractor(step=4, backend="native").jittable
         with pytest.raises(ValueError):
             SIFTExtractor(backend="cuda")
+
+
+@pytest.mark.slow
+def test_xla_sift_parity_at_reference_geometry(rng):
+    """256px / step 4 / bin 4 — the EXACT geometry the host-elimination
+    claim rides on (tools/northstar.py): parity with the native kernel AND
+    descriptor-count equality with HOSTBENCH's 3,721/img grid
+    (VERDICT r3 weak #7 / next #7)."""
+    from keystone_tpu import native
+
+    if not native.available():
+        pytest.skip(f"native lib unavailable: {native.build_error()}")
+    from keystone_tpu.ops.sift_xla import dense_sift_xla
+
+    imgs = rng.uniform(size=(4, 256, 256)).astype(np.float32)
+    ref = native.dense_sift(imgs, step=4, bin_size=4)
+    got = np.asarray(dense_sift_xla(imgs, step=4, bin_size=4))
+    assert got.shape == ref.shape
+    # (256 - 16)/4 + 1 = 61 keypoints per axis -> 3721/img (HOSTBENCH.json).
+    assert got.shape[1] == 61 * 61 == 3721
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)

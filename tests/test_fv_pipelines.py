@@ -229,3 +229,23 @@ def test_imagenet_with_test_time_augmentation():
     # top-5 floor (1/6) is too close to the threshold to be meaningful.
     assert out["top_1_error"] < 0.3, out["summary"]
     assert out["top_k_error"] < 0.1, out["summary"]
+
+
+def test_imagenet_resolve_scale_defaults():
+    """Real data defaults to the reference's 64k-dim headline config
+    (gmm_k=256, 3 epochs — BASELINE.json); synthetic stays CI-scale; an
+    explicit value always wins (VERDICT r3 missing #4)."""
+    from keystone_tpu.pipelines.images.imagenet_sift_lcs_fv import (
+        ImageNetSiftLcsFVConfig,
+        resolve_scale,
+    )
+
+    real = resolve_scale(ImageNetSiftLcsFVConfig(data_path="/d"))
+    assert (real.gmm_k, real.num_iters) == (256, 3)
+    assert 2 * (2 * real.gmm_k * real.pca_dims) == 65_536
+    synth = resolve_scale(ImageNetSiftLcsFVConfig())
+    assert (synth.gmm_k, synth.num_iters) == (16, 2)
+    explicit = resolve_scale(
+        ImageNetSiftLcsFVConfig(data_path="/d", gmm_k=32, num_iters=1)
+    )
+    assert (explicit.gmm_k, explicit.num_iters) == (32, 1)

@@ -198,15 +198,25 @@ def _imagenet(root):
 # low-confidence reconstructions — marked provisional). Synthetic floors:
 # the test suite's pinned values (tests/test_*_pipeline*.py).
 PIPELINES = {
-    "MnistRandomFFT": (_mnist, "test_accuracy", 0.96, 0.96, True, "BASELINE.md"),
+    # CI floors assume the synthetic label-noise band (SYNTH_LABEL_NOISE
+    # flips 10% of labels → even a perfect model scores ≈ 0.9 + 0.1/C on
+    # accuracy metrics), so they sit BELOW the old separable-data values:
+    # the run must land strictly between floor and ceiling to pass.
+    "MnistRandomFFT": (_mnist, "test_accuracy", 0.96, 0.85, True, "BASELINE.md"),
     "LinearPixels": (_linear_pixels, "test_accuracy", 0.30, 0.50, True, "provisional"),
-    "RandomPatchCifar": (_cifar, "test_accuracy", 0.80, 0.80, True, "BASELINE.md (84-85% full config)"),
-    "NewsgroupsPipeline": (_newsgroups, "test_accuracy", 0.75, 0.90, True, "provisional"),
-    "AmazonReviewsPipeline": (_amazon, "auc", 0.85, 0.95, True, "provisional"),
-    "TimitPipeline": (_timit, "phone_error_rate", 0.40, 0.15, False, "BASELINE.md (PER 33-34% full config)"),
-    "VOCSIFTFisher": (_voc, "map", 0.45, 0.70, True, "provisional"),
+    "RandomPatchCifar": (_cifar, "test_accuracy", 0.80, 0.78, True, "BASELINE.md (84-85% full config)"),
+    "NewsgroupsPipeline": (_newsgroups, "test_accuracy", 0.75, 0.80, True, "provisional"),
+    "AmazonReviewsPipeline": (_amazon, "auc", 0.85, 0.85, True, "provisional"),
+    "TimitPipeline": (_timit, "phone_error_rate", 0.40, 0.20, False, "BASELINE.md (PER 33-34% full config)"),
+    "VOCSIFTFisher": (_voc, "map", 0.45, 0.50, True, "provisional"),
     "ImageNetSiftLcsFV": (_imagenet, "top_k_error", 0.40, 0.60, False, "BASELINE.md (top-5 err 32-33% full config)"),
 }
+
+# Label-noise rate injected into the synthetic generators (overridable via
+# a pre-set KEYSTONE_SYNTH_LABEL_NOISE). 0.1 puts every metric's
+# best-possible value visibly below 1.0, making the floor/ceiling band
+# meaningful.
+SYNTH_LABEL_NOISE = 0.1
 
 
 def main(argv=None) -> int:
@@ -232,6 +242,18 @@ def main(argv=None) -> int:
     if env_forces_cpu():
         force_cpu()
 
+    # Synthetic mode injects the known label-noise overlap so the floors
+    # BIND (a 1.0 score now means the band check failed, not success); an
+    # explicitly pre-set env value wins, and the default is restored after
+    # the run so in-process callers (tests) don't leak noise into other
+    # synthetic users.
+    noise_preset = os.environ.get("KEYSTONE_SYNTH_LABEL_NOISE")
+    if args.synthetic and noise_preset is None:
+        os.environ["KEYSTONE_SYNTH_LABEL_NOISE"] = str(SYNTH_LABEL_NOISE)
+    from keystone_tpu.loaders.synthetic import label_noise_rate
+
+    noise = label_noise_rate() if args.synthetic else 0.0
+
     names = args.pipelines or list(PIPELINES)
     rows, failures = [], 0
     def emit(name, key, value, floor, status, dt, note):
@@ -250,31 +272,53 @@ def main(argv=None) -> int:
                           "note": note,
                           "seconds": round(dt, 1)}), flush=True)
 
-    for name in names:
-        runner, key, real_floor, ci_floor, higher, src = PIPELINES[name]
-        floor = ci_floor if args.synthetic else real_floor
-        t0 = time.time()
-        try:
-            out = runner(root)
-        except Exception as e:  # a crash is a FAIL, not an abort
-            err = f"{type(e).__name__}: {e}"
+    try:
+        for name in names:
+            runner, key, real_floor, ci_floor, higher, src = PIPELINES[name]
+            floor = ci_floor if args.synthetic else real_floor
+            t0 = time.time()
+            try:
+                out = runner(root)
+            except Exception as e:  # a crash is a FAIL, not an abort
+                err = f"{type(e).__name__}: {e}"
+                dt = time.time() - t0
+                rows.append((name, key, None, floor, "ERROR", dt, err))
+                failures += 1
+                emit(name, key, None, floor, "ERROR", dt, err)
+                continue
             dt = time.time() - t0
-            rows.append((name, key, None, floor, "ERROR", dt, err))
-            failures += 1
-            emit(name, key, None, floor, "ERROR", dt, err)
-            continue
-        dt = time.time() - t0
-        if out is None:
-            rows.append((name, key, None, floor, "SKIP", dt, "no data"))
-            emit(name, key, None, floor, "SKIP", dt, "no data")
-            continue
-        value = out.get(key)
-        ok = value is not None and (value >= floor if higher else value <= floor)
-        status = "PASS" if ok else "FAIL"
-        rows.append((name, key, value, floor, status, dt, src))
-        if not ok:
-            failures += 1
-        emit(name, key, value, floor, status, dt, src)
+            if out is None:
+                rows.append((name, key, None, floor, "SKIP", dt, "no data"))
+                emit(name, key, None, floor, "SKIP", dt, "no data")
+                continue
+            value = out.get(key)
+            ok = value is not None and (
+                value >= floor if higher else value <= floor
+            )
+            if ok and noise > 0.0:
+                # The binding band: with flip rate p even a perfect model
+                # scores ≈ 1-p+p/C, so an accuracy at/above 1-p/2 (or an
+                # error below p/8) means the noise never reached the
+                # metric — the harness is validating plumbing again.
+                band_ok = (
+                    value <= 1.0 - noise / 2.0
+                    if higher
+                    else value >= noise / 8.0
+                )
+                if not band_ok:
+                    ok = False
+                    src = (
+                        f"OUT OF BAND (noise p={noise}): metric "
+                        "unreachable by a noisy-label run — floor not binding"
+                    )
+            status = "PASS" if ok else "FAIL"
+            rows.append((name, key, value, floor, status, dt, src))
+            if not ok:
+                failures += 1
+            emit(name, key, value, floor, status, dt, src)
+    finally:
+        if args.synthetic and noise_preset is None:
+            del os.environ["KEYSTONE_SYNTH_LABEL_NOISE"]
 
     op = {True: ">=", False: "<="}
     print(f"\n{'pipeline':<22} {'metric':<18} {'value':>8} {'floor':>8}  verdict  {'sec':>7}  source")

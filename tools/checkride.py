@@ -50,15 +50,29 @@ import bench  # repo-root bench.py: worker protocol, scales, plausible peaks
 STEPS = (
     "bench_f32",
     "pallas_fv",
+    "roofline",
     "bench_bf16",
+    "bench_trace",
     "streamed_overlap",
     "memory_stats",
     "featurize",
     "factor_primitives",
+    "pipeline_rate",
     "acceptance_synthetic",
+    "bench_imagenet",
     "mfu_sweep",
     "bench_xl",
     "entry_compile",
+)
+
+# Steps whose results describe the SOLVER's code path: a checkpoint from an
+# older solver revision (bench.SOLVER_REV mismatch) is stale — re-measure
+# on the next live window instead of skipping, and never report it as this
+# round's number. Non-solver steps (pallas_fv, featurize, ...) keep their
+# evidence across solver changes.
+BENCH_FAMILY = frozenset(
+    ("bench_f32", "bench_bf16", "bench_xl", "bench_imagenet", "mfu_sweep",
+     "bench_trace")
 )
 
 
@@ -105,6 +119,7 @@ def _write_report(state_dir: str, report_path: str, meta: dict) -> None:
         and not r.get("partial")
         and not r.get("quick_scale")
         and "error" not in r
+        and not (s in BENCH_FAMILY and r.get("solver_rev") != bench.SOLVER_REV)
     ]
     report = {
         "meta": meta,
@@ -112,6 +127,21 @@ def _write_report(state_dir: str, report_path: str, meta: dict) -> None:
         "complete_on_tpu": sorted(on_tpu) == sorted(STEPS),
         "steps": steps,
     }
+    # MFU against the chip's MEASURED gemm peak (the roofline step), not
+    # the guessed PLAUSIBLE_PEAK constants — the honest denominator the
+    # round-3 verdict asked for.
+    roof = steps.get("roofline") or {}
+    peaks = roof.get("measured_peak_tflops")
+    if peaks and roof.get("ok") and roof.get("backend") == "tpu":
+        report["measured_peak_tflops"] = peaks
+        for name in ("bench_f32", "bench_bf16", "bench_imagenet", "bench_xl"):
+            r = steps.get(name)
+            if r and r.get("tflops_per_chip") and r.get("backend") == "tpu":
+                pk = peaks.get("bf16" if name.endswith("bf16") else "f32")
+                if pk:
+                    r["mfu_vs_measured_peak"] = round(
+                        r["tflops_per_chip"] / pk, 4
+                    )
     tmp = report_path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(report, f, indent=1)
@@ -176,20 +206,25 @@ def run_bench_step(step: str, target: str, quick: bool, timeout: float) -> dict:
     make every live-TPU bench fail with 'TPU already in use'."""
     dtype = "bf16" if step.endswith("bf16") else "f32"
     env = _step_env(target, quick)
-    if step == "bench_xl":
-        # Reference-scale d=262144 (SURVEY.md §6 TIMIT/CIFAR dims). Only
-        # meaningful on a live chip at full scale; --quick keeps the quick
-        # harness-validation scale even on TPU (a multi-minute XL solve
-        # would burn the short live window quick mode protects), and the
-        # chip-down path skips outright — its config would duplicate
-        # bench_f32 byte for byte.
+    if step in ("bench_xl", "bench_imagenet"):
+        # Full-scale-only rows: bench_xl is reference-scale d=262144
+        # (SURVEY.md §6 TIMIT/CIFAR dims); bench_imagenet is the ImageNet
+        # headline shape d=65536/k=1000 (SURVEY.md §2.11) whose at-shape
+        # rate the north-star projection consumes directly. Only meaningful
+        # on a live chip at full scale; --quick keeps the quick
+        # harness-validation scale even on TPU (a multi-minute solve would
+        # burn the short live window quick mode protects), and the
+        # chip-down path skips outright — a CPU-degraded config would
+        # duplicate bench_f32's evidence class without being either a
+        # harness test or a perf claim.
         if target != "tpu":
             return {
                 "ok": True,
                 "backend": target,
                 "skipped": "off-tpu: would duplicate bench_f32's config",
             }
-        scale = "tpu-xl" if not quick else _bench_scale_for(target, quick)
+        full_scale = {"bench_xl": "tpu-xl", "bench_imagenet": "tpu-imagenet"}
+        scale = full_scale[step] if not quick else _bench_scale_for(target, quick)
     else:
         scale = _bench_scale_for(target, quick)
     r = bench._run_worker(env, scale, dtype, timeout)
@@ -241,13 +276,15 @@ def run_mfu_sweep(
         return dict(prior, preserved_tpu_rows=True)
     # Resume only rows measured at this scale AND on this backend target —
     # in quick mode the scale is "quick" for both backends, and mixing
-    # CPU-measured rows into a TPU-tagged result would fake evidence.
+    # CPU-measured rows into a TPU-tagged result would fake evidence. Rows
+    # from an older solver revision measured retired code: start fresh.
     rows = [
         r
         for r in prior.get("rows", [])
         if "error" not in r
         and prior.get("scale") == scale
         and prior.get("backend") == target
+        and prior.get("solver_rev") == bench.SOLVER_REV
     ]
     done = {(r["dtype"], r["block"]) for r in rows}
     backend = prior.get("backend", target)
@@ -280,6 +317,7 @@ def run_mfu_sweep(
                         "ok": bool(done),
                         "backend": backend,
                         "scale": scale,
+                        "solver_rev": bench.SOLVER_REV,
                         "rows": rows,
                         "error": "tpu died mid-sweep",
                         # ok may be True (completed rows survive), so the
@@ -313,6 +351,7 @@ def run_mfu_sweep(
                     "ok": True,
                     "backend": backend,
                     "scale": scale,
+                    "solver_rev": bench.SOLVER_REV,
                     "rows": rows,
                     "partial": True,
                     "step": step,
@@ -503,6 +542,12 @@ def orchestrate(args) -> int:
                 # block a full-scale re-measure.
                 and (not prior.get("quick_scale") or args.quick)
                 and "error" not in prior
+                # A bench-family checkpoint from an older solver revision
+                # measured code this round no longer ships — re-measure.
+                and not (
+                    step in BENCH_FAMILY
+                    and prior.get("solver_rev") != bench.SOLVER_REV
+                )
             )
             if complete and (prior.get("backend") == "tpu" or target == "cpu"):
                 print(
@@ -529,6 +574,12 @@ def orchestrate(args) -> int:
         else:
             result = _run_step(step, target, args.quick, args.step_timeout)
         result["step"] = step
+        if step in BENCH_FAMILY:
+            # setdefault: a preserved prior (e.g. the sweep's CPU-rerun
+            # guard returning checkpointed TPU rows) keeps the revision it
+            # was MEASURED at — stamping it current would relabel old-rev
+            # evidence as this solver's.
+            result.setdefault("solver_rev", bench.SOLVER_REV)
         if args.quick:
             result["quick_scale"] = True
         _save_state(state_dir, step, result)
@@ -741,6 +792,311 @@ def step_memory_stats() -> dict:
     }
 
 
+def step_roofline() -> dict:
+    """Measured-peak roofline on the solver's own op shapes: pure gemms
+    (gram-shaped and square, f32-HIGHEST and bf16) plus the factorization
+    primitives at the solver block size. The gemm peaks become the MFU
+    denominators for every bench row (vs the guessed PLAUSIBLE_PEAK
+    constants), and the factor rates bound how much of the solve can ever
+    be MXU-bound. Ref: SURVEY.md §6 north-star metric #2."""
+    backend = _backend()
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    import bench
+
+    full = backend == "tpu" and not _quick()
+    # Gram shape matches the bench solve's dominant gemm; square is the
+    # MXU-friendliest shape the chip will ever see (the true ceiling).
+    n, b, sq = (32768, 4096, 8192) if full else (2048, 256, 512)
+
+    def timed(fn, *args):
+        jax.block_until_ready(fn(*args))  # compile + warm
+        reps, total = 0, 0.0
+        while total < 1.0 and reps < 20:
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            total += time.perf_counter() - t0
+            reps += 1
+        return total / reps
+
+    rng = np.random.default_rng(0)
+    rows, peaks = {}, {}
+    for key, dtype in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
+        prec = (
+            lax.Precision.HIGHEST if key == "f32" else lax.Precision.DEFAULT
+        )
+
+        @jax.jit
+        def mm(x, y, _p=prec):
+            return jnp.matmul(
+                x, y, precision=_p, preferred_element_type=jnp.float32
+            )
+
+        x = jnp.asarray(rng.normal(size=(b, n)), dtype=dtype)
+        y = jnp.asarray(rng.normal(size=(n, b)), dtype=dtype)
+        dt = timed(mm, x, y)
+        gram_tf = 2.0 * b * b * n / dt / 1e12
+        rows[f"gram_gemm_{key}"] = {
+            "shape": [b, n, b], "seconds": round(dt, 5),
+            "tflops": round(gram_tf, 2),
+        }
+        xs = jnp.asarray(rng.normal(size=(sq, sq)), dtype=dtype)
+        ys = jnp.asarray(rng.normal(size=(sq, sq)), dtype=dtype)
+        dts = timed(mm, xs, ys)
+        sq_tf = 2.0 * sq**3 / dts / 1e12
+        rows[f"square_gemm_{key}"] = {
+            "shape": [sq, sq, sq], "seconds": round(dts, 5),
+            "tflops": round(sq_tf, 2),
+        }
+        peaks[key] = round(max(gram_tf, sq_tf), 2)
+
+    # Factorization primitives at the solver block size (f32, like the
+    # solver's accum dtype): single vs batch-8 SPD inverse — the measured
+    # basis for the _factor_chunk batching policy.
+    from keystone_tpu.linalg.bcd import _batched_spd_inv
+
+    xg = jnp.asarray(rng.normal(size=(b, b)), dtype=jnp.float32)
+    g = (xg @ xg.T) / b + jnp.eye(b, dtype=jnp.float32)
+    inv_flops = b**3 / 3.0 + 2.0 * b**3
+    binv = jax.jit(_batched_spd_inv)
+    dt1 = timed(binv, g[None])
+    g8 = jnp.repeat(g[None], 8, axis=0)
+    dt8 = timed(binv, g8)
+    rows["spd_inverse_single"] = {
+        "b": b, "seconds": round(dt1, 5),
+        "tflops": round(inv_flops / dt1 / 1e12, 2),
+    }
+    rows["spd_inverse_batch8"] = {
+        "b": b, "seconds": round(dt8, 5),
+        "tflops": round(8 * inv_flops / dt8 / 1e12, 2),
+        "speedup_vs_8_singles": round(8 * dt1 / dt8, 2),
+    }
+
+    suspect = any(
+        peaks[key] > bench.PLAUSIBLE_PEAK_TFLOPS[key] * 1.1 for key in peaks
+    )
+    out = {
+        "ok": not suspect,
+        "backend": backend,
+        "full_scale": full,
+        "measured_peak_tflops": peaks,
+        "rows": rows,
+    }
+    if suspect:
+        out["error"] = "suspect_timing: gemm measured above plausible peak"
+    return out
+
+
+def step_bench_trace() -> dict:
+    """Phase-decomposed fused bench solve + an xprof trace artifact.
+
+    Answers the round-3 verdict's #1 open question — where do the other
+    ~90% of peak go? — by timing the solve's three programs (stack /
+    factor / epochs) and the result fetch separately, each with its own
+    FLOP count, then capturing a jax.profiler trace of one full solve for
+    offline op-level attribution."""
+    backend = _backend()
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import bench
+    from keystone_tpu.config import config
+    from keystone_tpu.linalg import RowMatrix, bcd, block_coordinate_descent
+    from keystone_tpu.linalg.row_matrix import _precision
+
+    p = bench.SCALE["quick" if _quick() else ("tpu" if backend == "tpu" else "cpu")]
+    n, d, k, block, iters = p["n"], p["d"], p["k"], p["block"], p["iters"]
+    rng = np.random.default_rng(0)
+    A = rng.normal(size=(n, d)).astype(np.float32)
+    B = (A @ rng.normal(size=(d, k)).astype(np.float32)).astype(np.float32)
+    Ma, Mb = RowMatrix.from_array(A), RowMatrix.from_array(B)
+    mesh, axis = Ma.mesh, config.data_axis
+    precision = _precision()
+    nb = d // block
+    lam = jnp.asarray(1e-3, jnp.float32)
+    w_rows = jax.device_put(
+        jnp.zeros((Ma.padded_rows,), jnp.float32),
+        NamedSharding(mesh, P(axis)),
+    )
+
+    def timed(fn, reps=3):
+        fn()  # compile + warm
+        total = 0.0
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            total += time.perf_counter() - t0
+        return total / reps
+
+    stack_fn = bcd._stack_blocks_fn(mesh, axis, nb)
+    a3 = stack_fn(Ma.data)
+    stack_s = timed(lambda: jax.block_until_ready(stack_fn(Ma.data)))
+
+    factor_fn = bcd._fused_factor_fn(mesh, axis, precision, False)
+    invs = factor_fn(a3, lam, w_rows)
+    factor_s = timed(
+        lambda: jax.block_until_ready(factor_fn(a3, lam, w_rows))
+    )
+    factor_flops = nb * (2.0 * n * block**2 + block**3 / 3.0 + 2.0 * block**3)
+
+    ep_fn = bcd._fused_epochs_fn(mesh, axis, precision, False, iters, True)
+
+    def run_epochs():
+        # The epochs program DONATES residual and weights — rebuild fresh
+        # carries per rep (outside would hide the donation's benefit;
+        # inside costs two small allocs, consistent across reps).
+        R = jnp.array(Mb.data, dtype=jnp.float32)
+        W3 = jnp.zeros((nb, block, k), dtype=jnp.float32)
+        R, W3 = ep_fn(a3, invs, R, W3, lam, w_rows)
+        jax.block_until_ready(W3)
+        return W3
+
+    epochs_s = timed(run_epochs)
+    epoch_flops = nb * iters * (6.0 * n * block * k + 2.0 * block * block * k)
+    W3 = run_epochs()
+    fetch_s = timed(lambda: np.asarray(W3[-1][-1, -1]))
+
+    # End-to-end through the public API (same path the bench times): the
+    # gap between this and the phase sum is dispatch/host overhead.
+    def run_public():
+        W, _ = block_coordinate_descent(
+            Ma, Mb, block_size=block, num_iters=iters, lam=1e-3,
+            cache_grams=True,
+        )
+        np.asarray(W[-1][-1, -1])
+
+    e2e_s = timed(run_public)
+
+    trace_info = None
+    if backend == "tpu":
+        trace_dir = os.path.join(REPO, ".checkride", "xprof")
+        os.makedirs(trace_dir, exist_ok=True)
+        with jax.profiler.trace(trace_dir):
+            run_public()
+        n_files, n_bytes = 0, 0
+        for root, _dirs, files in os.walk(trace_dir):
+            for fname in files:
+                n_files += 1
+                n_bytes += os.path.getsize(os.path.join(root, fname))
+        trace_info = {"dir": trace_dir, "files": n_files, "bytes": n_bytes}
+
+    phase_sum = stack_s + factor_s + epochs_s + fetch_s
+    return {
+        "ok": True,
+        "backend": backend,
+        "config": {"n": n, "d": d, "k": k, "block": block, "epochs": iters},
+        "phases": {
+            "stack": {"seconds": round(stack_s, 4)},
+            "factor": {
+                "seconds": round(factor_s, 4),
+                "tflops": round(factor_flops / factor_s / 1e12, 2),
+            },
+            "epochs": {
+                "seconds": round(epochs_s, 4),
+                "tflops": round(epoch_flops / epochs_s / 1e12, 2),
+            },
+            "fetch": {"seconds": round(fetch_s, 4)},
+        },
+        "phase_sum_s": round(phase_sum, 4),
+        "end_to_end_s": round(e2e_s, 4),
+        "dispatch_overhead_s": round(e2e_s - phase_sum, 4),
+        "xprof_trace": trace_info,
+    }
+
+
+def step_pipeline_rate() -> dict:
+    """End-to-end single-chip pipeline rate at the FULL per-image geometry.
+
+    The north-star projection previously summed per-stage models with no
+    measured end-to-end anchor (VERDICT r3 missing #6). This step runs the
+    ImageNetSiftLcsFV featurize→FV→solve program on synthetic 256px images
+    at the reference per-image config (step 4, pca 64, gmm_k 256, on-chip
+    SIFT, device FV) and reports img/s plus per-stage seconds — the
+    measured anchor tools/northstar.py consumes directly."""
+    backend = _backend()
+    import numpy as np
+
+    from keystone_tpu.loaders.imagenet import ImageNetLoader
+    from keystone_tpu.nodes.learning import BlockWeightedLeastSquaresEstimator
+    from keystone_tpu.nodes.util import ClassLabelIndicators
+    from keystone_tpu.pipelines.images.imagenet_sift_lcs_fv import (
+        ImageNetSiftLcsFVConfig,
+        build_featurizer,
+    )
+
+    if _quick() or backend != "tpu":
+        # Harness validation: tiny geometry, CI-scale featurizer.
+        n, size, gmm_k, pca, batch, sample = 48, 64, 4, 16, 16, 20_000
+        epochs = 1
+    else:
+        n, size, gmm_k, pca, batch, sample = 2048, 256, 256, 64, 128, 200_000
+        epochs = 3
+    classes = 16
+    conf = ImageNetSiftLcsFVConfig(
+        gmm_k=gmm_k,
+        pca_dims=pca,
+        sift_backend="xla",
+        fv_backend="tpu",
+        descriptor_sample=sample,
+        synthetic_n=n,
+        synthetic_classes=classes,
+    )
+    train, _test = ImageNetLoader.synthetic(
+        n=n, num_classes=classes, size=size
+    )
+
+    t0 = time.perf_counter()
+    featurizer = build_featurizer(conf, train.data[: min(n, 512)])
+    fit_s = time.perf_counter() - t0
+
+    # Warm one batch (compile), then time the featurize stream.
+    _ = np.asarray(featurizer(train.data[:batch]).get())
+    t0 = time.perf_counter()
+    feats = []
+    for s in range(0, n, batch):
+        feats.append(np.asarray(featurizer(train.data[s : s + batch]).get()))
+    featurize_s = time.perf_counter() - t0
+    A = np.concatenate(feats, axis=0)
+    del feats
+    feature_dim = A.shape[1]
+
+    targets = np.asarray(ClassLabelIndicators(classes)(train.labels))
+    solver = BlockWeightedLeastSquaresEstimator(
+        num_iters=epochs, lam=conf.lam, mixture_weight=conf.mixture_weight
+    )
+    t0 = time.perf_counter()
+    model = solver.fit(A, targets)
+    # Force a device→host fetch so async dispatch can't end the timer early.
+    np.asarray(model.W_blocks[-1][-1, -1])
+    solve_s = time.perf_counter() - t0
+
+    total_s = fit_s + featurize_s + solve_s
+    return {
+        "ok": True,
+        "backend": backend,
+        "config": {
+            "images": n, "size_px": size, "gmm_k": gmm_k, "pca_dims": pca,
+            "feature_dim": feature_dim, "classes": classes,
+            "solver_epochs": epochs, "sift_backend": "xla",
+        },
+        "featurize_img_per_sec": round(n / featurize_s, 2),
+        "stages_s": {
+            "fit_pca_gmm": round(fit_s, 2),
+            "featurize": round(featurize_s, 2),
+            "solve": round(solve_s, 2),
+        },
+        "end_to_end_s": round(total_s, 2),
+        "end_to_end_img_per_sec": round(n / total_s, 2),
+    }
+
+
 def step_entry_compile() -> dict:
     import jax
 
@@ -764,8 +1120,11 @@ def step_entry_compile() -> dict:
 
 STEP_FNS = {
     "pallas_fv": step_pallas_fv,
+    "roofline": step_roofline,
+    "bench_trace": step_bench_trace,
     "streamed_overlap": step_streamed_overlap,
     "memory_stats": step_memory_stats,
+    "pipeline_rate": step_pipeline_rate,
     "entry_compile": step_entry_compile,
 }
 

@@ -36,7 +36,7 @@ N_IMAGES = 1_281_167
 K_CLASSES = 1000
 D_FEATURES = 65_536
 SOLVER_EPOCHS = 3
-SOLVER_BLOCK = 4096
+SOLVER_BLOCK = 8192  # matches bench.SCALE["tpu-imagenet"] (auto-sized r3 sweep)
 CHIPS = 64
 # Data-parallel BCD psums one b×b gram per block per epoch over ICI; on a
 # 64-chip torus that collective overlaps poorly only at small n/chip.
@@ -93,16 +93,26 @@ def main() -> None:
     solver_flops = bench.bcd_flops(
         N_IMAGES, D_FEATURES, K_CLASSES, SOLVER_BLOCK, SOLVER_EPOCHS
     )
-    b = _tpu(steps, "bench_bf16") or _tpu(steps, "bench_f32")
+    # Prefer the AT-SHAPE measurement (bench_imagenet: d=65536, k=1000,
+    # block=8192 on silicon) — its rate needs no transfer assumption. The
+    # k=16 headline rows are the fallback, labelled as the rescale they are.
+    shaped = _tpu(steps, "bench_imagenet")
+    b = shaped or _tpu(steps, "bench_bf16") or _tpu(steps, "bench_f32")
     if b:
         tflops = b["tflops_per_chip"]
         dtype = b["bench_line"]["detail"]["dtype"]
         solver_s = solver_flops / (tflops * 1e12 * CHIPS * SCALING_EFFICIENCY)
+        rate_basis = (
+            "measured(tpu) AT ImageNet shape (d=65536, k=1000)"
+            if shaped
+            else "measured(tpu) at k=16 — RESCALED by FLOPs, assumes the "
+            "rate transfers to k=1000"
+        )
         rows.append(
             {
                 "stage": f"BWLS solve (d=64k, k=1000, {SOLVER_EPOCHS} epochs)",
                 "minutes": round(solver_s / 60, 2),
-                "basis": f"measured(tpu) {tflops} TFLOPS/chip ({dtype}) "
+                "basis": f"{rate_basis}: {tflops} TFLOPS/chip ({dtype}) "
                 f"x {CHIPS} chips x {SCALING_EFFICIENCY} eff (assumed)",
             }
         )
@@ -220,6 +230,24 @@ def main() -> None:
         "chip_stages_minutes": chip_minutes,
         "stages": rows,
     }
+    # Measured END-TO-END anchor (VERDICT r3 missing #6): the pipeline_rate
+    # checkride step runs the whole featurize→FV→solve program on one chip
+    # at full per-image geometry. Its img/s cross-checks the sum-of-stage
+    # model above — if the anchor disagrees with the stage sum, trust the
+    # anchor.
+    pr = _tpu(steps, "pipeline_rate")
+    if pr and pr.get("featurize_img_per_sec"):
+        img_s = float(pr["featurize_img_per_sec"])
+        anchor_min = N_IMAGES / (img_s * CHIPS * SCALING_EFFICIENCY) / 60.0
+        out["end_to_end_anchor"] = {
+            "measured_img_per_sec_per_chip": img_s,
+            "config": pr.get("config"),
+            "stages_s": pr.get("stages_s"),
+            "projected_chip_featurize_minutes_v5e64": round(anchor_min, 2),
+            "basis": f"measured(tpu) end-to-end chip featurize "
+            f"(on-chip SIFT+LCS+PCA+FV) x {CHIPS} chips x "
+            f"{SCALING_EFFICIENCY} eff (assumed)",
+        }
     print(json.dumps(out, indent=1))
 
 
