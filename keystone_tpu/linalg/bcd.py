@@ -330,8 +330,8 @@ def _factor_chunk(block_size: Optional[int] = None) -> int:
     so an uncapped chunk·b² OOMs HBM at large blocks — the deviceless v5e
     AOT compile of the ImageNet bench shape (chunk 8 · b 8192) demanded
     >16 GiB of temps. Capping chunk·b² at 128M f32 elements (512 MB per
-    temp) keeps the factor transient ~1-2 GiB: b=8192 factors per-block,
-    b≤2896 keeps the full batch of 16."""
+    temp) keeps the factor transient ~1-2 GiB: b=8192 gets chunk 2
+    (128M // 8192² = 2), b≤2896 keeps the full batch of 16."""
     if config.factor_batch is not None:
         return max(1, int(config.factor_batch))
     if jax.default_backend() == "cpu":

@@ -373,13 +373,14 @@ def _both_paths(rng, **kwargs):
 
     A, B, _ = _problem(rng, n=240, d=32)
     Ma, Mb = RowMatrix.from_array(A), RowMatrix.from_array(B)
-    config.fused_epochs = None  # auto: fused (blocks tile d)
-    W_f, blocks = block_coordinate_descent(Ma, Mb, **kwargs)
-    config.fused_epochs = False
+    prior = config.fused_epochs  # restore whatever the caller had set
     try:
+        config.fused_epochs = None  # auto: fused (blocks tile d)
+        W_f, blocks = block_coordinate_descent(Ma, Mb, **kwargs)
+        config.fused_epochs = False
         W_l, _ = block_coordinate_descent(Ma, Mb, **kwargs)
     finally:
-        config.fused_epochs = None
+        config.fused_epochs = prior
     return A, B, W_f, W_l, blocks
 
 

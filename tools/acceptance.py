@@ -206,7 +206,10 @@ PIPELINES = {
     "LinearPixels": (_linear_pixels, "test_accuracy", 0.30, 0.50, True, "provisional"),
     "RandomPatchCifar": (_cifar, "test_accuracy", 0.80, 0.78, True, "BASELINE.md (84-85% full config)"),
     "NewsgroupsPipeline": (_newsgroups, "test_accuracy", 0.75, 0.80, True, "provisional"),
-    "AmazonReviewsPipeline": (_amazon, "auc", 0.85, 0.85, True, "provisional"),
+    # Amazon CI floor sits below the noisy-AUC ceiling (1-p = 0.90 at
+    # p=0.1 — see noise_band) with a ≥0.10 window; 0.85 left only
+    # [0.85, 0.90] and flaked (ADVICE r4).
+    "AmazonReviewsPipeline": (_amazon, "auc", 0.85, 0.80, True, "provisional"),
     "TimitPipeline": (_timit, "phone_error_rate", 0.40, 0.20, False, "BASELINE.md (PER 33-34% full config)"),
     "VOCSIFTFisher": (_voc, "map", 0.45, 0.50, True, "provisional"),
     "ImageNetSiftLcsFV": (_imagenet, "top_k_error", 0.40, 0.60, False, "BASELINE.md (top-5 err 32-33% full config)"),
@@ -217,6 +220,52 @@ PIPELINES = {
 # best-possible value visibly below 1.0, making the floor/ceiling band
 # meaningful.
 SYNTH_LABEL_NOISE = 0.1
+
+
+def noise_band(name: str, p: float):
+    """Reachable-value band (lo, hi) for a pipeline's metric under the
+    synthetic noise model (ADVICE r4: one accuracy-shaped band was
+    miscalibrated for AUC / mAP / top-k error). ``None`` = unbounded side;
+    the floor check already guards the other direction. Closed forms, all
+    for a PERFECT model scored against noisy test labels:
+
+    - accuracy — integer labels flip to a uniformly random OTHER class
+      (synthetic.with_label_noise), so a flipped label never matches the
+      true-class prediction: ceiling exactly 1-p, +p/2 realization slack.
+    - AUC (balanced binary, flip rate p) — noisy-pos beats noisy-neg with
+      prob (1-p)² + 2·½·p(1-p) = 1-p; ceiling 1-p, +p/4 slack.
+    - multiclass error (PER) — perfect model errs on exactly the flipped
+      fraction: floor p, ×½ slack.
+    - top-k error (C classes) — a flipped label (uniform over C-1 others)
+      still lands inside the model's remaining k-1 slots with prob
+      (k-1)/(C-1): floor p·(C-k)/(C-1), ×½ slack.
+    - mAP (per-ENTRY indicator flips, per-class prevalence π) — perfect
+      ranking puts (1-p)·π·n kept positives on top (precision ≈ 1-p) and
+      p·(1-π)·n flipped negatives uniform in the tail, where precision at
+      depth t is ((1-p)π + p·t)/(π + t); integrating, the tail averages
+      [p(1-π) + π(1-2p)·ln(1/π)]/(1-π). VOC synthetic prevalence is
+      π = 1.5/C (1 or 2 present classes per image, voc.py synthetic).
+      Ceiling + 0.05 slack (64-image test split is noisy).
+    """
+    import math
+
+    acc_hi = 1.0 - p / 2.0
+    def map_ceiling(pi):
+        pos, neg = (1.0 - p) * pi, p * (1.0 - pi)
+        tail = (p * (1.0 - pi) + pi * (1.0 - 2.0 * p) * math.log(1.0 / pi)) / (1.0 - pi)
+        return (pos * (1.0 - p) + neg * tail) / (pos + neg)
+    bands = {
+        "MnistRandomFFT": (None, acc_hi),
+        "LinearPixels": (None, acc_hi),
+        "RandomPatchCifar": (None, acc_hi),
+        "NewsgroupsPipeline": (None, acc_hi),
+        "AmazonReviewsPipeline": (None, (1.0 - p) + p / 4.0),
+        "TimitPipeline": (p / 2.0, None),
+        # synthetic_classes=8, top_k=5 (the _imagenet runner above)
+        "ImageNetSiftLcsFV": (p * (8 - 5) / (8 - 1) / 2.0, None),
+        "VOCSIFTFisher": (None, map_ceiling(1.5 / 4.0) + 0.05),
+    }
+    return bands.get(name, (None, acc_hi if p < 0.5 else None))
 
 
 def main(argv=None) -> int:
@@ -296,19 +345,20 @@ def main(argv=None) -> int:
                 value >= floor if higher else value <= floor
             )
             if ok and noise > 0.0:
-                # The binding band: with flip rate p even a perfect model
-                # scores ≈ 1-p+p/C, so an accuracy at/above 1-p/2 (or an
-                # error below p/8) means the noise never reached the
-                # metric — the harness is validating plumbing again.
-                band_ok = (
-                    value <= 1.0 - noise / 2.0
-                    if higher
-                    else value >= noise / 8.0
+                # The binding band: a score beyond the metric's noise-model
+                # ceiling/floor (see noise_band) means the noise never
+                # reached the metric — the harness is validating plumbing
+                # again, not quality.
+                lo, hi = noise_band(name, noise)
+                band_ok = (lo is None or value >= lo) and (
+                    hi is None or value <= hi
                 )
                 if not band_ok:
                     ok = False
+                    bound = (f"> ceiling {hi:.4f}" if hi is not None
+                             and value > hi else f"< floor {lo:.4f}")
                     src = (
-                        f"OUT OF BAND (noise p={noise}): metric "
+                        f"OUT OF BAND (noise p={noise}, {bound}): metric "
                         "unreachable by a noisy-label run — floor not binding"
                     )
             status = "PASS" if ok else "FAIL"
