@@ -123,6 +123,19 @@ def build_featurizer(conf: ImageNetSiftLcsFVConfig, train_images) -> Pipeline:
     return Pipeline.gather(branches)
 
 
+def _build_tta(conf: ImageNetSiftLcsFVConfig, side: int):
+    """Patcher + score averager for the reference's TTA protocol (center +
+    four corners, each flipped = 10 views; crop defaults to 7/8 of the
+    image side). Shared by the eager and streamed paths so the crop
+    protocol can't drift between them."""
+    from keystone_tpu.evaluation.augmented import AugmentedExamplesEvaluator
+    from keystone_tpu.nodes.images import CenterCornerPatcher
+
+    crop = conf.augment_crop or (side * 7) // 8
+    patcher = CenterCornerPatcher(crop_size=crop, with_flips=True)
+    return patcher, AugmentedExamplesEvaluator(patcher.num_views)
+
+
 def run_streamed(conf: ImageNetSiftLcsFVConfig) -> dict:
     """Out-of-core execution of the north-star pipeline.
 
@@ -132,12 +145,14 @@ def run_streamed(conf: ImageNetSiftLcsFVConfig) -> dict:
     accumulated FEATURE matrix — ~3× smaller than the images at the
     64k-dim config — feeds the host-streamed weighted BCD, and test
     batches stream through scoring the same way.
+
+    With ``augment`` (the reference's AugmentedExamplesEvaluator protocol,
+    SURVEY.md §2.10), each test batch expands to its center+corner crop
+    views; views are featurized and scored in ``stream_batch``-sized
+    slices so device batches stay bounded, and only the (views, classes)
+    score rows are held before per-image averaging — the feature matrix
+    for the views is never materialized whole.
     """
-    if conf.augment:
-        raise ValueError(
-            "test-time augmentation is not supported with --stream; run the "
-            "eager mode for the TTA protocol"
-        )
     if conf.data_path:
         if not (conf.test_data_path and conf.label_map_path):
             raise ValueError("real data requires test path and label map")
@@ -217,10 +232,29 @@ def run_streamed(conf: ImageNetSiftLcsFVConfig) -> dict:
     model = solver.fit(A_host, targets)
     del A_host
 
+    patcher = averager = None
+    if conf.augment:
+        patcher, averager = _build_tta(conf, int(np.asarray(fit_sample).shape[1]))
+
     correct = []
     top1_wrong = []
     for X, y in test_batches():
-        scores = model.apply_batch(np.asarray(featurizer(X).get()))
+        if patcher is not None:
+            # Patch per image sub-batch so the view tensor never exceeds
+            # ~stream_batch rows on the device (a whole-batch patch at the
+            # real-data scale is a ~2 GB transient, 10× the working set
+            # this mode exists to bound).
+            X = np.asarray(X)
+            sub = max(1, conf.stream_batch // patcher.num_views)
+            view_scores = np.concatenate([
+                np.asarray(model.apply_batch(np.asarray(
+                    featurizer(patcher(X[i : i + sub])).get()
+                )))
+                for i in range(0, len(X), sub)
+            ])
+            scores = averager.average_scores(view_scores)
+        else:
+            scores = model.apply_batch(np.asarray(featurizer(X).get()))
         topk = np.asarray(TopKClassifier(conf.top_k)(scores))
         correct.append((topk == np.asarray(y)[:, None]).any(axis=1))
         top1_wrong.append(topk[:, 0] != np.asarray(y))
@@ -237,8 +271,10 @@ def run_streamed(conf: ImageNetSiftLcsFVConfig) -> dict:
         "seconds": elapsed,
         "summary": (
             f"top-{conf.top_k} error: {top_k_error:.4f} | "
-            f"top-1 error: {top1:.4f} (streamed)"
+            f"top-1 error: {top1:.4f} (streamed"
+            + (f", TTA x{patcher.num_views})" if patcher else ")")
         ),
+        **({"num_views": patcher.num_views} if patcher else {}),
     }
 
 
@@ -270,15 +306,9 @@ def run(conf: ImageNetSiftLcsFVConfig) -> dict:
     )
     scored = featurizer.and_then(solver, train.data, targets)
     if conf.augment:
-        from keystone_tpu.evaluation.augmented import AugmentedExamplesEvaluator
-        from keystone_tpu.nodes.images import CenterCornerPatcher
-
-        crop = conf.augment_crop or (test.data.shape[1] * 7) // 8
-        patcher = CenterCornerPatcher(crop_size=crop, with_flips=True)
+        patcher, averager = _build_tta(conf, test.data.shape[1])
         view_scores = np.asarray(scored(patcher(test.data)).get())
-        avg = AugmentedExamplesEvaluator(patcher.num_views).average_scores(
-            view_scores
-        )
+        avg = averager.average_scores(view_scores)
         topk = np.asarray(TopKClassifier(conf.top_k)(avg))
     else:
         pipeline = scored.and_then(TopKClassifier(conf.top_k))

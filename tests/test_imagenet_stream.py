@@ -173,14 +173,33 @@ def test_balanced_sample_spans_synsets(jpeg_tree):
     np.testing.assert_allclose(sample[2:], second, atol=1e-6)
 
 
-def test_streamed_rejects_augment():
+def test_streamed_tta_matches_eager():
+    """Streamed TTA view accounting: the streamed path scores 10 views per
+    image in stream_batch-sized slices and averages per image — any
+    grouping/order error scrambles the per-image averages, so parity with
+    the eager AugmentedExamplesEvaluator path is the accounting check."""
     from keystone_tpu.pipelines.images.imagenet_sift_lcs_fv import (
         ImageNetSiftLcsFVConfig,
         run,
     )
+    from keystone_tpu.workflow.executor import PipelineEnv
 
-    with pytest.raises(ValueError, match="augmentation"):
-        run(ImageNetSiftLcsFVConfig(stream=True, augment=True))
+    base = dict(
+        synthetic_n=96, synthetic_classes=4, pca_dims=8, gmm_k=4,
+        descriptor_sample=10_000, num_iters=1, top_k=2, augment=True,
+    )
+    PipelineEnv.reset()
+    eager = run(ImageNetSiftLcsFVConfig(**base))
+    PipelineEnv.reset()
+    # stream_batch=32 < 96·10 views forces multiple featurize slices per
+    # test batch; fit_sample_images=96 gives the same PCA/GMM fit set as
+    # the eager run.
+    streamed = run(ImageNetSiftLcsFVConfig(
+        **base, stream=True, stream_batch=32, fit_sample_images=96,
+    ))
+    assert streamed["num_views"] == 10
+    assert abs(streamed["top_k_error"] - eager["top_k_error"]) <= 0.03
+    assert abs(streamed["top_1_error"] - eager["top_1_error"]) <= 0.05
 
 
 def test_stream_surfaces_decode_errors(tmp_path):
