@@ -8,6 +8,7 @@ from keystone_tpu.workflow.pipeline import (
     Transformer,
 )
 from keystone_tpu.workflow.executor import GraphExecutor, PipelineEnv
+from keystone_tpu.workflow.functional import fitted_forward
 from keystone_tpu.workflow.optimizer import (
     ChainFusionRule,
     EquivalentNodeMergeRule,
@@ -30,6 +31,7 @@ __all__ = [
     "PipelineDataset",
     "PipelineEnv",
     "GraphExecutor",
+    "fitted_forward",
     "Optimizer",
     "Rule",
     "ChainFusionRule",

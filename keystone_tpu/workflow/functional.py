@@ -1,0 +1,54 @@
+"""Replay a fitted pipeline's optimized graph as one pure function.
+
+A fitted pipeline is transformer-only (every Estimator already replaced by
+its fitted Transformer), so its optimized graph can be re-executed
+functionally over a jit argument — the whole featurization chain traces
+into ONE XLA computation. This is how the driver's ``entry()`` exposes the
+flagship forward step and how the AOT tests compile the full two-branch
+ImageNet featurizer for a v5e target without a chip (SURVEY.md §7 hard
+part 6: both deep branches fused without blowing compile time).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from keystone_tpu.workflow.executor import PipelineEnv
+from keystone_tpu.workflow.operators import (
+    DatasetOperator,
+    GatherOperator,
+    TransformerOperator,
+)
+
+
+def fitted_forward(pipeline, example):
+    """A jittable ``fn(X)`` replaying ``pipeline``'s optimized transformer
+    graph over the argument.
+
+    ``pipeline`` must be fitted (transformer-only); ``example`` is a small
+    batch used once to build + optimize the graph (chain fusion, node
+    merging) — the returned function is pure and shape-polymorphic over
+    the leading batch axis up to what the transformers allow.
+    """
+    ds = pipeline(example)
+    g = PipelineEnv.get().optimizer.execute(ds.graph, [ds.sink])
+    order = g.reachable([ds.sink])
+
+    def fn(X):
+        values = {}
+        for nid in order:
+            op = g.operators[nid]
+            deps = g.dependencies[nid]
+            if isinstance(op, DatasetOperator):
+                values[nid] = X
+            elif isinstance(op, TransformerOperator):
+                values[nid] = op.transformer.apply_batch(values[deps[0]])
+            elif isinstance(op, GatherOperator):
+                values[nid] = jnp.concatenate(
+                    [values[d] for d in deps], axis=-1
+                )
+            else:
+                raise TypeError(f"unexpected op in fitted graph: {op!r}")
+        return values[ds.sink]
+
+    return fn
