@@ -75,9 +75,7 @@ def _local_gram_inv(a_b, aw, lam, precision, axis):
     solves per block; the λ-regularized SPD gram keeps it well-conditioned,
     and later epochs re-solve against the residual, so per-epoch solve
     error self-corrects instead of accumulating."""
-    ridge = _local_ridge_gram(a_b, aw, lam, precision, axis)
-    chol = jnp.linalg.cholesky(ridge)
-    return cho_solve((chol, True), jnp.eye(ridge.shape[0], dtype=ridge.dtype))
+    return _batched_spd_inv(_local_ridge_gram(a_b, aw, lam, precision, axis))
 
 
 def _local_solve_update(a_b, aw, inv, r, w_b, precision, axis):
@@ -127,18 +125,68 @@ def _gram_only_fn(mesh: Mesh, axis: str, precision, weighted: bool):
     return jax.jit(sm)
 
 
-def _batched_spd_inv(grams):
-    """Batched SPD inverse over a leading block axis — THE single source for
-    the Cholesky→triangular-solves inverse used by every batched factor
-    body. XLA lowers a single b×b factorization to a sequential panel loop
-    that dominates many-block factor phases on TPU; the batch dimension runs
-    those loops in parallel, amortizing the sequential lowering."""
+def _trsm_rhs_chunk(b: int, batch: int, itemsize: int) -> int:
+    """Column-chunk width for the identity-RHS triangular solves below.
+
+    XLA:TPU expands TriangularSolve into an UNROLLED 128-row panel chain
+    that materializes one (batch, rows_left, rhs_w) HLO temp per panel —
+    about batch·b²·w·itemsize/128 bytes across the chain. Against the
+    full b-wide identity at (batch=2, b=8192) that is ~17 GB and fails
+    v5e buffer assignment outright (measured via the deviceless AOT
+    compile: "Used 16.23G of 15.75G hbm"). Chunking the RHS columns and
+    scanning the chunks (scan = real while loop, temps REUSED per
+    iteration) caps the chain at ~2 GB while each panel step stays at
+    least one full 128-lane MXU tile wide. The floor is the 128 lane
+    width, NOT larger: a bigger floor would silently override the budget
+    right where it matters most (ring-path d_loc ≥ 16k). At the floor the
+    chain still grows as batch·b²·itemsize — but there the b×b operands
+    themselves approach HBM capacity and the caller must shard d
+    further."""
+    budget = 2 << 30
+    w = budget * 128 // max(1, batch * b * b * itemsize)
+    if w >= b:
+        return b
+    return max(128, 1 << int(np.floor(np.log2(max(w, 1)))))
+
+
+def _batched_spd_inv(grams, rhs_chunk: Optional[int] = None):
+    """(Batched) SPD inverse — THE single source for the
+    Cholesky→triangular-solves inverse used by every factor body, batched
+    (leading block axis) or not. XLA lowers a single b×b factorization to
+    a sequential panel loop that dominates many-block factor phases on
+    TPU; the batch dimension runs those loops in parallel, amortizing the
+    sequential lowering. The identity RHS is column-chunked per
+    ``_trsm_rhs_chunk`` (``rhs_chunk`` overrides, for tests) so the
+    unrolled trsm expansion can't blow the HBM temp budget at large b."""
     chol = jnp.linalg.cholesky(grams)
-    eye = jnp.broadcast_to(
-        jnp.eye(grams.shape[-1], dtype=grams.dtype), grams.shape
+    b = grams.shape[-1]
+    batch = int(np.prod(grams.shape[:-2])) if grams.ndim > 2 else 1
+    w = rhs_chunk or _trsm_rhs_chunk(
+        b, batch, jnp.dtype(grams.dtype).itemsize
     )
-    y = solve_triangular(chol, eye, lower=True)
-    return solve_triangular(chol, y, lower=True, trans=1)
+    eye = jnp.eye(b, dtype=grams.dtype)
+    if w >= b:
+        eyeb = jnp.broadcast_to(eye, grams.shape)
+        y = solve_triangular(chol, eyeb, lower=True)
+        return solve_triangular(chol, y, lower=True, trans=1)
+
+    nc = -(-b // w)
+    eye_pad = jnp.pad(eye, ((0, 0), (0, nc * w - b)))
+
+    def chunk_cols(_, c0):
+        rhs = jnp.broadcast_to(
+            lax.dynamic_slice(eye_pad, (0, c0), (b, w)),
+            grams.shape[:-2] + (b, w),
+        )
+        y = solve_triangular(chol, rhs, lower=True)
+        return None, solve_triangular(chol, y, lower=True, trans=1)
+
+    _, cols = lax.scan(
+        chunk_cols, None, jnp.arange(0, nc * w, w, dtype=jnp.int32)
+    )
+    # cols: (nc, *batch_dims, b, w) → (*batch_dims, b, nc·w), drop padding.
+    cols = jnp.moveaxis(cols, 0, -2)
+    return cols.reshape(grams.shape[:-1] + (nc * w,))[..., :b]
 
 
 @lru_cache(maxsize=None)

@@ -36,10 +36,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax, shard_map
-from jax.scipy.linalg import cho_solve
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from keystone_tpu.config import config
+from keystone_tpu.linalg.bcd import _batched_spd_inv
 from keystone_tpu.linalg.row_matrix import _precision, solver_matmul, storage_dtype
 
 
@@ -71,8 +71,10 @@ def _ring_solve_fn(mesh: Mesh, model_axis: str, data_axis, precision):
         # relative, inside solver tolerance.
         eye = jnp.eye(d_loc, dtype=gram.dtype)
         jitter = 1e-6 * (jnp.trace(gram) / d_loc)
-        chol = jnp.linalg.cholesky(gram + (lam + jitter) * eye)
-        inv = cho_solve((chol, True), eye)
+        # Shared chunked-RHS inverse (bcd._batched_spd_inv): the naive
+        # full-identity trsm pair blows XLA:TPU's unrolled-panel temp
+        # budget at large d_loc.
+        inv = _batched_spd_inv(gram + (lam + jitter) * eye)
         idx = lax.axis_index(model_axis)
         # Solver state in the accumulation dtype even when A stores bf16.
         w0 = jnp.zeros((d_loc, nshards * kc), dtype=b_chunk.dtype)

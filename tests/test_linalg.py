@@ -202,6 +202,29 @@ def test_bcd_batched_factor_ragged_and_chunked(rng):
     )
 
 
+def test_spd_inv_rhs_chunked_matches_full(rng):
+    """The column-chunked identity-RHS inverse (the v5e HBM fix for the
+    unrolled trsm expansion) must equal the one-shot inverse — including a
+    ragged final chunk and the batched leading axis."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.linalg.bcd import _batched_spd_inv
+
+    b = 13
+    X = rng.normal(size=(3, b, b)).astype(np.float32)
+    grams = X @ np.swapaxes(X, 1, 2) / b + 2.0 * np.eye(b, dtype=np.float32)
+    full = np.asarray(_batched_spd_inv(jnp.asarray(grams)))
+    chunked = np.asarray(_batched_spd_inv(jnp.asarray(grams), rhs_chunk=5))
+    np.testing.assert_allclose(chunked, full, rtol=1e-5, atol=1e-5)
+    oracle = np.linalg.inv(grams.astype(np.float64))
+    np.testing.assert_allclose(chunked, oracle, rtol=1e-3, atol=1e-3)
+    # Unbatched path with an exact-multiple chunk.
+    one = np.asarray(_batched_spd_inv(jnp.asarray(grams[0]), rhs_chunk=13))
+    np.testing.assert_allclose(one, oracle[0], rtol=1e-3, atol=1e-3)
+    two = np.asarray(_batched_spd_inv(jnp.asarray(grams[0]), rhs_chunk=4))
+    np.testing.assert_allclose(two, oracle[0], rtol=1e-3, atol=1e-3)
+
+
 def test_bcd_cached_grams_weighted(rng):
     A, B, _ = _problem(rng)
     w = rng.uniform(0.5, 2.0, size=A.shape[0]).astype(np.float32)
