@@ -115,7 +115,15 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         self.stream = stream
         # "data": rows sharded, psum'd grams (the default). "model": the
         # d-axis shards across the mesh and residual chunks ride a ppermute
-        # ring (linalg/ring_bcd.py) — the right trade when d dwarfs n·k.
+        # ring (linalg/ring_bcd.py). Measured guidance (tools/bench_ring.py
+        # on the 8-device mesh, n=256 k=4 iters=2): ring 5.5x faster at
+        # d=n·k and 17.7x at d=8·n·k — the ring shards the per-block
+        # factorizations across chips while the data path REPLICATES each
+        # post-psum b x b inverse on every chip, and it moves n·k/P-sized
+        # residual chunks instead of psum'ing b x b grams. Prefer "model"
+        # whenever d well exceeds n·k and features are dense; prefer
+        # "data" for tall-skinny problems (n >> d), sparse features, or
+        # when per-chip HBM can't hold an (n, d/P) column shard.
         self.parallelism = parallelism
 
     def _weights(self, Y: jnp.ndarray) -> Optional[jax.Array]:

@@ -40,7 +40,7 @@ def _run(tmp_path, steps, timeout=420):
 @pytest.mark.slow
 def test_checkride_cpu_dryrun_and_resume(tmp_path):
     steps = ["streamed_overlap", "memory_stats", "featurize",
-             "factor_primitives", "acceptance_synthetic"]
+             "factor_primitives", "ring_vs_dp", "acceptance_synthetic"]
     proc = _run(tmp_path, steps)
     assert proc.returncode == 0, proc.stderr[-2000:]
     report = json.loads((tmp_path / "report.json").read_text())
@@ -187,7 +187,7 @@ def test_bench_serves_checkride_checkpoint_only_when_config_matches(
         "value": 7.0,
         "detail": {"n": cfg["n"], "d": cfg["d"], "k": cfg["k"],
                    "block": cfg["block"], "epochs": cfg["iters"],
-                   "dtype": "f32"},
+                   "dtype": "f32", "solver_rev": bench.SOLVER_REV},
     }
     rec = {"ok": True, "backend": "tpu", "bench_line": good_line,
            "saved_at": bench.time.time()}
@@ -215,6 +215,13 @@ def test_bench_serves_checkride_checkpoint_only_when_config_matches(
     cpu["backend"] = "cpu"
     p.write_text(json.dumps(cpu))
     assert bench._checkride_checkpoint("tpu", "f32") is None
+    # Retired solver revision (measured code this round no longer ships)
+    # → no serve.
+    oldrev = json.loads(json.dumps(rec))
+    oldrev["bench_line"]["detail"]["solver_rev"] = "r0-retired"
+    p.write_text(json.dumps(oldrev))
+    assert bench._checkride_checkpoint("tpu", "f32") is None
+    p.write_text(json.dumps(rec))
     # Different epoch count (FLOP split changes) → no serve.
     ep = json.loads(json.dumps(rec))
     ep["bench_line"]["detail"]["epochs"] = cfg["iters"] + 1
@@ -319,6 +326,7 @@ def test_mid_sweep_tpu_death_sets_degrade_flag(tmp_path, monkeypatch):
                 "ok": True,
                 "backend": "tpu",
                 "scale": "quick",
+                "solver_rev": bench.SOLVER_REV,
                 "rows": rows,
                 "partial": True,
                 "step": "mfu_sweep",
@@ -339,6 +347,8 @@ def test_cpu_rerun_preserves_partial_tpu_sweep_rows(tmp_path):
     CPU-degraded re-run — partial live-chip evidence is the harness's
     whole purpose."""
     checkride = _sweep_module()
+    import bench
+
     rows = [
         {
             "block": 64,
@@ -354,6 +364,7 @@ def test_cpu_rerun_preserves_partial_tpu_sweep_rows(tmp_path):
                 "ok": True,
                 "backend": "tpu",
                 "scale": "quick",
+                "solver_rev": bench.SOLVER_REV,
                 "rows": rows,
                 "partial": True,
                 "step": "mfu_sweep",

@@ -57,6 +57,7 @@ STEPS = (
     "memory_stats",
     "featurize",
     "factor_primitives",
+    "ring_vs_dp",
     "pipeline_rate",
     "acceptance_synthetic",
     "bench_imagenet",
@@ -461,6 +462,17 @@ TOOL_STEPS = {
         [],  # script defaults are the TPU sweep (blocks 1024..8192, n=32768)
         ["--blocks", "256", "512", "--n", "2048", "--k", "8"],
     ),
+    # Single-chip caveat applies on TPU (the script records it): the ring's
+    # comm advantage needs >1 chip, so the TPU row compares the two
+    # programs' schedules at identical shapes; d is capped so the ring's
+    # per-chip d_loc x d_loc gram fits HBM on one device.
+    "ring_vs_dp": (
+        "bench_ring.py",
+        ["--n", "1024", "--k", "4", "--d-wide", "8192",
+         "--d-control", "2048"],
+        ["--n", "256", "--k", "4", "--d-wide", "4096",
+         "--d-control", "1024", "--reps", "1"],
+    ),
 }
 
 
@@ -563,7 +575,7 @@ def orchestrate(args) -> int:
         forced = _forced_failure(step)
         if forced is not None:
             result = dict(forced, backend=target)
-        elif step in ("bench_f32", "bench_bf16", "bench_xl"):
+        elif step in ("bench_f32", "bench_bf16", "bench_xl", "bench_imagenet"):
             result = run_bench_step(step, target, args.quick, args.step_timeout)
         elif step == "mfu_sweep":
             result = run_mfu_sweep(
