@@ -20,6 +20,18 @@ def env_flag(name: str) -> bool:
     return os.environ.get(name, "").lower() not in ("", "0", "false", "no")
 
 
+def _env_choice(name: str, choices: tuple, default: str) -> str:
+    """Validated enum env knob: case-insensitive, and a bad value fails AT
+    IMPORT naming the variable — not as a bare KeyError deep in a solve."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    val = raw.strip().lower()
+    if val not in choices:
+        raise ValueError(f"{name}={raw!r}: expected one of {choices}")
+    return val
+
+
 @dataclass
 class Config:
     # Default dtype for dense compute (solvers, featurization).
@@ -29,8 +41,17 @@ class Config:
     accum_dtype: str = "float32"
     # Matmul precision for solver-path compute (grams, QR, residuals). TPU
     # default matmul precision is bf16-class and loses ~3 decimal digits;
-    # solvers need full fp32 ("highest"). Featurization uses the default.
-    solver_precision: str = "highest"
+    # solvers default to full fp32 ("highest" = 6-pass bf16 emulation,
+    # ~1/6 MXU peak). "high" (3-pass) doubles gemm throughput at ~f32-ish
+    # accuracy — BCD's per-epoch residual re-solve self-corrects, so the
+    # bench measures it as the f32h mode; flip the default only on
+    # silicon evidence. Env: KEYSTONE_SOLVER_PRECISION.
+    solver_precision: str = field(
+        default_factory=lambda: _env_choice(
+            "KEYSTONE_SOLVER_PRECISION", ("highest", "high", "default"),
+            "highest",
+        )
+    )
     # Storage dtype for the solver's BIG operands (the feature matrix A and
     # streamed blocks). None = default_dtype. "bfloat16" is the v5e
     # throughput mode: A is stored (and streamed) at half the bytes and

@@ -59,7 +59,7 @@ def main() -> None:
 
     rows = []
     for dtype in args.dtypes:
-        peak = bench.PLAUSIBLE_PEAK_TFLOPS["bf16" if dtype == "bf16" else "f32"]
+        peak = bench.PLAUSIBLE_PEAK_TFLOPS[dtype]
         seen_blocks = set()
         for block in args.blocks:
             env = dict(base_env)

@@ -246,7 +246,7 @@ def run_bench_step(step: str, target: str, quick: bool, timeout: float) -> dict:
             "error": "suspect_timing: measured above plausible peak",
             "bench_line": r,
         }
-    peak = bench.PLAUSIBLE_PEAK_TFLOPS["bf16" if dtype == "bf16" else "f32"]
+    peak = bench.PLAUSIBLE_PEAK_TFLOPS[dtype]
     return {
         "ok": True,
         "backend": r.get("backend", target),
@@ -293,8 +293,10 @@ def run_mfu_sweep(
     ]
     done = {(r["dtype"], r["block"]) for r in rows}
     backend = prior.get("backend", target)
-    for dtype in ("f32", "bf16"):
-        peak = bench.PLAUSIBLE_PEAK_TFLOPS["bf16" if dtype == "bf16" else "f32"]
+    # f32h (HIGH 3-pass precision) rows measure the candidate default
+    # against "highest" — the flip decision is silicon-driven, not blind.
+    for dtype in ("f32", "bf16", "f32h"):
+        peak = bench.PLAUSIBLE_PEAK_TFLOPS[dtype]
         seen = {b for d, b in done if d == dtype}
         for block in blocks:
             env = _step_env(target, quick)
@@ -841,10 +843,16 @@ def step_roofline() -> dict:
 
     rng = np.random.default_rng(0)
     rows, peaks = {}, {}
-    for key, dtype in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
-        prec = (
-            lax.Precision.HIGHEST if key == "f32" else lax.Precision.DEFAULT
-        )
+    for key, dtype in (
+        ("f32", jnp.float32),
+        ("bf16", jnp.bfloat16),
+        ("f32h", jnp.float32),  # HIGH 3-pass: the candidate solver default
+    ):
+        prec = {
+            "f32": lax.Precision.HIGHEST,
+            "f32h": lax.Precision.HIGH,
+            "bf16": lax.Precision.DEFAULT,
+        }[key]
 
         @jax.jit
         def mm(x, y, _p=prec):
