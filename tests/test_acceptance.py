@@ -72,6 +72,42 @@ def test_synthetic_band_binds(capsys):
     assert "KEYSTONE_SYNTH_LABEL_NOISE" not in os.environ
 
 
+def test_noise_band_closed_forms():
+    """Spot-check the per-metric reachable bounds (ADVICE r4) against
+    their documented closed forms at p=0.1."""
+    import pytest
+
+    p = 0.1
+    assert acceptance.noise_band("MnistRandomFFT", p) == (None, 0.95)
+    lo, hi = acceptance.noise_band("AmazonReviewsPipeline", p)
+    assert lo is None and hi == pytest.approx(0.925)  # 1-p+p/4
+    lo, hi = acceptance.noise_band("TimitPipeline", p)
+    assert lo == pytest.approx(0.05) and hi is None  # p/2
+    lo, _ = acceptance.noise_band("ImageNetSiftLcsFV", p)
+    assert lo == pytest.approx(p * 3 / 7 / 2)  # p(C-k)/(C-1)/2, C=8 k=5
+    _, voc_hi = acceptance.noise_band("VOCSIFTFisher", p)
+    assert 0.85 < voc_hi < 0.92  # AP noise model ~0.849 + 0.05 slack
+    # More noise must lower the mAP ceiling (sanity on the closed form).
+    assert acceptance.noise_band("VOCSIFTFisher", 0.2)[1] < voc_hi
+
+
+def test_out_of_band_perfect_score_fails(capsys, monkeypatch):
+    """A perfect score under injected label noise means the noise never
+    reached the metric — the band check must FAIL it, naming the bound."""
+
+    def fake_runner(root):
+        return {"test_accuracy": 1.0}
+
+    monkeypatch.setitem(
+        acceptance.PIPELINES,
+        "MnistRandomFFT",
+        (fake_runner, "test_accuracy", 0.96, 0.85, True, "test"),
+    )
+    rc = acceptance.main(["--synthetic", "--pipelines", "MnistRandomFFT"])
+    out = capsys.readouterr().out
+    assert rc != 0 and "OUT OF BAND" in out and "ceiling" in out
+
+
 def test_broken_solver_fails_table(capsys, monkeypatch):
     """A solver regression must FAIL the acceptance table, not pass on
     separable data: zero out the linear solve and assert rc!=0."""

@@ -29,7 +29,9 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--blocks", type=int, nargs="+",
                     default=[1024, 2048, 4096, 8192])
-    ap.add_argument("--dtypes", nargs="+", default=["f32", "bf16"])
+    ap.add_argument("--dtypes", nargs="+",
+                    choices=sorted(bench.PLAUSIBLE_PEAK_TFLOPS),
+                    default=["f32", "bf16", "f32h"])
     ap.add_argument("--timeout", type=float, default=900.0)
     ap.add_argument(
         "--scale",
