@@ -278,6 +278,40 @@ def test_fused_solver_programs_compile_for_v5e(mesh):
 
 
 @pytest.mark.slow
+def test_streamed_solver_programs_compile_at_imagenet_block(mesh):
+    """The host-streamed path's two programs (first-epoch update emitting
+    the ridge inverse, and the cached gemm-only update) at the ImageNet
+    block size — the same derisking the fused programs got; the
+    first-epoch program contains the chunked-trsm inverse."""
+    from keystone_tpu.linalg.bcd import (
+        _cached_block_update_fn,
+        _first_epoch_update_fn,
+    )
+    from keystone_tpu.linalg.row_matrix import _precision
+
+    n, b, k = 8192, 8192, 1000
+    one = Mesh(np.array(mesh.devices.flat[:1]), (AXIS,))
+    first = _first_epoch_update_fn(one, AXIS, _precision(), True)
+    c1 = first.lower(
+        _sds((n, b), one, P(AXIS)),
+        _sds((n, k), one, P(AXIS)),
+        _sds((b, k), one, P()),
+        _sds((), one, P()),
+        _sds((n,), one, P(AXIS)),
+    ).compile()
+    assert _compiled_ok(c1)
+    cached = _cached_block_update_fn(one, AXIS, _precision(), True)
+    c2 = cached.lower(
+        _sds((n, b), one, P(AXIS)),
+        _sds((b, b), one, P()),
+        _sds((n, k), one, P(AXIS)),
+        _sds((b, k), one, P()),
+        _sds((n,), one, P(AXIS)),
+    ).compile()
+    assert _compiled_ok(c2)
+
+
+@pytest.mark.slow
 def test_two_branch_imagenet_featurizer_compiles_for_v5e(mesh):
     """The FULL gathered featurizer graph at the headline 64k-dim config
     (SIFT-XLA and LCS branches, each PCA→FV(k=256)→signed-sqrt→L2, fused
@@ -326,17 +360,21 @@ def test_two_branch_imagenet_featurizer_compiles_for_v5e(mesh):
 
 
 @pytest.mark.slow
-def test_fused_solver_compiles_at_imagenet_bench_shape(mesh):
-    """bench.SCALE['tpu-imagenet'] (n=8192, d=65536, k=1000, block=8192):
-    the at-shape silicon bench the north star consumes must not hit its
-    first XLA:TPU compile inside a live window."""
+@pytest.mark.parametrize("scale_key", ["tpu-imagenet", "tpu-xl"])
+def test_fused_solver_compiles_at_bench_shapes(mesh, scale_key):
+    """The full-scale bench shapes ('tpu-imagenet' n=8192/d=65536/k=1000/
+    b=8192; 'tpu-xl' d=262144, 128 blocks of 2048 — the step that preceded
+    two relay deaths) must not hit their first XLA:TPU compile inside a
+    live window, and must fit v5e buffer assignment."""
     import bench as bench_mod
-    from keystone_tpu.linalg.bcd import _fused_epochs_fn, _fused_factor_fn
+    from keystone_tpu.linalg.bcd import (
+        _factor_chunk,
+        _fused_epochs_fn,
+        _fused_factor_fn,
+    )
     from keystone_tpu.linalg.row_matrix import _precision
 
-    from keystone_tpu.linalg.bcd import _factor_chunk
-
-    p = bench_mod.SCALE["tpu-imagenet"]
+    p = bench_mod.SCALE[scale_key]
     n, d, k, b = p["n"], p["d"], p["k"], p["block"]
     nb = d // b
     one = Mesh(np.array(mesh.devices.flat[:1]), (AXIS,))
