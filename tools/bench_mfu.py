@@ -94,6 +94,9 @@ def main() -> None:
                 "tflops_per_chip": r["value"],
                 "mfu_vs_plausible_peak": round(mfu, 4),
                 "seconds_per_solve": r["detail"]["seconds_per_solve"],
+                # Accuracy rides with speed (the f32h-vs-f32 decision
+                # needs both), matching the checkride sweep rows.
+                "relative_residual": r["detail"].get("relative_residual"),
             }
             rows.append(line)
             print(json.dumps(line), flush=True)

@@ -348,6 +348,9 @@ def run_mfu_sweep(
                     "tflops_per_chip": r["value"],
                     "mfu_vs_plausible_peak": round(r["value"] / peak, 4),
                     "seconds_per_solve": r["detail"]["seconds_per_solve"],
+                    # Accuracy evidence rides with the speed row: the
+                    # f32h-vs-f32 default decision needs both.
+                    "relative_residual": r["detail"].get("relative_residual"),
                 }
             )
             # Checkpoint after EVERY row — the whole point of the harness.
