@@ -45,9 +45,11 @@ PLAUSIBLE_PEAK_TFLOPS = {"bf16": 200.0, "f32": 100.0, "f32h": 140.0}
 # silicon row from an older solver (e.g. the pre-fused dispatch-per-block
 # loop) describes code this round no longer ships: the checkride re-measures
 # instead of skipping, and the round bench never serves it as current.
-# r5: identity-RHS trsm chunking in the factor phase — the unchunked
-# factor program exceeded v5e HBM at the ImageNet bench shape (AOT-verified)
-SOLVER_REV = "r5-chunked-trsm"
+# r5: factor-phase rework, AOT-verified at the bench shapes — (a) identity
+# RHS of the inverse's trsm is column-chunked (the unchunked program
+# exceeded v5e HBM at the ImageNet shape); (b) one trsm + an MXU gemm
+# (A⁻¹ = L⁻ᵀL⁻¹) replaces the chained pair, halving the sequential tail.
+SOLVER_REV = "r5-trsm-gemm-inv"
 
 # (n, d, k, block, iters) per backend class — CPU emulation gets a smaller
 # problem so the gate finishes; the FLOP formula keeps the metric honest.
@@ -75,12 +77,20 @@ SCALE = {
 
 
 def bcd_flops(n: int, d: int, k: int, block: int, iters: int) -> float:
-    """FLOPs of block_coordinate_descent's device work with gram caching
-    (the default for multi-epoch solves): gram + Cholesky + explicit ridge
-    inverse once per block, then per-epoch residual/rhs gemms and one
-    inverse-multiply gemm (no triangular solves in the epoch loop)."""
+    """CANONICAL FLOPs of block_coordinate_descent's device work with gram
+    caching: gram + Cholesky + explicit ridge inverse once per block, then
+    per-epoch residual/rhs gemms and one inverse-multiply gemm (no
+    triangular solves in the epoch loop).
+
+    This is a FIXED accounting, not a per-revision raw-arithmetic count —
+    TFLOPS stay comparable across solver revisions (r3/r4 rows, BASELINE
+    ratios) as canonical-work/time. The formula charges the inverse at
+    2·b³ (the two-trsm formation); the r5 implementation actually spends
+    ~3·b³ there (one trsm + a full YᵀY gemm that ignores Y's
+    triangularity), so reported TFLOPS slightly UNDERSTATE raw device
+    throughput — the conservative direction."""
     nb = d // block
-    # gram + Cholesky + inverse formation (two b×b triangular solves)
+    # gram + Cholesky + canonical inverse formation (charged at 2·b³)
     once = 2.0 * n * block * block + block**3 / 3.0 + 2.0 * block**3
     per_epoch = (
         2.0 * n * block * k  # residual restore  A_b @ W_b

@@ -150,14 +150,18 @@ def _trsm_rhs_chunk(b: int, batch: int, itemsize: int) -> int:
 
 
 def _batched_spd_inv(grams, rhs_chunk: Optional[int] = None):
-    """(Batched) SPD inverse — THE single source for the
-    Cholesky→triangular-solves inverse used by every factor body, batched
-    (leading block axis) or not. XLA lowers a single b×b factorization to
-    a sequential panel loop that dominates many-block factor phases on
-    TPU; the batch dimension runs those loops in parallel, amortizing the
-    sequential lowering. The identity RHS is column-chunked per
-    ``_trsm_rhs_chunk`` (``rhs_chunk`` overrides, for tests) so the
-    unrolled trsm expansion can't blow the HBM temp budget at large b."""
+    """(Batched) SPD inverse — THE single source for the factor-phase
+    inverse, batched (leading block axis) or not.
+
+    Two TPU-shaped choices:
+    - ONE triangular solve, not two. A⁻¹ = (L⁻¹)ᵀ(L⁻¹), so only
+      Y = L⁻¹ is computed by substitution; the second "solve" is an MXU
+      gemm (YᵀY, HIGHEST precision). XLA lowers trsm as a sequential
+      panel loop — halving the trsm count halves the sequential tail of
+      every factor phase, and the batch dimension amortizes what's left.
+    - The identity RHS is column-chunked per ``_trsm_rhs_chunk``
+      (``rhs_chunk`` overrides, for tests) so the unrolled trsm expansion
+      can't blow the HBM temp budget at large b."""
     chol = jnp.linalg.cholesky(grams)
     b = grams.shape[-1]
     batch = int(np.prod(grams.shape[:-2])) if grams.ndim > 2 else 1
@@ -168,25 +172,26 @@ def _batched_spd_inv(grams, rhs_chunk: Optional[int] = None):
     if w >= b:
         eyeb = jnp.broadcast_to(eye, grams.shape)
         y = solve_triangular(chol, eyeb, lower=True)
-        return solve_triangular(chol, y, lower=True, trans=1)
+    else:
+        nc = -(-b // w)
+        eye_pad = jnp.pad(eye, ((0, 0), (0, nc * w - b)))
 
-    nc = -(-b // w)
-    eye_pad = jnp.pad(eye, ((0, 0), (0, nc * w - b)))
+        def chunk_cols(_, c0):
+            rhs = jnp.broadcast_to(
+                lax.dynamic_slice(eye_pad, (0, c0), (b, w)),
+                grams.shape[:-2] + (b, w),
+            )
+            return None, solve_triangular(chol, rhs, lower=True)
 
-    def chunk_cols(_, c0):
-        rhs = jnp.broadcast_to(
-            lax.dynamic_slice(eye_pad, (0, c0), (b, w)),
-            grams.shape[:-2] + (b, w),
+        _, cols = lax.scan(
+            chunk_cols, None, jnp.arange(0, nc * w, w, dtype=jnp.int32)
         )
-        y = solve_triangular(chol, rhs, lower=True)
-        return None, solve_triangular(chol, y, lower=True, trans=1)
-
-    _, cols = lax.scan(
-        chunk_cols, None, jnp.arange(0, nc * w, w, dtype=jnp.int32)
+        # cols: (nc, *batch, b, w) → (*batch, b, nc·w), drop padding.
+        cols = jnp.moveaxis(cols, 0, -2)
+        y = cols.reshape(grams.shape[:-1] + (nc * w,))[..., :b]
+    return jnp.matmul(
+        jnp.swapaxes(y, -1, -2), y, precision=lax.Precision.HIGHEST
     )
-    # cols: (nc, *batch_dims, b, w) → (*batch_dims, b, nc·w), drop padding.
-    cols = jnp.moveaxis(cols, 0, -2)
-    return cols.reshape(grams.shape[:-1] + (nc * w,))[..., :b]
 
 
 @lru_cache(maxsize=None)
