@@ -342,6 +342,51 @@ def test_mid_sweep_tpu_death_sets_degrade_flag(tmp_path, monkeypatch):
     assert not r2["ok"] or r2.get("tpu_dead")
 
 
+def test_precision_recommendation_from_tpu_sweep(tmp_path):
+    """The report self-interprets f32h-vs-f32 sweep evidence: recommend
+    'high' only on ≥1.3× speedup at ≤2× residual, at the largest shared
+    block, and only from TPU rows."""
+    checkride = _sweep_module()
+    rows = [
+        {"block": 8192, "dtype": "f32", "tflops_per_chip": 10.0,
+         "relative_residual": 0.07},
+        {"block": 8192, "dtype": "f32h", "tflops_per_chip": 19.0,
+         "relative_residual": 0.09},
+        {"block": 4096, "dtype": "f32h", "tflops_per_chip": 12.0,
+         "relative_residual": 0.09},
+        {"block": 2048, "dtype": "f32", "error": "failed"},
+    ]
+    import bench
+
+    rp = str(tmp_path / "r.json")
+
+    def seed(**over):
+        state = {"ok": True, "backend": "tpu",
+                 "solver_rev": bench.SOLVER_REV, "rows": rows}
+        state.update(over)
+        checkride._save_state(str(tmp_path), "mfu_sweep", state)
+        checkride._write_report(str(tmp_path), rp, {})
+        return json.loads(open(rp).read())
+
+    rec = seed()["precision_recommendation"]
+    assert rec["recommend"] == "high" and rec["block"] == 8192
+    assert rec["speedup"] == 1.9
+    # Residual blowup flips the call back to highest.
+    rows[1]["relative_residual"] = 0.5
+    assert seed()["precision_recommendation"]["recommend"] == "highest"
+    # Missing residual = no accuracy evidence: never flip blind.
+    rows[1]["relative_residual"] = None
+    rec = seed()["precision_recommendation"]
+    assert rec["recommend"] == "highest" and "missing" in rec["reason"]
+    rows[1]["relative_residual"] = 0.09
+    # Provenance gates: CPU, retired-rev, quick, and partial sweeps carry
+    # no recommendation (same rules as tpu_evidence_steps).
+    assert "precision_recommendation" not in seed(backend="cpu")
+    assert "precision_recommendation" not in seed(solver_rev="r0-retired")
+    assert "precision_recommendation" not in seed(quick_scale=True)
+    assert "precision_recommendation" not in seed(partial=True)
+
+
 def test_cpu_rerun_preserves_partial_tpu_sweep_rows(tmp_path):
     """A partial TPU sweep checkpoint must never be overwritten by a
     CPU-degraded re-run — partial live-chip evidence is the harness's

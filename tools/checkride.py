@@ -147,6 +147,53 @@ def _write_report(state_dir: str, report_path: str, meta: dict) -> None:
                     r["mfu_vs_measured_peak"] = round(
                         r["tflops_per_chip"] / pk, 4
                     )
+    # Self-interpreting precision evidence: once the sweep has TPU rows
+    # for both f32 (HIGHEST) and f32h (HIGH) at a shared block size, say
+    # whether flipping config.solver_precision is supported — ≥1.3×
+    # speedup at ≤2× residual — so a short window's output carries the
+    # decision, not just the numbers.
+    sweep = steps.get("mfu_sweep") or {}
+    if (
+        sweep.get("backend") == "tpu"
+        # Same provenance gates as tpu_evidence_steps: retired-rev, toy
+        # --quick, and mid-death partial sweeps must not drive a
+        # production-default flip.
+        and sweep.get("solver_rev") == bench.SOLVER_REV
+        and not sweep.get("quick_scale")
+        and not sweep.get("partial")
+        and sweep.get("ok")
+    ):
+        by = {}
+        for r in sweep.get("rows", []):
+            if "error" not in r and r.get("tflops_per_chip"):
+                by.setdefault(r["dtype"], {})[r["block"]] = r
+        shared = sorted(
+            set(by.get("f32", {})) & set(by.get("f32h", {})), reverse=True
+        )
+        if shared:
+            blk = shared[0]  # largest shared block = the production regime
+            a, h = by["f32"][blk], by["f32h"][blk]
+            speedup = h["tflops_per_chip"] / a["tflops_per_chip"]
+            ra, rh = a.get("relative_residual"), h.get("relative_residual")
+            # No residual on either row = NO accuracy evidence: stay on
+            # "highest" (the conservative default), never flip blind.
+            resid_ok = ra is not None and rh is not None and rh <= 2.0 * ra
+            report["precision_recommendation"] = {
+                "block": blk,
+                "f32_tflops": a["tflops_per_chip"],
+                "f32h_tflops": h["tflops_per_chip"],
+                "speedup": round(speedup, 2),
+                "f32_residual": ra,
+                "f32h_residual": rh,
+                "recommend": (
+                    "high" if speedup >= 1.3 and resid_ok else "highest"
+                ),
+                "reason": (
+                    "missing residual evidence" if ra is None or rh is None
+                    else f"speedup {speedup:.2f}x, residual "
+                    f"{'ok' if resid_ok else 'degraded'}"
+                ),
+            }
     tmp = report_path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(report, f, indent=1)
