@@ -43,10 +43,14 @@ import bench  # repo-root bench.py: worker protocol, scales, plausible peaks
 # Ordered by evidence value per live-chip minute: one step of every CLASS
 # before more rows of an already-captured class (a ~40 min window should
 # yield maximal evidence diversity) — pallas_fv (never yet captured on
-# silicon) right after the headline bench, the multi-row sweep after every
-# unique class, and bench_xl LAST among measurements: its 2 GiB operands
-# have preceded two relay deaths (r3: the ride died on the first step
-# after it), so it must not sit in front of unique evidence.
+# silicon) right after the headline bench. bench_imagenet (the at-shape
+# number the north star consumes — the r4 verdict's #2 priority) is a
+# KNOWN relay hazard (~6.3 GiB residency, same class as bench_xl), so it
+# runs only after EVERY cheap class has one row — but before the slow
+# multi-row steps (acceptance, sweep), which resume/row-checkpoint and so
+# lose least from a wedge after it. bench_xl stays LAST among
+# measurements: its 2 GiB operands preceded two relay deaths (r3: the
+# ride died on the first step after it).
 STEPS = (
     "bench_f32",
     "pallas_fv",
@@ -59,8 +63,8 @@ STEPS = (
     "factor_primitives",
     "ring_vs_dp",
     "pipeline_rate",
-    "acceptance_synthetic",
     "bench_imagenet",
+    "acceptance_synthetic",
     "mfu_sweep",
     "bench_xl",
     "entry_compile",
