@@ -196,10 +196,7 @@ def worker(scale_key: str, dtype: str) -> None:
     backend = jax.default_backend()
     # HBM high-water (TPU runtimes report it; CPU returns None) — the
     # donation/aliasing evidence channel (SURVEY.md §5 sanitizer row).
-    try:
-        mem = jax.local_devices()[0].memory_stats() or {}
-    except Exception:
-        mem = {}
+    from keystone_tpu.utils.metrics import peak_hbm_bytes
     tflops_per_chip = bcd_flops(n, d, k, block, iters) / dt / 1e12 / n_dev
     peak = PLAUSIBLE_PEAK_TFLOPS[dtype]
     line = {
@@ -219,7 +216,7 @@ def worker(scale_key: str, dtype: str) -> None:
             "seconds_per_solve": round(dt, 4),
             "relative_residual": round(resid, 6),
             "devices": n_dev,
-            "peak_hbm_bytes": mem.get("peak_bytes_in_use"),
+            "peak_hbm_bytes": peak_hbm_bytes(),
         },
     }
     if backend != "cpu" and tflops_per_chip > peak:

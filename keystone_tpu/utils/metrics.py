@@ -76,6 +76,19 @@ def device_hbm_bytes(default: int | None = None) -> int:
     return default if default is not None else config.hbm_budget_bytes
 
 
+def peak_hbm_bytes() -> int | None:
+    """HBM high-water of device 0 (``peak_bytes_in_use``), or None where
+    the runtime doesn't report it (notably CPU) — the one reader every
+    evidence row (bench line, checkride steps) shares, so a runtime that
+    names the key differently is fixed in one place."""
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        return None
+    peak = stats.get("peak_bytes_in_use")
+    return int(peak) if peak is not None else None
+
+
 def achieved_tflops(fn: Callable, *args, repeats: int = 3) -> Dict[str, float]:
     """Compile, time, and convert to achieved TFLOPS (per process)."""
     jitted = jax.jit(fn)
