@@ -78,9 +78,11 @@ def device_hbm_bytes(default: int | None = None) -> int:
 
 def peak_hbm_bytes() -> int | None:
     """HBM high-water of device 0 (``peak_bytes_in_use``), or None where
-    the runtime doesn't report it (notably CPU) — the one reader every
-    evidence row (bench line, checkride steps) shares, so a runtime that
-    names the key differently is fixed in one place."""
+    the runtime doesn't report it (notably CPU). Shared by the
+    single-number evidence rows (bench line, streamed-overlap step); the
+    checkride ``memory_stats`` step deliberately keeps its own multi-key
+    probe — it exists to record the runtime's whole key set, including
+    whatever a different runtime names the peak."""
     try:
         stats = jax.local_devices()[0].memory_stats() or {}
     except Exception:

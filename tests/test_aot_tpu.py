@@ -391,3 +391,18 @@ def test_fused_solver_compiles_at_bench_shapes(mesh, scale_key, expected_chunk):
         _sds((n,), one, P(AXIS)),
     ).compile()
     assert _compiled_ok(c2)
+    if scale_key == "tpu-imagenet":
+        # The UNCACHED body (single-epoch solves, cache_grams auto=False
+        # at num_iters=1) re-derives each block's inverse INSIDE the scan
+        # — the chunked-trsm machinery must fit there too. The dummy invs
+        # operand mirrors _solve_fused's (nb, 1, 1) placeholder.
+        unc = _fused_epochs_fn(one, AXIS, _precision(), False, 1, False)
+        c3 = unc.lower(
+            _sds((nb, n, b), one, P(None, AXIS)),
+            _sds((nb, 1, 1), one, P()),
+            _sds((n, k), one, P(AXIS)),
+            _sds((nb, b, k), one, P()),
+            _sds((), one, P()),
+            _sds((n,), one, P(AXIS)),
+        ).compile()
+        assert _compiled_ok(c3)
