@@ -1,6 +1,13 @@
 # Developer entry points. Pipelines launch via bin/run-pipeline.sh.
 
-.PHONY: test native bench dryrun clean tpu-checkride sentinel northstar acceptance
+.PHONY: test t1 native bench dryrun clean tpu-checkride sentinel northstar acceptance
+
+# The canonical tier-1 verify (ROADMAP.md), verbatim — builders and CI
+# invoke this one entry point instead of hand-copying the command.
+# bash for pipefail/PIPESTATUS.
+t1: SHELL := /bin/bash
+t1:
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # One-command resumable live-chip evidence harness: probes the TPU, runs
 # bench f32/bf16 + MFU sweep + Pallas Mosaic compile + streamed-overlap +

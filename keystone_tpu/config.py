@@ -20,6 +20,18 @@ def env_flag(name: str) -> bool:
     return os.environ.get(name, "").lower() not in ("", "0", "false", "no")
 
 
+def _env_int(name: str, default: int) -> int:
+    """Validated integer env knob: a bad value fails AT IMPORT naming the
+    variable — the same diagnostic contract as _env_choice."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r}: expected an integer") from None
+
+
 def _env_choice(name: str, choices: tuple, default: str) -> str:
     """Validated enum env knob: case-insensitive, and a bad value fails AT
     IMPORT naming the variable — not as a bare KeyError deep in a solve."""
@@ -92,6 +104,17 @@ class Config:
     # skinny per-epoch gemms it wraps, so dispatch count is a first-order
     # solver cost. None/True = on; False = force the legacy per-block loop.
     fused_epochs: bool | None = None
+    # Depth of the bounded host-side prefetch queue in front of the chunked
+    # solvers and streamed pipeline application (loaders/stream.py
+    # PrefetchIterator): the upstream producer — CSV parse, JPEG decode,
+    # map_batches featurization — runs on a background thread up to this
+    # many batches ahead, so host ingest leaves the device's critical path
+    # while peak host residency stays bounded by depth × batch bytes.
+    # 0 restores fully synchronous single-thread ingestion.
+    # Env: KEYSTONE_PREFETCH_DEPTH.
+    prefetch_depth: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_PREFETCH_DEPTH", 2)
+    )
     # Whole-pipeline auto-caching (profile a sample run, persist the best
     # time-saved-per-byte intermediates under a budget). Opt-in: profiling
     # costs a sample execution per optimization.

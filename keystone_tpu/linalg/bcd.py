@@ -26,7 +26,9 @@ from typing import List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+
+from keystone_tpu.utils.compat import shard_map
 from jax.scipy.linalg import cho_factor, cho_solve, solve_triangular
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -34,23 +36,13 @@ from keystone_tpu.config import config
 from keystone_tpu.linalg.row_matrix import (
     RowMatrix,
     _precision,
+    donate_argnums as _donate,
     solver_matmul,
     storage_dtype,
 )
 
 
 # -- shared per-shard solver math (single source for every shard_map body) --
-
-
-def _donate(mesh: Mesh, *argnums: int):
-    """donate_argnums for the solver hot loops on real hardware: the old
-    residual/weight buffers are dead the moment the update returns, and
-    donating them caps the solver's HBM high-water at one live copy
-    (SURVEY.md §5 sanitizer row's donation/aliasing prescription). CPU
-    ignores donation with a per-call warning, so only device meshes opt in."""
-    if mesh.devices.flat[0].platform == "cpu":
-        return ()
-    return argnums
 
 
 def _local_weighted(a_b, w_rows, weighted: bool):
@@ -165,9 +157,13 @@ def _batched_spd_inv(grams, rhs_chunk: Optional[int] = None):
     chol = jnp.linalg.cholesky(grams)
     b = grams.shape[-1]
     batch = int(np.prod(grams.shape[:-2])) if grams.ndim > 2 else 1
-    w = rhs_chunk or _trsm_rhs_chunk(
-        b, batch, jnp.dtype(grams.dtype).itemsize
-    )
+    # `is None`, not truthiness: an explicit rhs_chunk=0 must error, not
+    # silently fall back to the policy (ADVICE r5).
+    if rhs_chunk is None:
+        w = _trsm_rhs_chunk(b, batch, jnp.dtype(grams.dtype).itemsize)
+    else:
+        assert rhs_chunk >= 1, f"rhs_chunk must be >= 1, got {rhs_chunk}"
+        w = rhs_chunk
     eye = jnp.eye(b, dtype=grams.dtype)
     if w >= b:
         eyeb = jnp.broadcast_to(eye, grams.shape)
@@ -835,7 +831,9 @@ def block_coordinate_descent_streamed(
         None if col_center is None else np.asarray(col_center, dtype=A_host.dtype)
     )
 
-    def put(i: int) -> jax.Array:
+    def host_block(i: int) -> np.ndarray:
+        """Host-side block prep — slice/densify, center, cast, pad. Pure
+        numpy on read-only A_host, so the prefetch thread runs it safely."""
         s, e = blocks[i]
         if sparse:
             block = A_host.densify(s, e, dtype=dtype)
@@ -845,7 +843,10 @@ def block_coordinate_descent_streamed(
             block = np.ascontiguousarray(A_host[:, s:e], dtype=dtype)
         if pad:
             block = np.pad(block, ((0, pad), (0, 0)))
-        return jax.device_put(block, sharding)
+        return block
+
+    def put(i: int) -> jax.Array:
+        return jax.device_put(host_block(i), sharding)
 
     weighted = row_weights is not None
     if weighted:
@@ -890,28 +891,56 @@ def block_coordinate_descent_streamed(
     # exists so the checkride can MEASURE what double-buffering buys; it is
     # never the right setting for real runs.
     from keystone_tpu.config import env_flag
+    from keystone_tpu.loaders.stream import PrefetchIterator
 
     no_overlap = env_flag("KEYSTONE_STREAM_NO_OVERLAP")
-    next_buf = None if no_overlap else put(0)
-    for epoch in range(start_epoch, num_iters):
-        for i in range(nb):
-            if no_overlap:
-                cur = put(i)
-                cur.block_until_ready()
-            else:
-                cur = next_buf
-                # Prefetch the next block while this one computes (double
-                # buffering): H2D DMA overlaps the MXU work.
-                if epoch + 1 < num_iters or i + 1 < nb:
-                    next_buf = put((i + 1) % nb)
-            if invs[i] is None:
-                R, W[i], invs[i] = first(cur, R, W[i], lam_arr, w_rows)
-            else:
-                R, W[i] = cached(cur, invs[i], R, W[i], w_rows)
-            if throttle:
-                R.block_until_ready()
-        if checkpoint_dir is not None:
-            _save_epoch(checkpoint_dir, epoch + 1, W, R, fingerprint)
+    # Host-side block prep (densify/center/cast/pad) runs on a background
+    # prefetch thread, config.prefetch_depth blocks ahead, on top of the
+    # existing H2D double buffer: the device then never waits on the numpy
+    # prep either. depth=0 keeps the prep inline on the consumer thread.
+    depth = 0 if no_overlap else max(0, int(config.prefetch_depth))
+    total = (num_iters - start_epoch) * nb
+    src = None
+    if depth > 0:
+
+        def host_blocks():
+            for _ in range(start_epoch, num_iters):
+                for i in range(nb):
+                    yield host_block(i)
+
+        src = PrefetchIterator(host_blocks(), depth)
+
+    def put_ahead(i_next: int) -> jax.Array:
+        if src is not None:
+            return jax.device_put(next(src), sharding)
+        return put(i_next)
+
+    try:
+        next_buf = None if no_overlap else put_ahead(0)
+        consumed = 0
+        for epoch in range(start_epoch, num_iters):
+            for i in range(nb):
+                if no_overlap:
+                    cur = put(i)
+                    cur.block_until_ready()
+                else:
+                    cur = next_buf
+                    consumed += 1
+                    # Prefetch the next block while this one computes
+                    # (double buffering): H2D DMA overlaps the MXU work.
+                    if consumed < total:
+                        next_buf = put_ahead((i + 1) % nb)
+                if invs[i] is None:
+                    R, W[i], invs[i] = first(cur, R, W[i], lam_arr, w_rows)
+                else:
+                    R, W[i] = cached(cur, invs[i], R, W[i], w_rows)
+                if throttle:
+                    R.block_until_ready()
+            if checkpoint_dir is not None:
+                _save_epoch(checkpoint_dir, epoch + 1, W, R, fingerprint)
+    finally:
+        if src is not None:
+            src.close()
     if checkpoint_dir is not None:
         wait_for_checkpoints(checkpoint_dir)
     return W, blocks

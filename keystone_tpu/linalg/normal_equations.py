@@ -9,14 +9,24 @@ than shipping it anywhere).
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.scipy.linalg import cho_factor, cho_solve
 
-from keystone_tpu.linalg.row_matrix import RowMatrix
+from keystone_tpu.utils.compat import shard_map
+from jax.scipy.linalg import cho_factor, cho_solve
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from keystone_tpu.config import config
+from keystone_tpu.linalg.row_matrix import (
+    RowMatrix,
+    _precision,
+    donate_argnums,
+    solver_matmul,
+    storage_dtype,
+)
 
 
 @partial(jax.jit, static_argnames=("refine_steps",))
@@ -45,8 +55,44 @@ def solve_least_squares_normal(
     )
 
 
+@lru_cache(maxsize=None)
+def _accum_gram_atb_fn(mesh: Mesh, axis: str, precision):
+    """One fused program per chunk: psum'd (AᵀA, AᵀB) added into the
+    running accumulators. Everything is donated — the accumulators because
+    the previous values are dead once the sums exist, and the CHUNK buffers
+    because the overlapped loop never touches a chunk after its
+    accumulation step, so XLA recycles their HBM for the next transfer and
+    device residency stays at two in-flight chunk buffers regardless of
+    stream length."""
+
+    def local(gram, atb, a, b):
+        return (
+            gram + lax.psum(solver_matmul(a.T, a, precision), axis),
+            atb + lax.psum(solver_matmul(a.T, b, precision), axis),
+        )
+
+    sm = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis), P(axis)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sm, donate_argnums=donate_argnums(mesh, 0, 1, 2, 3))
+
+
+def _put_labeled_chunk(chunk):
+    X_chunk, Y_chunk = chunk
+    if Y_chunk is None:
+        raise ValueError("chunked solve needs labeled batches")
+    A = RowMatrix.from_array(X_chunk, dtype=storage_dtype())
+    B = RowMatrix.from_array(Y_chunk)
+    return A, B
+
+
 def solve_least_squares_chunked(
-    batches, lam: float = 0.0, refine_steps: int = 1
+    batches, lam: float = 0.0, refine_steps: int = 1,
+    prefetch_depth: int | None = None,
 ) -> jax.Array:
     """Normal-equation solve over an out-of-core row stream.
 
@@ -56,17 +102,84 @@ def solve_least_squares_chunked(
     ``treeAggregate`` over RDD partitions, so n is bounded only by the
     source, not by host or device memory. Each chunk's gram rides the
     mesh's psum; the accumulator stays replicated on-device.
+
+    ``prefetch_depth`` (default ``config.prefetch_depth``) > 0 takes the
+    overlapped path: the producer runs ``depth`` batches ahead on a
+    background thread (unless ``batches`` is already a PrefetchIterator),
+    the next chunk's host→device transfer is issued while the current
+    chunk's accumulation is in flight, and the accumulation step donates
+    both accumulators and the consumed chunk buffers. 0 restores the
+    fully synchronous loop.
     """
+    depth = config.prefetch_depth if prefetch_depth is None else int(prefetch_depth)
+    from contextlib import nullcontext
+
+    from keystone_tpu.config import env_flag
+    from keystone_tpu.loaders.stream import PrefetchIterator, prefetched
+
+    # The measurement knob wins over any depth (matching the streamed BCD
+    # path): serialized means serialized, even at the default prefetch
+    # depth or for a caller-built PrefetchIterator.
+    if env_flag("KEYSTONE_STREAM_NO_OVERLAP"):
+        return _solve_chunked_sync(batches, lam, refine_steps)
+    if depth <= 0 and not isinstance(batches, PrefetchIterator):
+        return _solve_chunked_sync(batches, lam, refine_steps)
+
+    # Respect an upstream-constructed prefetcher (the bench hands one in to
+    # read its queue high-water afterwards) instead of double-wrapping —
+    # and leave closing it to its owner.
+    own = not isinstance(batches, PrefetchIterator)
+    ctx = prefetched(iter(batches), depth) if own else nullcontext(batches)
+    with ctx as src:
+        it = iter(src)
+        first = next(it, None)
+        if first is None:
+            raise ValueError("empty batch stream")
+        cur = _put_labeled_chunk(first)
+        mesh = cur[0].mesh
+        accum = _accum_gram_atb_fn(mesh, config.data_axis, _precision())
+        cdtype = jnp.dtype(config.accum_dtype)
+        d = cur[0].data.shape[1]
+        # Labels may be 1-D (a single regression/class column — the CSV
+        # label_col shape); AᵀB is then (d,) and the Cholesky solve
+        # accepts the vector rhs directly, same as the sync path.
+        b_tail = cur[1].data.shape[1:]
+        replicated = NamedSharding(mesh, P())
+        gram = jax.device_put(jnp.zeros((d, d), dtype=cdtype), replicated)
+        atb = jax.device_put(jnp.zeros((d,) + b_tail, dtype=cdtype), replicated)
+        while cur is not None:
+            A, B = cur
+            # Dispatch is async: the gemms run while the host fetches (the
+            # producer thread parses/featurizes ahead) and stages the next
+            # chunk's transfer.
+            gram, atb = accum(gram, atb, A.data, B.data)
+            nxt = next(it, None)
+            cur = None if nxt is None else _put_labeled_chunk(nxt)
+    return _chol_solve(
+        gram, atb, jnp.asarray(lam, dtype=gram.dtype), refine_steps
+    )
+
+
+def _solve_chunked_sync(batches, lam: float, refine_steps: int) -> jax.Array:
+    """The prefetch_depth=0 path: one thread, one chunk in flight — the
+    pre-overlap behavior, preserved exactly for A/B measurement and as the
+    fallback where background threads are unwelcome.
+
+    KEYSTONE_STREAM_NO_OVERLAP=1 additionally blocks on each chunk's
+    reduction, serializing ingest and compute outright — the same
+    measurement knob the streamed BCD path honors, so benches can price
+    what overlap (including plain async dispatch) buys. Never the right
+    setting for real runs."""
+    from keystone_tpu.config import env_flag
+
+    serialize = env_flag("KEYSTONE_STREAM_NO_OVERLAP")
     gram = None
     atb = None
-    from keystone_tpu.linalg.row_matrix import storage_dtype
-
-    for X_chunk, Y_chunk in batches:
-        if Y_chunk is None:
-            raise ValueError("chunked solve needs labeled batches")
-        A = RowMatrix.from_array(X_chunk, dtype=storage_dtype())
-        B = RowMatrix.from_array(Y_chunk)
+    for chunk in batches:
+        A, B = _put_labeled_chunk(chunk)
         g, ab = A.gram_and_atb(B)  # fused: one read of the chunk
+        if serialize:
+            jax.block_until_ready((g, ab))
         gram = g if gram is None else gram + g
         atb = ab if atb is None else atb + ab
     if gram is None:

@@ -15,7 +15,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+
+from keystone_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from keystone_tpu.config import config
@@ -33,6 +35,17 @@ def _precision():
 def storage_dtype():
     """Dtype for the solver's big operands (config.solver_storage_dtype)."""
     return jnp.dtype(config.solver_storage_dtype or config.default_dtype)
+
+
+def donate_argnums(mesh: Mesh, *argnums: int):
+    """donate_argnums for the solver hot loops on real hardware: the old
+    residual/weight/accumulator buffers are dead the moment the update
+    returns, and donating them caps the solver's HBM high-water at one live
+    copy (SURVEY.md §5 sanitizer row's donation/aliasing prescription). CPU
+    ignores donation with a per-call warning, so only device meshes opt in."""
+    if mesh.devices.flat[0].platform == "cpu":
+        return ()
+    return argnums
 
 
 def solver_matmul(x, y, precision):

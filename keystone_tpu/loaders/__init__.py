@@ -1,6 +1,17 @@
 from keystone_tpu.loaders.labeled_data import LabeledData
 from keystone_tpu.loaders.csv_loader import CsvDataLoader
 from keystone_tpu.loaders.mnist import MnistLoader
-from keystone_tpu.loaders.stream import BatchIterator
+from keystone_tpu.loaders.stream import (
+    BatchIterator,
+    PrefetchIterator,
+    prefetch_batches,
+)
 
-__all__ = ["LabeledData", "CsvDataLoader", "MnistLoader", "BatchIterator"]
+__all__ = [
+    "LabeledData",
+    "CsvDataLoader",
+    "MnistLoader",
+    "BatchIterator",
+    "PrefetchIterator",
+    "prefetch_batches",
+]

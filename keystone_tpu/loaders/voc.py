@@ -66,6 +66,12 @@ class VOCLoader:
             np.stack(images).astype(config.default_dtype), labels
         )
 
+    # Expected present classes per synthetic image: `synthetic` draws
+    # r.integers(1, 3) — 1 or 2 present classes, uniformly — so E = 1.5.
+    # Exported so the acceptance harness's mAP noise band derives its
+    # prevalence from the same sampling rule it bounds (ADVICE r5).
+    SYNTH_PRESENT_CLASSES_MEAN = 1.5
+
     @staticmethod
     def synthetic(
         n: int = 256, num_classes: int = 6, size: int = 64, seed: int = 0

@@ -97,7 +97,11 @@ def _fv_pallas(X, w, mu, var, tile_m: int, interpret: bool):
     # Grid semantics for Mosaic: image programs are independent
     # ("parallel"); the m-tile axis accumulates into the same output block
     # and must iterate in order ("arbitrary"). Ignored by the interpreter.
-    compiler_params = pltpu.CompilerParams(
+    # (TPUCompilerParams is the pre-rename spelling of CompilerParams.)
+    params_cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
+    compiler_params = params_cls(
         dimension_semantics=("parallel", "arbitrary")
     )
 

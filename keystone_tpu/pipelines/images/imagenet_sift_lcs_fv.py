@@ -200,9 +200,13 @@ def run_streamed(conf: ImageNetSiftLcsFVConfig) -> dict:
     t0 = time.time()
     featurizer = build_featurizer(conf, fit_sample)
 
+    # apply_batches runs the batch producer (JPEG decode / synthetic read)
+    # on a prefetch thread while the fused featurizer chain computes on the
+    # current batch — decode of batch b+1 overlaps featurization of b on
+    # every source, not just the real-data loader's decode-ahead pool.
     feats, labels = [], []
-    for X, y in train_batches():
-        feats.append(np.asarray(featurizer(X).get()))
+    for F, y in featurizer.apply_batches(train_batches()):
+        feats.append(np.asarray(F))
         labels.append(np.asarray(y))
     if not feats:
         raise ValueError(
@@ -236,25 +240,36 @@ def run_streamed(conf: ImageNetSiftLcsFVConfig) -> dict:
     if conf.augment:
         patcher, averager = _build_tta(conf, int(np.asarray(fit_sample).shape[1]))
 
+    def score_batches():
+        """(scores, labels) per test batch, ingest-overlapped either way:
+        the plain path featurizes via apply_batches (decode on the prefetch
+        thread), the TTA path prefetches raw batches and expands views on
+        the consumer side (the view tensor must stay sub-batch-bounded)."""
+        if patcher is None:
+            for F, y in featurizer.apply_batches(test_batches()):
+                yield model.apply_batch(np.asarray(F)), y
+            return
+        from keystone_tpu.loaders.stream import prefetched
+
+        with prefetched(iter(test_batches())) as src:
+            for X, y in src:
+                # Patch per image sub-batch so the view tensor never
+                # exceeds ~stream_batch rows on the device (a whole-batch
+                # patch at the real-data scale is a ~2 GB transient, 10×
+                # the working set this mode exists to bound).
+                X = np.asarray(X)
+                sub = max(1, conf.stream_batch // patcher.num_views)
+                view_scores = np.concatenate([
+                    np.asarray(model.apply_batch(np.asarray(
+                        featurizer(patcher(X[i : i + sub])).get()
+                    )))
+                    for i in range(0, len(X), sub)
+                ])
+                yield averager.average_scores(view_scores), y
+
     correct = []
     top1_wrong = []
-    for X, y in test_batches():
-        if patcher is not None:
-            # Patch per image sub-batch so the view tensor never exceeds
-            # ~stream_batch rows on the device (a whole-batch patch at the
-            # real-data scale is a ~2 GB transient, 10× the working set
-            # this mode exists to bound).
-            X = np.asarray(X)
-            sub = max(1, conf.stream_batch // patcher.num_views)
-            view_scores = np.concatenate([
-                np.asarray(model.apply_batch(np.asarray(
-                    featurizer(patcher(X[i : i + sub])).get()
-                )))
-                for i in range(0, len(X), sub)
-            ])
-            scores = averager.average_scores(view_scores)
-        else:
-            scores = model.apply_batch(np.asarray(featurizer(X).get()))
+    for scores, y in score_batches():
         topk = np.asarray(TopKClassifier(conf.top_k)(scores))
         correct.append((topk == np.asarray(y)[:, None]).any(axis=1))
         top1_wrong.append(topk[:, 0] != np.asarray(y))

@@ -1,0 +1,35 @@
+"""JAX cross-version shims.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the top-level
+``jax`` namespace, and its replication-check kwarg was renamed
+``check_rep`` → ``check_vma`` along the way. The solver stack imports it
+from here and always passes ``check_vma=``; the shim resolves the import
+location and translates the kwarg for whichever jax the image bakes in, so
+the same source runs against both API generations.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # newer jax: top-level export (check_vma kwarg)
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # older jax: experimental namespace (check_rep kwarg)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_ACCEPTS_CHECK_VMA = "check_vma" in inspect.signature(_shard_map).parameters
+
+
+def shard_map(f=None, **kwargs):
+    """``jax.shard_map`` with the check kwarg translated per version.
+
+    Works both called directly (``shard_map(fn, mesh=..., ...)``) and as a
+    keyword-configured decorator via ``partial(shard_map, mesh=..., ...)``.
+    """
+    if not _ACCEPTS_CHECK_VMA and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    elif _ACCEPTS_CHECK_VMA and "check_rep" in kwargs:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    if f is None:
+        return lambda fn: _shard_map(fn, **kwargs)
+    return _shard_map(f, **kwargs)

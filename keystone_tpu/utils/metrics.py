@@ -36,6 +36,10 @@ def cost_analysis(fn: Callable, *args) -> Dict[str, Any]:
     lowered = jax.jit(fn).lower(*args)
     compiled = lowered.compile()
     cost = compiled.cost_analysis() or {}
+    # Older jax returns a one-element list of dicts (per-executable);
+    # newer returns the dict directly.
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
     return {
         "flops": float(cost.get("flops", 0.0)),
         "bytes_accessed": float(cost.get("bytes accessed", 0.0)),

@@ -378,6 +378,30 @@ class Pipeline:
     def __call__(self, data) -> "PipelineDataset":
         return self.apply(data)
 
+    def apply_batches(self, batches, prefetch_depth: Optional[int] = None):
+        """Stream row batches through the pipeline with ingest overlap.
+
+        ``batches`` is any iterable of ``(features, labels-or-None)`` pairs
+        or bare feature batches (``loaders.stream.BatchIterator`` included).
+        The upstream producer — CSV parse, JPEG decode, ``map_batches``
+        featurization — runs on a background prefetch thread
+        (``prefetch_depth`` deep, default ``config.prefetch_depth``; 0 =
+        synchronous passthrough) while the fused transformer chain computes
+        on the current batch, so host ingest leaves the device's critical
+        path. Yields ``(transformed_batch, labels)`` in source order —
+        the out-of-core scoring/featurization loop of the streamed
+        pipelines.
+        """
+        from keystone_tpu.loaders.stream import prefetched
+
+        with prefetched(iter(batches), prefetch_depth) as src:
+            for item in src:
+                if isinstance(item, tuple) and len(item) == 2:
+                    X, y = item
+                else:
+                    X, y = item, None
+                yield self.apply(X).get(), y
+
     def apply_datum(self, datum) -> Any:
         """Apply to a single datum, eagerly (driver-local in the reference).
 

@@ -1,0 +1,258 @@
+"""Streaming-equivalence tests for the prefetching ingestion layer.
+
+The contract the chunked solvers rely on (loaders/stream.py
+PrefetchIterator): prefetched iteration yields the producer's batches
+bit-identically and in order, producer exceptions surface in the
+consumer, ``prefetch_depth=0`` is a true passthrough, and the overlapped
+solver paths match their synchronous counterparts exactly.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from keystone_tpu.config import config
+
+
+@pytest.fixture
+def depth_config():
+    """Restore config.prefetch_depth after tests that flip it."""
+    prior = config.prefetch_depth
+    yield config
+    config.prefetch_depth = prior
+
+
+def test_prefetch_bit_identical_in_order(rng):
+    from keystone_tpu.loaders.stream import BatchIterator, PrefetchIterator
+
+    X = rng.normal(size=(1000, 7)).astype(np.float32)
+    y = rng.integers(0, 3, 1000).astype(np.int32)
+    it = BatchIterator.from_arrays(X, y, batch_rows=128)
+    sync = list(it)
+    pre = list(PrefetchIterator(iter(it), depth=2))
+    assert len(pre) == len(sync)
+    for (xs, ys), (xp, yp) in zip(sync, pre):
+        np.testing.assert_array_equal(xs, xp)
+        np.testing.assert_array_equal(ys, yp)
+
+
+def test_prefetch_propagates_producer_exception():
+    from keystone_tpu.loaders.stream import PrefetchIterator
+
+    def gen():
+        yield np.zeros((2, 2)), None
+        raise RuntimeError("boom in producer")
+
+    it = PrefetchIterator(gen(), depth=2)
+    next(it)
+    with pytest.raises(RuntimeError, match="boom in producer"):
+        next(it)
+    # Exhausted after the error; no hang, no replay.
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_prefetch_depth_zero_is_true_passthrough():
+    from keystone_tpu.loaders.stream import prefetch_batches
+
+    src = iter([1, 2, 3])
+    assert prefetch_batches(src, 0) is src
+
+
+def test_prefetch_rejects_invalid_depth():
+    from keystone_tpu.loaders.stream import PrefetchIterator
+
+    with pytest.raises(ValueError, match="depth"):
+        PrefetchIterator(iter([]), depth=0)
+
+
+def test_prefetch_bounded_queue_and_close_stops_producer():
+    from keystone_tpu.loaders.stream import PrefetchIterator
+
+    produced = []
+
+    def gen():
+        for i in range(1000):
+            produced.append(i)
+            yield i
+
+    it = PrefetchIterator(gen(), depth=2)
+    assert next(it) == 0
+    it.close()
+    it._thread.join(timeout=5)
+    assert not it._thread.is_alive()
+    # Bounded: the producer can never run more than depth ahead of the
+    # consumer plus the item in its own hands.
+    assert len(produced) <= 1 + 2 + 1
+    assert it.max_queued <= 2
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_batch_iterator_prefetch_is_reiterable(rng):
+    from keystone_tpu.loaders.stream import BatchIterator
+
+    X = rng.normal(size=(64, 3)).astype(np.float32)
+    pre = BatchIterator.from_arrays(X, batch_rows=16).prefetch(2)
+    first = [x for x, _ in pre]
+    second = [x for x, _ in pre]
+    assert len(first) == len(second) == 4
+    for a, b in zip(first, second):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_map_batches_runs_on_prefetch_thread(rng):
+    """The featurization chain (map_batches) executes on the producer
+    thread when prefetched — the ingest work leaves the consumer."""
+    from keystone_tpu.loaders.stream import BatchIterator
+
+    X = rng.normal(size=(64, 3)).astype(np.float32)
+    main_thread = threading.current_thread()
+    seen_threads = []
+
+    def feat(batch):
+        seen_threads.append(threading.current_thread())
+        return batch * 2.0
+
+    it = BatchIterator.from_arrays(X, batch_rows=16).map_batches(feat)
+    out = [x for x, _ in it.prefetch(2)]
+    assert len(out) == 4
+    assert all(t is not main_thread for t in seen_threads)
+    np.testing.assert_allclose(np.concatenate(out), X * 2.0, atol=0)
+
+
+def test_chunked_solve_prefetched_matches_sync(rng):
+    from keystone_tpu.linalg import solve_least_squares_chunked
+    from keystone_tpu.loaders.stream import BatchIterator
+
+    A = rng.normal(size=(500, 16)).astype(np.float32)
+    W0 = rng.normal(size=(16, 3)).astype(np.float32)
+    B = A @ W0
+    it = lambda: BatchIterator.from_arrays(A, B, batch_rows=128)
+    W_sync = np.asarray(
+        solve_least_squares_chunked(it(), lam=0.2, prefetch_depth=0)
+    )
+    W_pre = np.asarray(
+        solve_least_squares_chunked(it(), lam=0.2, prefetch_depth=2)
+    )
+    np.testing.assert_array_equal(W_sync, W_pre)
+    # And both still solve the ridge problem.
+    reg = A.T @ A + 0.2 * np.eye(16, dtype=np.float32)
+    oracle = np.linalg.solve(reg, A.T @ B)
+    np.testing.assert_allclose(W_sync, oracle, rtol=1e-3, atol=1e-3)
+
+
+def test_chunked_solve_prefetched_handles_1d_labels(rng):
+    """The CSV label_col shape: labels stream as a 1-D column and AᵀB is a
+    vector — the overlapped path must accept it like the sync path does."""
+    from keystone_tpu.linalg import solve_least_squares_chunked
+    from keystone_tpu.loaders.stream import BatchIterator
+
+    A = rng.normal(size=(300, 12)).astype(np.float32)
+    y = (A @ rng.normal(size=(12,)).astype(np.float32)).astype(np.float32)
+    it = lambda: BatchIterator.from_arrays(A, y, batch_rows=64)
+    w_sync = np.asarray(
+        solve_least_squares_chunked(it(), lam=0.1, prefetch_depth=0)
+    )
+    w_pre = np.asarray(
+        solve_least_squares_chunked(it(), lam=0.1, prefetch_depth=2)
+    )
+    assert w_pre.shape == (12,)
+    np.testing.assert_array_equal(w_sync, w_pre)
+
+
+def test_chunked_solve_error_paths_overlapped(rng):
+    from keystone_tpu.linalg import solve_least_squares_chunked
+
+    A = rng.normal(size=(64, 8)).astype(np.float32)
+    B = rng.normal(size=(64, 2)).astype(np.float32)
+
+    with pytest.raises(ValueError, match="empty"):
+        solve_least_squares_chunked(iter([]), prefetch_depth=2)
+    with pytest.raises(ValueError, match="labeled"):
+        solve_least_squares_chunked(iter([(A, None)]), prefetch_depth=2)
+
+    def boom():
+        yield A, B
+        raise RuntimeError("producer exploded")
+
+    with pytest.raises(RuntimeError, match="producer exploded"):
+        solve_least_squares_chunked(boom(), prefetch_depth=2)
+
+
+def test_streamed_bcd_prefetch_matches_sync(rng, depth_config):
+    from keystone_tpu.linalg import RowMatrix
+    from keystone_tpu.linalg.bcd import (
+        assemble_blocks,
+        block_coordinate_descent_streamed,
+    )
+
+    A = rng.normal(size=(200, 32)).astype(np.float32)
+    W0 = rng.normal(size=(32, 4)).astype(np.float32)
+    B = A @ W0
+
+    depth_config.prefetch_depth = 2
+    W_pre, _ = block_coordinate_descent_streamed(
+        A, RowMatrix.from_array(B), 8, 3, lam=0.1
+    )
+    depth_config.prefetch_depth = 0
+    W_sync, _ = block_coordinate_descent_streamed(
+        A, RowMatrix.from_array(B), 8, 3, lam=0.1
+    )
+    np.testing.assert_array_equal(
+        np.asarray(assemble_blocks(W_pre)), np.asarray(assemble_blocks(W_sync))
+    )
+
+
+def test_pipeline_apply_batches_matches_eager(rng, depth_config):
+    from keystone_tpu.loaders.stream import BatchIterator
+    from keystone_tpu.workflow.pipeline import Transformer
+
+    class Times3(Transformer):
+        def apply_batch(self, X):
+            return X * 3.0
+
+    X = rng.normal(size=(96, 5)).astype(np.float32)
+    y = rng.integers(0, 2, 96).astype(np.int32)
+    pipe = Times3().to_pipeline()
+
+    batches = BatchIterator.from_arrays(X, y, batch_rows=32)
+    eager = [np.asarray(pipe.apply(Xb).get()) for Xb, _ in batches]
+
+    outs, ys = [], []
+    for F, yb in pipe.apply_batches(batches, prefetch_depth=2):
+        outs.append(np.asarray(F))
+        ys.append(np.asarray(yb))
+    assert len(outs) == len(eager)
+    for a, b in zip(outs, eager):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(np.concatenate(ys), y)
+
+    # depth=0: synchronous passthrough yields the same stream.
+    sync = [np.asarray(F) for F, _ in pipe.apply_batches(batches, prefetch_depth=0)]
+    for a, b in zip(sync, eager):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_prefetch_abandoned_consumer_stops_thread():
+    """A consumer that bails mid-stream (exception/early break) must not
+    leave the producer thread parked on the bounded queue."""
+    from keystone_tpu.loaders.stream import PrefetchIterator
+
+    stopped = threading.Event()
+
+    def gen():
+        try:
+            for i in range(10_000):
+                yield i
+        finally:
+            stopped.set()
+
+    it = PrefetchIterator(gen(), depth=1)
+    assert next(it) == 0
+    thread = it._thread
+    it.close()
+    thread.join(timeout=5)
+    assert not thread.is_alive()
+    assert stopped.wait(timeout=1)

@@ -147,16 +147,25 @@ def _timit(root):
     )
 
 
+# Synthetic-run configs, shared by the runners AND the noise_band closed
+# forms below (ADVICE r5: the band constants were independent hardcodes of
+# these values — a drift in synthetic_classes/top_k would silently
+# miscalibrate the band and pass out-of-band results).
+VOC_SYNTH = dict(
+    synthetic_n=96, synthetic_classes=4, pca_dims=24, gmm_k=4,
+    descriptor_sample=20_000, num_iters=1,
+)
+IMAGENET_SYNTH = dict(
+    synthetic_n=256, synthetic_classes=8, pca_dims=16, gmm_k=4,
+    descriptor_sample=30_000, num_iters=1, top_k=5,
+)
+
+
 def _voc(root):
     from keystone_tpu.pipelines.images import voc_sift_fisher as m
 
     if root is None:
-        return m.run(
-            m.VOCSIFTFisherConfig(
-                synthetic_n=96, synthetic_classes=4, pca_dims=24, gmm_k=4,
-                descriptor_sample=20_000, num_iters=1,
-            )
-        )
+        return m.run(m.VOCSIFTFisherConfig(**VOC_SYNTH))
     img = os.path.join(root, "voc", "JPEGImages")
     if not os.path.isdir(img):
         return None
@@ -174,12 +183,7 @@ def _imagenet(root):
     from keystone_tpu.pipelines.images import imagenet_sift_lcs_fv as m
 
     if root is None:
-        return m.run(
-            m.ImageNetSiftLcsFVConfig(
-                synthetic_n=256, synthetic_classes=8, pca_dims=16, gmm_k=4,
-                descriptor_sample=30_000, num_iters=1, top_k=5,
-            )
-        )
+        return m.run(m.ImageNetSiftLcsFVConfig(**IMAGENET_SYNTH))
     tr = os.path.join(root, "imagenet", "train")
     if not os.path.isdir(tr):
         return None
@@ -244,16 +248,25 @@ def noise_band(name: str, p: float):
       p·(1-π)·n flipped negatives uniform in the tail, where precision at
       depth t is ((1-p)π + p·t)/(π + t); integrating, the tail averages
       [p(1-π) + π(1-2p)·ln(1/π)]/(1-π). VOC synthetic prevalence is
-      π = 1.5/C (1 or 2 present classes per image, voc.py synthetic).
+      π = E[present classes]/C from the loader's own sampling rule.
       Ceiling + 0.05 slack (64-image test split is noisy).
+
+    Every synthetic-run constant here (C, k, π) is read from VOC_SYNTH /
+    IMAGENET_SYNTH / the VOC loader — the SAME objects the runners use —
+    so the closed forms can't drift from the runs they bound (ADVICE r5).
     """
     import math
+
+    from keystone_tpu.loaders.voc import VOCLoader
 
     acc_hi = 1.0 - p / 2.0
     def map_ceiling(pi):
         pos, neg = (1.0 - p) * pi, p * (1.0 - pi)
         tail = (p * (1.0 - pi) + pi * (1.0 - 2.0 * p) * math.log(1.0 / pi)) / (1.0 - pi)
         return (pos * (1.0 - p) + neg * tail) / (pos + neg)
+    imagenet_c = IMAGENET_SYNTH["synthetic_classes"]
+    imagenet_k = IMAGENET_SYNTH["top_k"]
+    voc_pi = VOCLoader.SYNTH_PRESENT_CLASSES_MEAN / VOC_SYNTH["synthetic_classes"]
     bands = {
         "MnistRandomFFT": (None, acc_hi),
         "LinearPixels": (None, acc_hi),
@@ -261,9 +274,10 @@ def noise_band(name: str, p: float):
         "NewsgroupsPipeline": (None, acc_hi),
         "AmazonReviewsPipeline": (None, (1.0 - p) + p / 4.0),
         "TimitPipeline": (p / 2.0, None),
-        # synthetic_classes=8, top_k=5 (the _imagenet runner above)
-        "ImageNetSiftLcsFV": (p * (8 - 5) / (8 - 1) / 2.0, None),
-        "VOCSIFTFisher": (None, map_ceiling(1.5 / 4.0) + 0.05),
+        "ImageNetSiftLcsFV": (
+            p * (imagenet_c - imagenet_k) / (imagenet_c - 1) / 2.0, None
+        ),
+        "VOCSIFTFisher": (None, map_ceiling(voc_pi) + 0.05),
     }
     return bands.get(name, (None, acc_hi if p < 0.5 else None))
 

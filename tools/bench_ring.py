@@ -70,6 +70,7 @@ def measure(n, d, k, iters, lam, reps):
     W_true = rng.normal(size=(d, k)).astype(np.float32)
     B = A @ W_true
     nshards = len(jax.devices())
+    # d % nshards validated in main() (naming the offending flag).
     block = d // nshards  # DP uses the ring's per-chip block for parity
 
     W_dp, t_dp = _timed(lambda: _solve_dp(A, B, block, iters, lam), reps)
@@ -109,6 +110,18 @@ def main() -> None:
 
     backend = ensure_live_backend()
     import jax
+
+    # Validate up front, naming the offending flag — a non-divisible d
+    # otherwise surfaces deep in the solvers as an opaque shape error.
+    ndev = len(jax.devices())
+    for flag, d in (("--d-control", args.d_control), ("--d-wide", args.d_wide)):
+        if d % ndev != 0:
+            sys.exit(
+                f"error: {flag}={d} is not divisible by the device count "
+                f"({ndev}); the ring solver shards d per chip and the DP "
+                "run reuses d // n_devices as its block size — pick a "
+                f"multiple of {ndev}"
+            )
 
     rows = [
         measure(args.n, d, args.k, args.iters, args.lam, args.reps)
