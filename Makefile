@@ -1,6 +1,6 @@
 # Developer entry points. Pipelines launch via bin/run-pipeline.sh.
 
-.PHONY: test t1 native bench dryrun clean tpu-checkride sentinel northstar acceptance
+.PHONY: test t1 native bench bench-serve dryrun clean tpu-checkride sentinel northstar acceptance
 
 # The canonical tier-1 verify (ROADMAP.md), verbatim — builders and CI
 # invoke this one entry point instead of hand-copying the command.
@@ -39,6 +39,12 @@ native:
 
 bench:
 	python bench.py
+
+# Shape-stable serving: per-shape jit vs bucketed+AOT-warmed on a
+# mixed-size request trace. Gate: zero post-warmup compiles, >=2x p99.
+# Writes the machine-readable BENCH_serve.json regression anchor.
+bench-serve:
+	python tools/bench_serve.py --out BENCH_serve.json
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -32,6 +32,46 @@ def _env_int(name: str, default: int) -> int:
         raise ValueError(f"{name}={raw!r}: expected an integer") from None
 
 
+def pow2_ladder(max_batch: int) -> tuple:
+    """Power-of-two bucket ladder up to (and always including) max_batch —
+    the default shape set the serving layer pads batches onto."""
+    if max_batch <= 0:
+        raise ValueError(f"max_batch must be positive, got {max_batch}")
+    ladder = []
+    b = 1
+    while b < max_batch:
+        ladder.append(b)
+        b *= 2
+    ladder.append(max_batch)
+    return tuple(ladder)
+
+
+def _env_buckets() -> tuple:
+    """Parse KEYSTONE_SERVE_BUCKETS: empty/unset = () (today's per-shape
+    jit), 'pow2' = power-of-two ladder up to serve_max_batch, else a
+    comma-separated ascending bucket list. Bad values fail AT IMPORT naming
+    the variable (same contract as _env_choice)."""
+    raw = os.environ.get("KEYSTONE_SERVE_BUCKETS")
+    if raw is None or not raw.strip():
+        return ()
+    if raw.strip().lower() == "pow2":
+        return pow2_ladder(_env_int("KEYSTONE_SERVE_MAX_BATCH", 1024))
+    try:
+        vals = tuple(
+            sorted({int(tok) for tok in raw.split(",") if tok.strip()})
+        )
+    except ValueError:
+        raise ValueError(
+            f"KEYSTONE_SERVE_BUCKETS={raw!r}: expected 'pow2' or "
+            "comma-separated integers"
+        ) from None
+    if not vals or vals[0] <= 0:
+        raise ValueError(
+            f"KEYSTONE_SERVE_BUCKETS={raw!r}: buckets must be positive"
+        )
+    return vals
+
+
 def _env_choice(name: str, choices: tuple, default: str) -> str:
     """Validated enum env knob: case-insensitive, and a bad value fails AT
     IMPORT naming the variable — not as a bare KeyError deep in a solve."""
@@ -114,6 +154,22 @@ class Config:
     # Env: KEYSTONE_PREFETCH_DEPTH.
     prefetch_depth: int = field(
         default_factory=lambda: _env_int("KEYSTONE_PREFETCH_DEPTH", 2)
+    )
+    # Serving bucket ladder: when non-empty, Transformer.batch_call rounds
+    # array batches up to the next bucket (padding with the last real row)
+    # so the per-shape jit cache only ever sees ladder shapes — a serving
+    # workload with variable request sizes stops recompiling once the
+    # ladder is warm. Empty = today's per-shape jit. The AOT serving engine
+    # (workflow/serving.py CompiledPipeline) uses this ladder too, falling
+    # back to pow-2 up to serve_max_batch when empty. Padding is refused
+    # (RowDependenceError) for transformers with row_independent=False.
+    # Env: KEYSTONE_SERVE_BUCKETS ('pow2' or comma-separated ints).
+    serve_buckets: tuple = field(default_factory=_env_buckets)
+    # Top of the default serving ladder: the largest batch a single bucketed
+    # device call serves (bigger requests chunk through this bucket).
+    # Env: KEYSTONE_SERVE_MAX_BATCH.
+    serve_max_batch: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_SERVE_MAX_BATCH", 1024)
     )
     # Whole-pipeline auto-caching (profile a sample run, persist the best
     # time-saved-per-byte intermediates under a budget). Opt-in: profiling

@@ -20,6 +20,7 @@ class RandomPatcher(Transformer):
     """
 
     jittable = False  # output count depends on num_patches, not batch size
+    row_independent = False  # output rows are drawn across the whole batch
 
     def __init__(self, num_patches: int, patch_size: int, seed: int = 0):
         self.num_patches = num_patches
@@ -43,6 +44,10 @@ class RandomPatcher(Transformer):
 class Windower(Transformer):
     """All (size × size) windows at `stride` — the im2col view, exposed as a
     node for featurizers that want explicit patches."""
+
+    # n images fan out to n·windows rows: slicing a padded batch's output
+    # [:n] would return the wrong rows, so bucketed serving refuses it.
+    row_independent = False
 
     def __init__(self, stride: int, window_size: int):
         self.stride = stride
@@ -94,6 +99,8 @@ class CenterCornerPatcher(Transformer):
     """Center + four corner crops, optionally horizontally flipped — the
     test-time augmentation of the ImageNet pipeline. Emits (n·views, s, s, c)
     with views grouped per image."""
+
+    row_independent = False  # n images emit n·views rows
 
     def __init__(self, crop_size: int, with_flips: bool = True):
         self.crop_size = crop_size

@@ -247,7 +247,11 @@ def run_streamed(conf: ImageNetSiftLcsFVConfig) -> dict:
         the consumer side (the view tensor must stay sub-batch-bounded)."""
         if patcher is None:
             for F, y in featurizer.apply_batches(test_batches()):
-                yield model.apply_batch(np.asarray(F)), y
+                # batch_call (not apply_batch) so the classifier head runs
+                # jitted and, under KEYSTONE_SERVE_BUCKETS, shape-stable:
+                # the stream's trailing partial batch otherwise recompiles
+                # the whole blocked-gemm chain for its one-off row count.
+                yield model.batch_call(np.asarray(F)), y
             return
         from keystone_tpu.loaders.stream import prefetched
 
@@ -260,7 +264,7 @@ def run_streamed(conf: ImageNetSiftLcsFVConfig) -> dict:
                 X = np.asarray(X)
                 sub = max(1, conf.stream_batch // patcher.num_views)
                 view_scores = np.concatenate([
-                    np.asarray(model.apply_batch(np.asarray(
+                    np.asarray(model.batch_call(np.asarray(
                         featurizer(patcher(X[i : i + sub])).get()
                     )))
                     for i in range(0, len(X), sub)

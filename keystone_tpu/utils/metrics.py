@@ -9,6 +9,7 @@ HLO (`cost_analysis`), which is what per-chip TFLOPS reporting uses.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from contextlib import contextmanager
 from typing import Any, Callable, Dict
@@ -31,10 +32,8 @@ def stage_timer(name: str, sink: Dict[str, float] | None = None):
             sink[name] = dt
 
 
-def cost_analysis(fn: Callable, *args) -> Dict[str, Any]:
-    """FLOPs / bytes-accessed of `fn` as XLA compiles it for these args."""
-    lowered = jax.jit(fn).lower(*args)
-    compiled = lowered.compile()
+def compiled_cost(compiled) -> Dict[str, Any]:
+    """FLOPs / bytes-accessed of an already-compiled executable."""
     cost = compiled.cost_analysis() or {}
     # Older jax returns a one-element list of dicts (per-executable);
     # newer returns the dict directly.
@@ -45,6 +44,11 @@ def cost_analysis(fn: Callable, *args) -> Dict[str, Any]:
         "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
         "raw": dict(cost),
     }
+
+
+def cost_analysis(fn: Callable, *args) -> Dict[str, Any]:
+    """FLOPs / bytes-accessed of `fn` as XLA compiles it for these args."""
+    return compiled_cost(jax.jit(fn).lower(*args).compile())
 
 
 @contextmanager
@@ -96,18 +100,70 @@ def peak_hbm_bytes() -> int | None:
 
 
 def achieved_tflops(fn: Callable, *args, repeats: int = 3) -> Dict[str, float]:
-    """Compile, time, and convert to achieved TFLOPS (per process)."""
-    jitted = jax.jit(fn)
-    out = jitted(*args)
+    """Compile, time, and convert to achieved TFLOPS (per process).
+
+    One lowered/compiled executable serves both the timing loop and the
+    FLOP count — lowering the function a second time through
+    ``cost_analysis`` would double compile cost for the same HLO.
+    """
+    compiled = jax.jit(fn).lower(*args).compile()
+    out = compiled(*args)
     jax.block_until_ready(out)
     t0 = time.perf_counter()
     for _ in range(repeats):
-        out = jitted(*args)
+        out = compiled(*args)
     jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / repeats
-    flops = cost_analysis(fn, *args)["flops"]
+    flops = compiled_cost(compiled)["flops"]
     return {
         "seconds": dt,
         "flops": flops,
         "tflops": flops / dt / 1e12 if dt > 0 else 0.0,
     }
+
+
+class ServingCounters:
+    """Process-wide serving observability: how many XLA compiles the
+    bucketed apply path performed, and which buckets traffic actually
+    lands in (the evidence behind 'zero steady-state recompiles' — after
+    warmup the compile counter must not move). Thread-safe: the
+    micro-batcher worker and client threads both record here."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.compiles = 0
+            self.calls = 0
+            self.rows_in = 0
+            self.rows_padded = 0
+            self.bucket_hits: Dict[int, int] = {}
+
+    def record_compile(self, bucket: int) -> None:
+        with self._lock:
+            self.compiles += 1
+
+    def record_call(self, bucket: int, rows: int) -> None:
+        with self._lock:
+            self.calls += 1
+            self.rows_in += rows
+            self.rows_padded += bucket - rows
+            self.bucket_hits[bucket] = self.bucket_hits.get(bucket, 0) + 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "compiles": self.compiles,
+                "calls": self.calls,
+                "rows_in": self.rows_in,
+                "rows_padded": self.rows_padded,
+                "pad_overhead": (
+                    self.rows_padded / self.rows_in if self.rows_in else 0.0
+                ),
+                "bucket_hits": dict(sorted(self.bucket_hits.items())),
+            }
+
+
+serving_counters = ServingCounters()

@@ -17,6 +17,11 @@ from keystone_tpu.workflow.optimizer import (
     default_optimizer,
 )
 from keystone_tpu.workflow.serialization import load_pipeline, save_pipeline
+from keystone_tpu.workflow.serving import (
+    CompiledPipeline,
+    PipelineService,
+    RowDependenceError,
+)
 
 __all__ = [
     "Graph",
@@ -39,4 +44,7 @@ __all__ = [
     "default_optimizer",
     "save_pipeline",
     "load_pipeline",
+    "CompiledPipeline",
+    "PipelineService",
+    "RowDependenceError",
 ]

@@ -31,7 +31,10 @@ from keystone_tpu.workflow.operators import (
 
 # Profiling hint memo: (transformer signature, sample shape, dtype, scale)
 # -> FLOPs ratio. Cost analysis compiles twice per entry; graph copies and
-# repeated optimizer passes hit this instead.
+# repeated optimizer passes hit this instead. Bounded: past the cap the
+# OLDEST entry is evicted (dict keeps insertion order) — wholesale clearing
+# would force every live pipeline's next profile to recompile at once.
+_FLOPS_MEMO_CAP = 256
 _flops_ratio_memo: Dict[Any, float | None] = {}
 
 
@@ -137,8 +140,8 @@ class Profiler:
             if f_sample > 0 and f_full > 0:
                 ratio = f_full / f_sample
             if key is not None:
-                if len(_flops_ratio_memo) > 1024:
-                    _flops_ratio_memo.clear()
+                while len(_flops_ratio_memo) >= _FLOPS_MEMO_CAP:
+                    _flops_ratio_memo.pop(next(iter(_flops_ratio_memo)))
                 _flops_ratio_memo[key] = ratio
             return ratio
         except Exception:

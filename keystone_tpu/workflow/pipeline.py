@@ -67,6 +67,15 @@ class Transformer:
 
     jittable: bool = True
 
+    # Output row i depends on input row i alone AND output rows == input
+    # rows — the contract that makes bucket-padding sound (pad rows cannot
+    # perturb real outputs, and slicing [:n] recovers exactly them). True
+    # for the per-datum-lifted common case; transformers that couple rows
+    # (batch statistics at apply time) or fan rows out (Windower,
+    # CenterCornerPatcher) set False, and the bucketed serving path refuses
+    # them with serving.RowDependenceError.
+    row_independent: bool = True
+
     def apply(self, x: Any) -> Any:
         if _is_array(x) or jnp.isscalar(x):
             return self.batch_call(jnp.asarray(x)[None, ...])[0]
@@ -87,8 +96,21 @@ class Transformer:
     # -- execution ---------------------------------------------------------
 
     def batch_call(self, X: Any) -> Any:
-        """Apply to a batch, via the cached jitted function when possible."""
+        """Apply to a batch, via the cached jitted function when possible.
+
+        With ``config.serve_buckets`` set (env KEYSTONE_SERVE_BUCKETS),
+        array batches are rounded up the bucket ladder, padded, run at the
+        bucket shape, and sliced — the jit cache then only ever sees ladder
+        shapes, so variable-size traffic stops recompiling once the ladder
+        is warm. Empty ladder = per-shape jit, exactly as before.
+        """
         if self.jittable and _is_array(X):
+            from keystone_tpu.config import config
+
+            if config.serve_buckets:
+                from keystone_tpu.workflow.serving import bucketed_call
+
+                return bucketed_call(self, X)
             return self._jitted()(X)
         return self.apply_batch(X)
 
@@ -182,6 +204,9 @@ class FusedTransformer(Transformer):
                 flat.append(s)
         self.stages = flat
         self.jittable = all(s.jittable for s in flat)
+        self.row_independent = all(
+            getattr(s, "row_independent", True) for s in flat
+        )
 
     def apply_batch(self, X):
         for s in self.stages:
@@ -433,6 +458,21 @@ class Pipeline:
         graph = PipelineEnv.get().executor.fit_estimators(self.graph, self.sink)
         # Prune to the subgraph feeding our sink.
         return Pipeline(graph, self.source, self.sink)
+
+    def compiled(self, buckets=None, max_batch=None, donate=None):
+        """Fit (if needed) and lower to a shape-stable serving engine.
+
+        Returns a ``workflow.serving.CompiledPipeline``: call ``warmup()``
+        with the traffic's feature shape to AOT-compile the whole bucket
+        ladder before first traffic, then serve mixed-size batches with
+        zero steady-state recompiles. Requires the serve path to be a
+        linear chain of jittable, row-independent transformers.
+        """
+        from keystone_tpu.workflow.serving import CompiledPipeline
+
+        return CompiledPipeline(
+            self, buckets=buckets, max_batch=max_batch, donate=donate
+        )
 
     # -- introspection -----------------------------------------------------
 

@@ -1,0 +1,553 @@
+"""Shape-stable serving: bucketed batch apply with AOT warmup.
+
+Fitted pipelines are *applied* far more often than they are fit, and the
+north-star workload is request traffic whose batch sizes vary per call. A
+bare ``jax.jit`` recompiles the whole fused chain for every distinct row
+count, so a mixed-size trace compiles forever and never reaches steady
+state. The standard TPU answer is statically bounded shapes: round every
+batch up a small bucket ladder, pad with rows that cannot affect the real
+outputs, run ONE ahead-of-time compiled executable per bucket, and slice
+the result (arXiv:1810.09868 AOT compilation; arXiv:2206.14148 bounded
+shapes).
+
+Three layers, outermost first:
+
+- ``PipelineService`` — a micro-batcher: concurrent ``submit()`` calls
+  coalesce into one bucketed device call (the serving analog of the
+  reference's per-partition map — amortize dispatch across requests).
+- ``CompiledPipeline`` — the per-process serving engine: bucket ladder,
+  mask-safe padding, AOT warmup of every bucket before first traffic,
+  donated input buffers on the hot call, host-in/host-out so the steady
+  state issues NO jax operations beyond the pre-compiled executable
+  (zero steady-state recompiles, measured by tools/bench_serve.py).
+- ``bucketed_call`` — the in-graph wiring: ``Transformer.batch_call``
+  routes through it when ``config.serve_buckets`` is non-empty (env
+  ``KEYSTONE_SERVE_BUCKETS``), so executor-driven applies and
+  ``Pipeline.apply_batches`` loops see a bounded shape set too.
+
+Padding is only sound for transformers whose output row i depends on
+input row i alone AND whose output row count equals the input row count —
+the ``Transformer.row_independent`` flag. Ops that couple rows (batch
+statistics at apply time) or fan rows out (``Windower``,
+``CenterCornerPatcher``) set it False and the bucketed path refuses them
+with ``RowDependenceError`` instead of silently corrupting outputs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, InvalidStateError
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+
+from keystone_tpu.config import config, pow2_ladder
+from keystone_tpu.utils.metrics import serving_counters
+
+
+class RowDependenceError(TypeError):
+    """Raised when bucketed (padded) apply is requested for a transformer
+    whose batch output depends on other rows — padding would change the
+    real outputs, so it is refused rather than risked."""
+
+
+# ---------------------------------------------------------------------------
+# Ladder helpers
+# ---------------------------------------------------------------------------
+
+
+def resolve_ladder(
+    buckets: Optional[Sequence[int]] = None, max_batch: Optional[int] = None
+) -> Tuple[int, ...]:
+    """The bucket ladder to serve with: explicit ``buckets`` >
+    ``config.serve_buckets`` > pow-2 up to ``max_batch`` /
+    ``config.serve_max_batch``. Always sorted, deduplicated, positive."""
+    if buckets is None and config.serve_buckets:
+        buckets = config.serve_buckets
+    if buckets is None:
+        ladder = pow2_ladder(max_batch or config.serve_max_batch)
+    else:
+        ladder = tuple(sorted({int(b) for b in buckets}))
+        if max_batch is not None:
+            ladder = tuple(b for b in ladder if b <= max_batch)
+            if not ladder or ladder[-1] < max_batch:
+                ladder = ladder + (int(max_batch),)
+    if not ladder or ladder[0] <= 0:
+        raise ValueError(f"bucket ladder must be positive ints, got {ladder}")
+    return ladder
+
+
+def bucket_for(n: int, ladder: Sequence[int]) -> Optional[int]:
+    """Smallest bucket >= n, or None when n exceeds the ladder (the caller
+    chunks)."""
+    for b in ladder:
+        if n <= b:
+            return b
+    return None
+
+
+def _jit_cache_size(jit_fn) -> int:
+    """Compiled-entry count of a jitted callable, for compile observability
+    on the batch_call path (0 where the runtime doesn't expose it)."""
+    try:
+        return jit_fn._cache_size()
+    except Exception:
+        return 0
+
+
+def _stages(transformer) -> list:
+    from keystone_tpu.workflow.pipeline import FusedTransformer
+
+    if isinstance(transformer, FusedTransformer):
+        return list(transformer.stages)
+    return [transformer]
+
+
+def _row_coupled_stages(transformer) -> list:
+    """Names of stages whose output rows depend on other rows — the ONE
+    definition of pad-unsafety both the explicit engine and the implicit
+    batch_call knob consult."""
+    return [
+        type(s).__name__
+        for s in _stages(transformer)
+        if not getattr(s, "row_independent", True)
+    ]
+
+
+def check_row_independent(transformer) -> None:
+    """Raise RowDependenceError naming every offending stage."""
+    bad = _row_coupled_stages(transformer)
+    if bad:
+        raise RowDependenceError(
+            f"cannot pad batches through {', '.join(bad)}: the stage's "
+            "batch output depends on other rows (row_independent=False), "
+            "so bucketed serving would change real outputs. Serve it "
+            "per-shape (unset KEYSTONE_SERVE_BUCKETS / serve_buckets) or "
+            "keep the row-coupled stage off the bucketed path."
+        )
+
+
+# ---------------------------------------------------------------------------
+# In-graph bucketing (Transformer.batch_call wiring)
+# ---------------------------------------------------------------------------
+
+
+# Row-coupled transformer classes we have already warned about falling back
+# to per-shape jit under the global bucketing knob (warn once per class, not
+# once per batch).
+_fallback_warned: set = set()
+
+
+def bucketed_call(transformer, X):
+    """Bucket-pad-run-slice on device, through the transformer's own
+    per-shape jit cache — which now only ever sees ladder shapes, so the
+    compile set is bounded by the ladder instead of the request mix.
+
+    Used by ``Transformer.batch_call`` when ``config.serve_buckets`` is
+    set. Stays device-in/device-out (this runs mid-graph, feeding further
+    device ops); the tiny pad/slice ops compile once per (bucket, n) pair
+    and then also reach steady state.
+
+    Row-coupled transformers (``row_independent=False``) cannot be padded;
+    here — the IMPLICIT, process-wide knob — they fall back to today's
+    per-shape jit with a one-time warning, so flipping
+    KEYSTONE_SERVE_BUCKETS never crashes a working pipeline (e.g. the
+    ImageNet TTA view expansion mid-graph). The EXPLICIT serving engine
+    (``CompiledPipeline``), where the user asked for bucketed execution by
+    name, refuses them with ``RowDependenceError`` instead.
+    """
+    import logging
+
+    import jax.numpy as jnp
+
+    bad = _row_coupled_stages(transformer)
+    if bad:
+        key = tuple(bad)
+        if key not in _fallback_warned:
+            _fallback_warned.add(key)
+            logging.getLogger("keystone_tpu").warning(
+                "serve_buckets: %s is not row-independent; padding refused, "
+                "falling back to per-shape jit (this path can recompile per "
+                "batch size)",
+                ", ".join(bad),
+            )
+        return transformer._jitted()(X)
+    ladder = resolve_ladder()
+    # Normalize to a jax array up front: a numpy batch and an equal-shape
+    # device array key DIFFERENT jit-cache entries, which would double the
+    # compile set per bucket.
+    X = jnp.asarray(X)
+    n = int(X.shape[0])
+    if n == 0:
+        return transformer._jitted()(X)
+    jit_fn = transformer._jitted()
+    max_b = ladder[-1]
+    outs = []
+    for start in range(0, n, max_b):
+        chunk = X[start : min(start + max_b, n)]
+        m = int(chunk.shape[0])
+        b = bucket_for(m, ladder)
+        if m != b:
+            pad = jnp.broadcast_to(chunk[-1:], (b - m,) + chunk.shape[1:])
+            chunk = jnp.concatenate([chunk, pad], axis=0)
+        cache_before = _jit_cache_size(jit_fn)
+        out = jit_fn(chunk)
+        if _jit_cache_size(jit_fn) > cache_before:
+            serving_counters.record_compile(b)  # cold ladder bucket
+        serving_counters.record_call(b, m)
+        if m != b:
+            out = jax.tree_util.tree_map(lambda a: a[:m], out)
+        outs.append(out)
+    if len(outs) == 1:
+        return outs[0]
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.concatenate(xs, axis=0), *outs
+    )
+
+
+# ---------------------------------------------------------------------------
+# CompiledPipeline — AOT-warmed bucketed serving engine
+# ---------------------------------------------------------------------------
+
+
+def _serving_transformer(target):
+    """Lower a Pipeline / Transformer to the single jittable transformer the
+    serving engine compiles (fitting estimators and fusing the chain)."""
+    from keystone_tpu.workflow.executor import PipelineEnv
+    from keystone_tpu.workflow.pipeline import Pipeline, Transformer
+
+    if isinstance(target, Pipeline):
+        fitted = target.fit()
+        return PipelineEnv.get().executor.serving_chain(
+            fitted.graph, fitted.source, fitted.sink
+        )
+    if isinstance(target, Transformer):
+        if not target.jittable:
+            raise TypeError(
+                f"{type(target).__name__} is not jittable; the AOT serving "
+                "path compiles the whole chain as one XLA program"
+            )
+        return target
+    raise TypeError(f"cannot serve a {type(target).__name__}")
+
+
+class CompiledPipeline:
+    """A fitted pipeline compiled for shape-stable serving.
+
+    - Rounds incoming batches up the bucket ladder, pads with mask-safe
+      rows (the last real row, replicated — numerically inert for
+      row-independent chains and immune to 0-row pathologies like
+      divide-by-norm), runs the bucket's pre-compiled executable, slices.
+    - ``warmup()`` AOT-compiles the WHOLE ladder via
+      ``jit(...).lower(spec).compile()`` before first traffic.
+    - Donates the padded input buffer on the hot call (we own it — it was
+      built by padding — so donation is always safe; auto-disabled on CPU
+      where XLA ignores it).
+    - Host-in/host-out: padding is numpy, results come back as numpy. The
+      steady state therefore issues zero jax tracing/compile work — only
+      pre-compiled executable calls. Oversize batches chunk through the
+      top bucket.
+    """
+
+    def __init__(
+        self,
+        target,
+        buckets: Optional[Sequence[int]] = None,
+        max_batch: Optional[int] = None,
+        donate: Optional[bool] = None,
+    ):
+        self.transformer = _serving_transformer(target)
+        check_row_independent(self.transformer)
+        self.ladder = resolve_ladder(buckets, max_batch)
+        self.max_batch = self.ladder[-1]
+        if donate is None:
+            donate = jax.default_backend() != "cpu"
+        self.donate = bool(donate)
+        self._jit = jax.jit(
+            self.transformer.apply_batch,
+            donate_argnums=(0,) if self.donate else (),
+        )
+        self._executables: dict = {}
+        self.feature_shape: Optional[Tuple[int, ...]] = None
+        self._dtype = None
+        self.compile_count = 0
+        self.warmup_seconds: Optional[float] = None
+        self._lock = threading.Lock()
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    # -- warmup ------------------------------------------------------------
+
+    def warmup(
+        self, example: Union[Tuple[int, ...], Any], dtype=None
+    ) -> "CompiledPipeline":
+        """AOT-compile every bucket before first traffic.
+
+        ``example`` is either the per-row feature shape (a tuple of ints)
+        or a sample batch (leading axis = rows) whose ``shape[1:]``/dtype
+        are taken. Idempotent per (shape, dtype): re-warming compiles only
+        missing buckets.
+        """
+        if isinstance(example, tuple) and all(
+            isinstance(d, int) for d in example
+        ):
+            feature_shape = example
+            dt = np.dtype(dtype or config.default_dtype)
+        else:
+            arr = np.asarray(example)
+            if arr.ndim < 1:
+                raise ValueError(
+                    "warmup example must be a feature-shape tuple or a "
+                    "sample batch with a leading row axis"
+                )
+            feature_shape = arr.shape[1:]
+            dt = np.dtype(dtype) if dtype is not None else arr.dtype
+        # A float64 host batch must not lower an f64 executable under
+        # x64-disabled jax; serve at the dtype jax would compute in.
+        dt = np.dtype(jax.dtypes.canonicalize_dtype(dt))
+        with self._lock:
+            if (
+                self.feature_shape is not None
+                and (self.feature_shape, self._dtype) != (feature_shape, dt)
+            ):
+                # New traffic signature: previous executables can't serve it.
+                self._executables.clear()
+            self.feature_shape, self._dtype = feature_shape, dt
+            t0 = time.perf_counter()
+            for b in self.ladder:
+                if b not in self._executables:
+                    self._compile_bucket(b)
+            self.warmup_seconds = time.perf_counter() - t0
+        return self
+
+    def _compile_bucket(self, b: int):
+        """Lower + compile one bucket's executable (caller holds the lock or
+        is single-threaded setup code)."""
+        spec = jax.ShapeDtypeStruct(
+            (b,) + self.feature_shape, self._dtype
+        )
+        self._executables[b] = self._jit.lower(spec).compile()
+        self.compile_count += 1
+        serving_counters.record_compile(b)
+        return self._executables[b]
+
+    # -- hot path ----------------------------------------------------------
+
+    def __call__(self, X):
+        """Serve one batch: returns numpy, sliced to the real row count."""
+        if self.feature_shape is None:
+            # Lazy warmup off the first request's signature: correct, but
+            # the first-traffic latency pays the whole ladder. Call
+            # warmup() ahead of traffic instead.
+            self.warmup(np.asarray(X))
+        X = np.asarray(X, dtype=self._dtype)
+        if X.shape[1:] != self.feature_shape:
+            raise ValueError(
+                f"request feature shape {X.shape[1:]} != warmed shape "
+                f"{self.feature_shape}; re-warm the pipeline for new traffic"
+            )
+        n = X.shape[0]
+        if n == 0:
+            raise ValueError("cannot serve an empty batch")
+        outs = []
+        for start in range(0, n, self.max_batch):
+            chunk = X[start : min(start + self.max_batch, n)]
+            outs.append(self._serve_chunk(chunk))
+        if len(outs) == 1:
+            return outs[0]
+        return jax.tree_util.tree_map(
+            lambda *xs: np.concatenate(xs, axis=0), *outs
+        )
+
+    def _serve_chunk(self, chunk: np.ndarray):
+        m = chunk.shape[0]
+        b = bucket_for(m, self.ladder)
+        if m != b:
+            pad = np.broadcast_to(chunk[-1:], (b - m,) + chunk.shape[1:])
+            chunk = np.concatenate([chunk, pad], axis=0)
+        ex = self._executables.get(b)
+        if ex is None:
+            with self._lock:
+                ex = self._executables.get(b)
+                if ex is None:  # cold bucket (warmup skipped): counted miss
+                    ex = self._compile_bucket(b)
+        out = ex(chunk)
+        serving_counters.record_call(b, m)
+        # np.asarray blocks on the transfer, so latency measurements around
+        # this call see the true device time; slicing happens on host.
+        return jax.tree_util.tree_map(lambda a: np.asarray(a)[:m], out)
+
+    def stats(self) -> dict:
+        return {
+            "ladder": list(self.ladder),
+            "compile_count": self.compile_count,
+            "warmup_seconds": self.warmup_seconds,
+            "donate": self.donate,
+        }
+
+
+# ---------------------------------------------------------------------------
+# PipelineService — request coalescing micro-batcher
+# ---------------------------------------------------------------------------
+
+
+class PipelineService:
+    """Coalesces concurrent small requests into one bucketed device call.
+
+    ``submit(x)`` returns a ``concurrent.futures.Future``. A background
+    worker drains the request queue: it takes the oldest request, then
+    keeps absorbing queued requests until the flush would exceed
+    ``max_rows`` or ``max_delay_ms`` has passed since the flush group
+    opened, concatenates them into one batch, runs the warmed
+    ``CompiledPipeline`` once, and splits the result back per-request.
+    Under load the delay never waits — the queue is non-empty, so flushes
+    are back-to-back full buckets; the delay only bounds the latency a
+    lone request pays waiting for company.
+
+    Requires a warmed pipeline: warmup belongs before first traffic, not
+    under it.
+    """
+
+    def __init__(
+        self,
+        compiled: CompiledPipeline,
+        max_delay_ms: float = 2.0,
+        max_rows: Optional[int] = None,
+    ):
+        if compiled.feature_shape is None:
+            raise RuntimeError(
+                "PipelineService requires a warmed CompiledPipeline — call "
+                "warmup() with the traffic's feature shape first"
+            )
+        self.compiled = compiled
+        self.max_rows = int(max_rows or compiled.max_batch)
+        self.max_delay = max_delay_ms / 1e3
+        self._pending: deque = deque()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._closed = False
+        self.requests = 0
+        self.batches_run = 0
+        self.rows_served = 0
+        self._worker = threading.Thread(
+            target=self._loop, name="keystone-serve", daemon=True
+        )
+        self._worker.start()
+
+    # -- client side -------------------------------------------------------
+
+    def submit(self, x) -> Future:
+        """Queue one request: a single example (feature-shaped) or a small
+        batch (leading row axis). The future resolves to the transformed
+        example/batch respectively."""
+        x = np.asarray(x, dtype=self.compiled.dtype)
+        datum = x.shape == self.compiled.feature_shape
+        if datum:
+            x = x[None, ...]
+        if x.shape[1:] != self.compiled.feature_shape:
+            raise ValueError(
+                f"request shape {x.shape} does not match served feature "
+                f"shape {self.compiled.feature_shape}"
+            )
+        fut: Future = Future()
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("PipelineService is closed")
+            self._pending.append((x, datum, fut))
+            self.requests += 1
+            self._cv.notify()
+        return fut
+
+    # -- worker side -------------------------------------------------------
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._pending and not self._closed:
+                    self._cv.wait()
+                if not self._pending and self._closed:
+                    return
+                group = [self._pending.popleft()]
+                rows = group[0][0].shape[0]
+                deadline = time.monotonic() + self.max_delay
+                while rows < self.max_rows:
+                    if self._pending:
+                        nxt_rows = self._pending[0][0].shape[0]
+                        if rows + nxt_rows > self.max_rows:
+                            break
+                        group.append(self._pending.popleft())
+                        rows += nxt_rows
+                        continue
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or self._closed:
+                        break
+                    self._cv.wait(remaining)
+            self._flush(group)
+
+    @staticmethod
+    def _resolve(fut: Future, value=None, exc=None) -> None:
+        """Resolve a future, tolerating client-side cancellation: a future
+        the client cancelled mid-flight must not poison the rest of its
+        coalesced group (set_result on it raises InvalidStateError)."""
+        try:
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(value)
+        except InvalidStateError:
+            pass
+
+    def _flush(self, group):
+        try:
+            if len(group) == 1:
+                X = group[0][0]
+            else:
+                X = np.concatenate([g[0] for g in group], axis=0)
+            out = self.compiled(X)
+            self.batches_run += 1
+            self.rows_served += X.shape[0]
+            off = 0
+            for x, datum, fut in group:
+                m = x.shape[0]
+                piece = jax.tree_util.tree_map(
+                    lambda a, o=off, m=m: a[o : o + m], out
+                )
+                if datum:
+                    piece = jax.tree_util.tree_map(lambda a: a[0], piece)
+                off += m
+                self._resolve(fut, value=piece)
+        except Exception as e:  # fail the whole flush group, keep serving
+            for _x, _d, fut in group:
+                if not fut.done():
+                    self._resolve(fut, exc=e)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self):
+        """Drain queued requests, then stop the worker."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._worker.join()
+
+    def __enter__(self) -> "PipelineService":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def stats(self) -> dict:
+        return {
+            "requests": self.requests,
+            "batches_run": self.batches_run,
+            "rows_served": self.rows_served,
+            "coalesce_ratio": (
+                self.requests / self.batches_run if self.batches_run else None
+            ),
+        }

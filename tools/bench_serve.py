@@ -1,0 +1,272 @@
+"""Shape-stable serving benchmark: per-shape jit vs bucketed + AOT-warmed.
+
+Serves a mixed-size request trace (row counts drawn uniformly from
+[1, max_batch]) through a representative fused serving head
+(standardize -> cosine random features -> signed-Hellinger -> L2
+normalize -> linear scores) two ways:
+
+1. naive — today's ``Transformer.batch_call`` per-shape ``jax.jit``:
+   every distinct row count recompiles the whole fused chain;
+2. bucketed — ``workflow.serving.CompiledPipeline``: the pow-2 bucket
+   ladder is AOT-compiled BEFORE traffic (``warmup``), every request is
+   padded onto a bucket and served by a pre-compiled executable.
+
+Reports steady-state p50/p99/mean request latency, throughput, and
+compile counts for both paths (compiles are counted two ways: the
+serving layer's own counter and a jax monitoring listener on XLA
+compile-cache requests). The acceptance gate: ZERO compiles after
+warmup on the bucketed path, and bucketed p99 at least 2x better than
+naive. A third phase drives the ``PipelineService`` micro-batcher with
+concurrent single-row clients and reports the coalescing ratio.
+
+Usage: python tools/bench_serve.py [--requests 160] [--max-batch 256]
+           [--out BENCH_serve.json]
+Prints one JSON line and (with --out) writes the machine-readable
+result for future PRs to regress against.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class CompileEventCounter:
+    """Counts XLA compiles via jax.monitoring (each backend compile emits
+    one '/jax/compilation_cache/compile_requests_use_cache' event).
+    Listener registration is global and permanent, so one instance is
+    created per process and phases snapshot its count."""
+
+    EVENT = "/jax/compilation_cache/compile_requests_use_cache"
+
+    def __init__(self):
+        import jax
+
+        self.count = 0
+        jax.monitoring.register_event_listener(self._on_event)
+
+    def _on_event(self, name, **kwargs):
+        if name == self.EVENT:
+            self.count += 1
+
+
+def build_chain(d: int, features: int, classes: int, seed: int):
+    """A fresh serving-head instance (fresh jit caches) over shared
+    deterministic weights."""
+    from keystone_tpu.nodes.learning.linear_mapper import LinearMapper
+    from keystone_tpu.nodes.stats.hellinger import SignedHellingerMapper
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+    from keystone_tpu.nodes.stats.random_features import CosineRandomFeatures
+    from keystone_tpu.nodes.stats.scalers import StandardScalerModel
+    from keystone_tpu.workflow.pipeline import FusedTransformer
+
+    rng = np.random.default_rng(seed)
+    return FusedTransformer(
+        [
+            StandardScalerModel(
+                rng.normal(size=d).astype(np.float32),
+                (1.0 + rng.uniform(size=d)).astype(np.float32),
+            ),
+            CosineRandomFeatures.create(d, features, seed=seed),
+            SignedHellingerMapper(),
+            L2Normalizer(),
+            LinearMapper(
+                (rng.normal(size=(features, classes)) / np.sqrt(features))
+                .astype(np.float32)
+            ),
+        ]
+    )
+
+
+def lat_stats(lats_s) -> dict:
+    ms = np.asarray(lats_s) * 1e3
+    return {
+        "p50_ms": round(float(np.percentile(ms, 50)), 3),
+        "p99_ms": round(float(np.percentile(ms, 99)), 3),
+        "mean_ms": round(float(ms.mean()), 3),
+        "total_s": round(float(ms.sum() / 1e3), 3),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=160,
+                    help="requests in the mixed-size trace")
+    ap.add_argument("--max-batch", type=int, default=256,
+                    help="largest request row count / top serving bucket")
+    ap.add_argument("--d", type=int, default=64, help="input feature dim")
+    ap.add_argument("--features", type=int, default=512,
+                    help="random-feature width of the serving head")
+    ap.add_argument("--classes", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--service-clients", type=int, default=4,
+                    help="concurrent single-row clients for the "
+                    "micro-batcher phase (0 skips it)")
+    ap.add_argument("--service-requests", type=int, default=200,
+                    help="total single-row requests across clients")
+    ap.add_argument("--out", type=str, default=None,
+                    help="also write the JSON result to this path")
+    args = ap.parse_args()
+
+    from keystone_tpu.utils.platform import ensure_live_backend
+
+    backend = ensure_live_backend()
+    import jax
+
+    from keystone_tpu.config import config
+    from keystone_tpu.utils.metrics import serving_counters
+    from keystone_tpu.workflow.serving import (
+        CompiledPipeline,
+        PipelineService,
+        _jit_cache_size,
+    )
+
+    # The baseline phase must measure TRUE per-shape jit: an inherited
+    # KEYSTONE_SERVE_BUCKETS would silently route batch_call through
+    # bucketing and collapse the comparison to bucketed-vs-bucketed.
+    config.serve_buckets = ()
+
+    compile_events = CompileEventCounter()
+    rng = np.random.default_rng(args.seed)
+    sizes = rng.integers(1, args.max_batch + 1, size=args.requests)
+    trace = [
+        rng.normal(size=(int(n), args.d)).astype(np.float32) for n in sizes
+    ]
+
+    # -- naive: per-shape jit ------------------------------------------------
+    naive = build_chain(args.d, args.features, args.classes, args.seed)
+    # One warm call at the top size — the naive server has seen SOME traffic;
+    # every new row count in the trace still recompiles.
+    jax.block_until_ready(naive.batch_call(trace[0][: args.max_batch]))
+    ev0 = compile_events.count
+    naive_lats = []
+    t0 = time.perf_counter()
+    for x in trace:
+        t1 = time.perf_counter()
+        jax.block_until_ready(naive.batch_call(x))
+        naive_lats.append(time.perf_counter() - t1)
+    naive_wall = time.perf_counter() - t0
+    naive_compiles = compile_events.count - ev0
+
+    # -- bucketed + AOT warmup -----------------------------------------------
+    serving_counters.reset()
+    cp = CompiledPipeline(
+        build_chain(args.d, args.features, args.classes, args.seed),
+        max_batch=args.max_batch,
+    )
+    ev0 = compile_events.count
+    cp.warmup((args.d,))
+    warmup_compiles = compile_events.count - ev0
+    ev0 = compile_events.count
+    bucketed_lats = []
+    t0 = time.perf_counter()
+    for x in trace:
+        t1 = time.perf_counter()
+        cp(x)  # host-out: the np result is already synchronized
+        bucketed_lats.append(time.perf_counter() - t1)
+    bucketed_wall = time.perf_counter() - t0
+    post_warmup_compiles = compile_events.count - ev0
+
+    rows = int(sizes.sum())
+    naive_p99 = float(np.percentile(np.asarray(naive_lats) * 1e3, 99))
+    bucketed_p99 = float(np.percentile(np.asarray(bucketed_lats) * 1e3, 99))
+
+    result = {
+        "metric": "serve_bucketed_vs_pershape",
+        "backend": backend,
+        "host_cores": os.cpu_count(),
+        "requests": args.requests,
+        "rows": rows,
+        "d": args.d,
+        "features": args.features,
+        "classes": args.classes,
+        "ladder": list(cp.ladder),
+        "naive": {
+            **lat_stats(naive_lats),
+            "rows_per_s": round(rows / naive_wall, 1),
+            "compiles": naive_compiles,
+            "jit_cache_entries": _jit_cache_size(naive._jitted()),
+        },
+        "bucketed": {
+            **lat_stats(bucketed_lats),
+            "rows_per_s": round(rows / bucketed_wall, 1),
+            "warmup_seconds": round(cp.warmup_seconds, 3),
+            "warmup_compiles": warmup_compiles,
+            "post_warmup_compiles": post_warmup_compiles,
+            "serving_counter_compiles_post_warmup": (
+                serving_counters.snapshot()["compiles"] - len(cp.ladder)
+            ),
+            "pad_overhead": round(
+                serving_counters.snapshot()["pad_overhead"], 4
+            ),
+            "bucket_hits": serving_counters.snapshot()["bucket_hits"],
+        },
+        "speedup": {
+            "p50": round(
+                float(np.percentile(np.asarray(naive_lats) * 1e3, 50))
+                / float(np.percentile(np.asarray(bucketed_lats) * 1e3, 50)),
+                2,
+            ),
+            "p99": round(naive_p99 / bucketed_p99, 2),
+            "throughput": round(naive_wall / bucketed_wall, 2),
+        },
+        "pass": {
+            "zero_post_warmup_compiles": post_warmup_compiles == 0,
+            "p99_speedup_ge_2x": naive_p99 / bucketed_p99 >= 2.0,
+        },
+    }
+
+    # -- micro-batcher: concurrent single-row clients -------------------------
+    if args.service_clients > 0:
+        per_client = max(1, args.service_requests // args.service_clients)
+        lats, lock = [], threading.Lock()
+
+        def client(cid: int):
+            crng = np.random.default_rng(1000 + cid)
+            mine = []
+            for _ in range(per_client):
+                x = crng.normal(size=(args.d,)).astype(np.float32)
+                t1 = time.perf_counter()
+                svc.submit(x).result()
+                mine.append(time.perf_counter() - t1)
+            with lock:
+                lats.extend(mine)
+
+        with PipelineService(cp, max_delay_ms=2.0) as svc:
+            threads = [
+                threading.Thread(target=client, args=(c,))
+                for c in range(args.service_clients)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            svc_wall = time.perf_counter() - t0
+            stats = svc.stats()
+        result["service"] = {
+            **lat_stats(lats),
+            "clients": args.service_clients,
+            "requests": stats["requests"],
+            "device_batches": stats["batches_run"],
+            "coalesce_ratio": round(stats["coalesce_ratio"], 2),
+            "rows_per_s": round(stats["rows_served"] / svc_wall, 1),
+        }
+
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()
