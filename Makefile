@@ -1,13 +1,23 @@
 # Developer entry points. Pipelines launch via bin/run-pipeline.sh.
 
-.PHONY: test t1 native bench bench-serve dryrun clean tpu-checkride sentinel northstar acceptance
+.PHONY: test t1 chaos native bench bench-serve bench-serve-overload dryrun clean tpu-checkride sentinel northstar acceptance
 
-# The canonical tier-1 verify (ROADMAP.md), verbatim — builders and CI
-# invoke this one entry point instead of hand-copying the command.
-# bash for pipefail/PIPESTATUS.
+# The canonical tier-1 verify (ROADMAP.md), verbatim at the defaults —
+# builders and CI invoke this one entry point instead of hand-copying the
+# command; `chaos` reuses it with T1_ENV/T1_LOG overridden so the two can
+# never drift. bash for pipefail/PIPESTATUS.
+T1_LOG ?= /tmp/_t1.log
+T1_ENV ?=
 t1: SHELL := /bin/bash
 t1:
-	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+	set -o pipefail; rm -f $(T1_LOG); timeout -k 10 870 env JAX_PLATFORMS=cpu $(T1_ENV) python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee $(T1_LOG); rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' $(T1_LOG) | tr -cd . | wc -c); exit $$rc
+
+# Tier-1 under the standard fault plan (utils/reliability.py): transient
+# IOErrors at 5% of record boundaries plus one injected device OOM, seeded
+# and deterministic. The suite must pass UNCHANGED — every injected fault
+# is recovered (retry/backoff, quarantine, chunk downshift) invisibly.
+chaos:
+	$(MAKE) t1 T1_ENV="KEYSTONE_FAULTS=io:0.05,oom:1 KEYSTONE_FAULTS_SEED=0" T1_LOG=/tmp/_chaos.log
 
 # One-command resumable live-chip evidence harness: probes the TPU, runs
 # bench f32/bf16 + MFU sweep + Pallas Mosaic compile + streamed-overlap +
@@ -45,6 +55,12 @@ bench:
 # Writes the machine-readable BENCH_serve.json regression anchor.
 bench-serve:
 	python tools/bench_serve.py --out BENCH_serve.json
+
+# Serving under 2x sustained over-capacity against the bounded queue +
+# deadlines: reports fast-fail rate and accepted p99 — degradation must
+# be bounded (rejections, not a latency cliff) and no future stranded.
+bench-serve-overload:
+	python tools/bench_serve.py --overload
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

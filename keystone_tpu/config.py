@@ -32,6 +32,18 @@ def _env_int(name: str, default: int) -> int:
         raise ValueError(f"{name}={raw!r}: expected an integer") from None
 
 
+def _env_float(name: str, default: float) -> float:
+    """Validated float env knob: a bad value fails AT IMPORT naming the
+    variable — the same diagnostic contract as _env_int."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r}: expected a number") from None
+
+
 def pow2_ladder(max_batch: int) -> tuple:
     """Power-of-two bucket ladder up to (and always including) max_batch —
     the default shape set the serving layer pads batches onto."""
@@ -191,6 +203,58 @@ class Config:
     # the KEYSTONE_CACHE_DIR env var takes precedence). Content-addressed, so
     # it never serves stale fits — see workflow/disk_cache.py.
     cache_dir: str | None = None
+    # Fault-injection plan (utils/reliability.py FaultPlan): a
+    # 'site:value,...' spec, e.g. 'io:0.05,oom:1,producer_death:1'. Integer
+    # values fire on the first N checks of the site; fractions are per-check
+    # probabilities drawn from a stream seeded by faults_seed, so a fixed
+    # seed reproduces the exact fault sequence. Empty = injection disabled,
+    # zero overhead. Env: KEYSTONE_FAULTS / KEYSTONE_FAULTS_SEED.
+    faults: str = field(
+        default_factory=lambda: os.environ.get("KEYSTONE_FAULTS", "")
+    )
+    faults_seed: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_FAULTS_SEED", 0)
+    )
+    # Transient-failure retry budget (utils/reliability.py RetryPolicy):
+    # total attempts per operation, and the exponential-backoff base/cap in
+    # milliseconds (full jitter: each pause is uniform over [0, cap]).
+    # Used by the prefetch producer (flaky record reads) and the chunked
+    # solvers (device RESOURCE_EXHAUSTED at the H2D step).
+    # Env: KEYSTONE_RETRY_ATTEMPTS / KEYSTONE_RETRY_BASE_MS /
+    # KEYSTONE_RETRY_MAX_MS.
+    retry_attempts: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_RETRY_ATTEMPTS", 4)
+    )
+    retry_base_ms: float = field(
+        default_factory=lambda: _env_float("KEYSTONE_RETRY_BASE_MS", 5.0)
+    )
+    retry_max_ms: float = field(
+        default_factory=lambda: _env_float("KEYSTONE_RETRY_MAX_MS", 1000.0)
+    )
+    # Checkpoint cadence for the streaming solvers: snapshot accumulator
+    # state (gram/AᵀB, resp. W/R blocks) every K chunks/blocks into the
+    # solve's checkpoint_dir, so a killed fit recomputes at most K chunks on
+    # resume. 0 disables mid-stream snapshots in BOTH solvers (resume from
+    # an existing snapshot still works; the streamed BCD epoch-boundary
+    # orbax saves are independent and keep happening).
+    # Env: KEYSTONE_CHECKPOINT_EVERY.
+    checkpoint_every: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_CHECKPOINT_EVERY", 8)
+    )
+    # Serving backpressure: the most requests PipelineService holds pending
+    # before submit() fast-fails with QueueFullError — bounded queues turn
+    # overload into fast rejections instead of unbounded latency cliffs.
+    # Env: KEYSTONE_SERVE_MAX_PENDING.
+    serve_max_pending: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_SERVE_MAX_PENDING", 1024)
+    )
+    # Default per-request deadline for PipelineService submits, in
+    # milliseconds: a request still queued past its deadline fails its
+    # future with DeadlineExceeded BEFORE wasting a device call. 0 = no
+    # deadline. Env: KEYSTONE_SERVE_DEADLINE_MS.
+    serve_deadline_ms: float = field(
+        default_factory=lambda: _env_float("KEYSTONE_SERVE_DEADLINE_MS", 0.0)
+    )
     # Whether executor fuses jittable transformer chains into one XLA program.
     # Disabled by KEYSTONE_NO_FUSE set to a truthy value (anything except
     # "", "0", "false", "no").

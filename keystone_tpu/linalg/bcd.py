@@ -771,6 +771,56 @@ def assemble_blocks(W: List[jax.Array]) -> jax.Array:
     return jnp.concatenate(W, axis=0)
 
 
+_BCD_CKPT_KEY = "bcd_stream"
+
+
+def _bcd_ckpt_store(checkpoint_dir: str):
+    from keystone_tpu.workflow.disk_cache import DiskCache
+
+    return DiskCache(checkpoint_dir, suffix=".ckpt.pkl")
+
+
+def _bcd_ckpt_save(store, fingerprint, epoch, block, W, R, invs) -> None:
+    """Mid-epoch snapshot: solver state (W blocks + residual + the ridge
+    inverses computed so far) and the block cursor — ``block`` blocks of
+    ``epoch`` are complete. The atomic DiskCache rewrite means a kill
+    mid-save leaves the previous complete snapshot. D2H of R is the sync
+    this costs, once per K blocks."""
+    from keystone_tpu.utils.metrics import reliability_counters
+
+    store.put(
+        _BCD_CKPT_KEY,
+        {
+            "fingerprint": dict(fingerprint),
+            "epoch": int(epoch),
+            "block": int(block),
+            "W": [np.asarray(w) for w in W],
+            "R": np.asarray(R),
+            "invs": {
+                i: np.asarray(v) for i, v in enumerate(invs) if v is not None
+            },
+        },
+        overwrite=True,
+    )
+    reliability_counters.bump("checkpoints_written")
+
+
+def _bcd_ckpt_resume(store, fingerprint):
+    """The block snapshot, or None when absent / bound to another solve."""
+    import logging
+
+    state = store.get(_BCD_CKPT_KEY)
+    if state is None:
+        return None
+    if not _fingerprint_matches(state.get("fingerprint", {}), fingerprint):
+        logging.getLogger("keystone_tpu").warning(
+            "block checkpoint in %s holds a different solve (fingerprint "
+            "mismatch); ignoring it", store.root,
+        )
+        return None
+    return state
+
+
 def block_coordinate_descent_streamed(
     A_host,
     B: RowMatrix,
@@ -780,6 +830,7 @@ def block_coordinate_descent_streamed(
     row_weights: Optional[jax.Array] = None,
     checkpoint_dir: Optional[str] = None,
     col_center: Optional[np.ndarray] = None,
+    checkpoint_every: Optional[int] = None,
 ) -> Tuple[List[jax.Array], List[Tuple[int, int]]]:
     """BCD for feature matrices that exceed HBM: A stays in host RAM and
     column blocks stream to the device double-buffered — the transfer of
@@ -798,7 +849,18 @@ def block_coordinate_descent_streamed(
     The first epoch fuses gram+Cholesky into each block update and keeps
     the small (b, b) factors resident, so later epochs run the cheap
     cached update while still streaming only one block of A at a time.
+
+    Reliability: each block's H2D retries transient RESOURCE_EXHAUSTED
+    with backoff (a column block can't be split without changing the
+    solve — persistent OOM propagates with the advice to shrink
+    ``block_size``). With ``checkpoint_dir``, epoch snapshots (orbax, as
+    before) are supplemented by mid-epoch block snapshots every
+    ``checkpoint_every`` blocks (default ``config.checkpoint_every``,
+    env ``KEYSTONE_CHECKPOINT_EVERY``; 0 = epoch-only) holding W, R, the
+    ridge inverses computed so far, and the block cursor — a killed fit
+    resumes recomputing at most K block updates.
     """
+    from keystone_tpu.utils.reliability import RetryPolicy, active_plan
     from keystone_tpu.utils.sparse import SparseBatch
 
     sparse = isinstance(A_host, SparseBatch)
@@ -845,8 +907,35 @@ def block_coordinate_descent_streamed(
             block = np.pad(block, ((0, pad), (0, 0)))
         return block
 
+    plan = active_plan()
+    retry = RetryPolicy()
+
+    def put_host(block: np.ndarray) -> jax.Array:
+        """H2D one prepared block, retrying transient RESOURCE_EXHAUSTED
+        (real or the harness's ``oom`` site). Unlike the row-chunked
+        solver there is no downshift — halving a column block would
+        change the solve — so a persistent OOM propagates, annotated."""
+
+        def attempt():
+            if plan is not None:
+                plan.maybe_raise("oom")
+            return jax.device_put(block, sharding)
+
+        try:
+            return retry.call(attempt, site="h2d", counter="h2d_retries")
+        except Exception as exc:
+            from keystone_tpu.utils.reliability import is_oom
+
+            if is_oom(exc):
+                raise type(exc)(
+                    f"{exc} [streamed BCD: a ({block.shape[0]}, "
+                    f"{block.shape[1]}) block does not fit on device even "
+                    "after retries; reduce block_size]"
+                ) from exc
+            raise
+
     def put(i: int) -> jax.Array:
-        return jax.device_put(host_block(i), sharding)
+        return put_host(host_block(i))
 
     weighted = row_weights is not None
     if weighted:
@@ -885,7 +974,33 @@ def block_coordinate_descent_streamed(
     start_epoch, W, R = _resume_or_default(
         checkpoint_dir, fingerprint, W, R, sharding
     )
+    # Mid-epoch block snapshots (atomic DiskCache) can be FURTHER along
+    # than the last orbax epoch save; prefer whichever resumes later.
+    start_block = 0
+    every = (
+        config.checkpoint_every if checkpoint_every is None
+        else int(checkpoint_every)
+    )
+    ckpt_store = None
+    if checkpoint_dir is not None and every > 0:
+        from keystone_tpu.utils.metrics import reliability_counters
+
+        ckpt_store = _bcd_ckpt_store(checkpoint_dir)
+        state = _bcd_ckpt_resume(ckpt_store, fingerprint)
+        if state is not None and (state["epoch"], state["block"]) > (
+            start_epoch, 0,
+        ):
+            start_epoch, start_block = state["epoch"], state["block"]
+            W = [jnp.asarray(w) for w in state["W"]]
+            R = jax.device_put(jnp.asarray(state["R"]), sharding)
+            for i, v in state["invs"].items():
+                invs[int(i)] = jnp.asarray(v)
+            reliability_counters.bump("checkpoints_resumed")
+            if start_block >= nb:  # snapshot landed on an epoch boundary
+                start_epoch, start_block = start_epoch + 1, 0
     if start_epoch >= num_iters:
+        if ckpt_store is not None:
+            ckpt_store.delete(_BCD_CKPT_KEY)  # consumed by this solve
         return W, blocks
     # KEYSTONE_STREAM_NO_OVERLAP=1 serializes transfer and compute — it
     # exists so the checkride can MEASURE what double-buffering buys; it is
@@ -899,27 +1014,29 @@ def block_coordinate_descent_streamed(
     # existing H2D double buffer: the device then never waits on the numpy
     # prep either. depth=0 keeps the prep inline on the consumer thread.
     depth = 0 if no_overlap else max(0, int(config.prefetch_depth))
-    total = (num_iters - start_epoch) * nb
+    total = (num_iters - start_epoch) * nb - start_block
     src = None
     if depth > 0:
 
         def host_blocks():
-            for _ in range(start_epoch, num_iters):
-                for i in range(nb):
+            for e in range(start_epoch, num_iters):
+                for i in range(start_block if e == start_epoch else 0, nb):
                     yield host_block(i)
 
         src = PrefetchIterator(host_blocks(), depth)
 
     def put_ahead(i_next: int) -> jax.Array:
         if src is not None:
-            return jax.device_put(next(src), sharding)
+            return put_host(next(src))
         return put(i_next)
 
     try:
-        next_buf = None if no_overlap else put_ahead(0)
+        next_buf = None if no_overlap else put_ahead(start_block)
         consumed = 0
+        blocks_done = 0
         for epoch in range(start_epoch, num_iters):
-            for i in range(nb):
+            first_block = start_block if epoch == start_epoch else 0
+            for i in range(first_block, nb):
                 if no_overlap:
                     cur = put(i)
                     cur.block_until_ready()
@@ -936,6 +1053,11 @@ def block_coordinate_descent_streamed(
                     R, W[i] = cached(cur, invs[i], R, W[i], w_rows)
                 if throttle:
                     R.block_until_ready()
+                blocks_done += 1
+                if ckpt_store is not None and blocks_done % every == 0:
+                    _bcd_ckpt_save(
+                        ckpt_store, fingerprint, epoch, i + 1, W, R, invs
+                    )
             if checkpoint_dir is not None:
                 _save_epoch(checkpoint_dir, epoch + 1, W, R, fingerprint)
     finally:
@@ -943,4 +1065,9 @@ def block_coordinate_descent_streamed(
             src.close()
     if checkpoint_dir is not None:
         wait_for_checkpoints(checkpoint_dir)
+    if ckpt_store is not None:
+        # Block snapshots are mid-flight state, consumed by the solve that
+        # completes over them; the epoch-boundary orbax saves remain the
+        # durable cross-run artifact (pre-existing semantics).
+        ckpt_store.delete(_BCD_CKPT_KEY)
     return W, blocks

@@ -90,9 +90,199 @@ def _put_labeled_chunk(chunk):
     return A, B
 
 
+def _put_chunks_resilient(chunk, plan, retry):
+    """H2D one labeled chunk with OOM recovery; returns the (A, B) pairs
+    to accumulate, in row order.
+
+    RESOURCE_EXHAUSTED at the transfer (real, or the harness's ``oom``
+    site) is retried with backoff — transient allocation pressure clears,
+    and a successful retry transfers the SAME host bytes, so the solve
+    stays bit-identical. OOM that survives the whole retry budget is
+    structural (the chunk itself doesn't fit): halve its rows and recurse,
+    recording the downshift in ``reliability_counters``. Sub-chunks
+    accumulate in row order, so the split solve is the same least-squares
+    sum at a different flop grouping — numerically equivalent, though not
+    bit-identical to the unsplit run.
+    """
+    import numpy as np
+
+    X_chunk, Y_chunk = chunk
+    if Y_chunk is None:
+        raise ValueError("chunked solve needs labeled batches")
+
+    def attempt():
+        if plan is not None:
+            plan.maybe_raise("oom")
+        return _put_labeled_chunk(chunk)
+
+    from keystone_tpu.utils.reliability import is_oom
+
+    try:
+        if retry is None:
+            return [attempt()]
+        return [retry.call(attempt, site="h2d", counter="h2d_retries")]
+    except Exception as exc:
+        if not is_oom(exc):
+            raise
+        n = int(np.asarray(X_chunk).shape[0])
+        if n <= 1:
+            raise  # can't split a single row: genuinely out of memory
+        import logging
+
+        from keystone_tpu.utils.metrics import reliability_counters
+
+        reliability_counters.bump("oom_downshifts")
+        logging.getLogger("keystone_tpu").warning(
+            "chunked solve: device OOM persisted across retries on a "
+            "%d-row chunk; halving and re-transferring", n,
+        )
+        mid = n // 2
+        lo = (X_chunk[:mid], Y_chunk[:mid])
+        hi = (X_chunk[mid:], Y_chunk[mid:])
+        return _put_chunks_resilient(lo, plan, retry) + _put_chunks_resilient(
+            hi, plan, retry
+        )
+
+
+_STREAM_CKPT_KEY = "stream_solve"
+
+
+def _stream_ckpt_store(checkpoint_dir: str):
+    from keystone_tpu.workflow.disk_cache import DiskCache
+
+    return DiskCache(checkpoint_dir, suffix=".ckpt.pkl")
+
+
+def _stream_fingerprint(first_chunk) -> dict:
+    """Solve identity for checkpoint binding: shapes, dtypes, and a probe
+    of the stream's first record — enough to refuse resuming a different
+    problem into these accumulators."""
+    import numpy as np
+
+    X, Y = first_chunk
+    X = np.asarray(X)
+    return {
+        "d": int(X.shape[1]),
+        "b_tail": tuple(int(t) for t in np.asarray(Y).shape[1:]),
+        "accum_dtype": str(config.accum_dtype),
+        "storage_dtype": str(jnp.dtype(storage_dtype())),
+        "chunk_rows": int(X.shape[0]),
+        "x0_probe": float(np.asarray(X[0], dtype=np.float64).sum()),
+    }
+
+
+class _StreamCheckpointer:
+    """THE checkpoint/resume protocol of the chunked solve — one
+    implementation driven by both the overlapped and sync paths, so the
+    fingerprint binding, skip accounting, every-K save cadence, and
+    consume-on-success can never drift between them. Inert (every call a
+    no-op) when constructed without a ``checkpoint_dir``."""
+
+    def __init__(self, checkpoint_dir: str | None, checkpoint_every: int | None):
+        self.store = (
+            _stream_ckpt_store(checkpoint_dir)
+            if checkpoint_dir is not None
+            else None
+        )
+        every = (
+            config.checkpoint_every
+            if checkpoint_every is None
+            else int(checkpoint_every)
+        )
+        #: Snapshot cadence K; 0 = resume-only (no mid-stream saves).
+        self.every = max(0, every)
+        self.fingerprint = None
+        self.done = 0
+        self.skip = 0
+        self.gram_np = None
+        self.atb_np = None
+
+    def resume(self, first_chunk) -> None:
+        """Bind to the stream's identity (call once, with the first host
+        chunk) and load a matching snapshot if one exists."""
+        if self.store is None:
+            return
+        import logging
+
+        from keystone_tpu.utils.metrics import reliability_counters
+
+        self.fingerprint = _stream_fingerprint(first_chunk)
+        state = self.store.get(_STREAM_CKPT_KEY)
+        if state is None:
+            return
+        if state.get("fingerprint") != self.fingerprint:
+            logging.getLogger("keystone_tpu").warning(
+                "stream-solve checkpoint holds a different solve "
+                "(fingerprint mismatch); starting fresh"
+            )
+            return
+        reliability_counters.bump("checkpoints_resumed")
+        self.skip = int(state["chunks_done"])
+        self.gram_np, self.atb_np = state["gram"], state["atb"]
+
+    def skipping(self) -> bool:
+        """True while fast-forwarding past already-accumulated chunks —
+        the caller drops the chunk unread (no transfer, no gram)."""
+        if self.done < self.skip:
+            self.done += 1
+            from keystone_tpu.utils.metrics import reliability_counters
+
+            reliability_counters.bump("chunks_skipped_on_resume")
+            return True
+        return False
+
+    def restored(self, cdtype):
+        """(gram, atb) from the snapshot in the accumulation dtype, or
+        (None, None) on a fresh start. The numpy round-trip is bit-exact,
+        which is what makes resumed solves bit-identical."""
+        if self.gram_np is None:
+            return None, None
+        return (
+            jnp.asarray(self.gram_np, dtype=cdtype),
+            jnp.asarray(self.atb_np, dtype=cdtype),
+        )
+
+    def chunk_done(self, gram, atb) -> None:
+        """Count one accumulated chunk; snapshot at the cadence. The D2H
+        fetch is the only sync this adds, once per K chunks; the atomic
+        DiskCache rewrite means a kill mid-save leaves the previous
+        complete snapshot."""
+        self.done += 1
+        if (
+            self.store is None
+            or self.every <= 0
+            or self.done % self.every != 0
+        ):
+            return
+        import numpy as np
+
+        from keystone_tpu.utils.metrics import reliability_counters
+
+        self.store.put(
+            _STREAM_CKPT_KEY,
+            {
+                "fingerprint": dict(self.fingerprint),
+                "chunks_done": int(self.done),
+                "gram": np.asarray(gram),
+                "atb": np.asarray(atb),
+            },
+            overwrite=True,
+        )
+        reliability_counters.bump("checkpoints_written")
+
+    def consume(self) -> None:
+        """Delete the snapshot: it belongs to the solve that just
+        completed over it, and a later solve over changed data must never
+        silently resume stale accumulators."""
+        if self.store is not None:
+            self.store.delete(_STREAM_CKPT_KEY)
+
+
 def solve_least_squares_chunked(
     batches, lam: float = 0.0, refine_steps: int = 1,
     prefetch_depth: int | None = None,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int | None = None,
 ) -> jax.Array:
     """Normal-equation solve over an out-of-core row stream.
 
@@ -110,20 +300,42 @@ def solve_least_squares_chunked(
     chunk's accumulation is in flight, and the accumulation step donates
     both accumulators and the consumed chunk buffers. 0 restores the
     fully synchronous loop.
+
+    Reliability: the H2D step retries transient RESOURCE_EXHAUSTED with
+    backoff and halves chunks that structurally don't fit (see
+    ``_put_chunks_resilient``). With ``checkpoint_dir``, the AᵀA/AᵀB
+    accumulators plus the stream cursor snapshot every
+    ``checkpoint_every`` chunks (default ``config.checkpoint_every``,
+    env ``KEYSTONE_CHECKPOINT_EVERY``; 0 = resume-only) through the atomic
+    ``DiskCache``: a killed fit re-run with the same stream resumes at
+    the last snapshot, recomputes at most K chunks, and — because the
+    restored accumulators round-trip bit-exactly and the remaining
+    chunks accumulate through the same program in the same order —
+    yields a bit-identical solution. A snapshot is CONSUMED by the
+    successful solve that completes over it (deleted on return), so a
+    later solve over changed data can never silently resume stale
+    accumulators.
     """
     depth = config.prefetch_depth if prefetch_depth is None else int(prefetch_depth)
     from contextlib import nullcontext
 
     from keystone_tpu.config import env_flag
     from keystone_tpu.loaders.stream import PrefetchIterator, prefetched
+    from keystone_tpu.utils.reliability import RetryPolicy, active_plan
 
     # The measurement knob wins over any depth (matching the streamed BCD
     # path): serialized means serialized, even at the default prefetch
     # depth or for a caller-built PrefetchIterator.
-    if env_flag("KEYSTONE_STREAM_NO_OVERLAP"):
-        return _solve_chunked_sync(batches, lam, refine_steps)
-    if depth <= 0 and not isinstance(batches, PrefetchIterator):
-        return _solve_chunked_sync(batches, lam, refine_steps)
+    if env_flag("KEYSTONE_STREAM_NO_OVERLAP") or (
+        depth <= 0 and not isinstance(batches, PrefetchIterator)
+    ):
+        return _solve_chunked_sync(
+            batches, lam, refine_steps, checkpoint_dir, checkpoint_every
+        )
+
+    plan = active_plan()
+    retry = RetryPolicy()
+    ckpt = _StreamCheckpointer(checkpoint_dir, checkpoint_every)
 
     # Respect an upstream-constructed prefetcher (the bench hands one in to
     # read its queue high-water afterwards) instead of double-wrapping —
@@ -135,35 +347,68 @@ def solve_least_squares_chunked(
         first = next(it, None)
         if first is None:
             raise ValueError("empty batch stream")
-        cur = _put_labeled_chunk(first)
-        mesh = cur[0].mesh
-        accum = _accum_gram_atb_fn(mesh, config.data_axis, _precision())
+        if first[1] is None:
+            raise ValueError("chunked solve needs labeled batches")
+        ckpt.resume(first)
+        # Fast-forward past checkpointed chunks: the producer re-reads
+        # them (row streams don't seek) but no transfer or gram runs.
+        cur_host = first
+        while cur_host is not None and ckpt.skipping():
+            cur_host = next(it, None)
         cdtype = jnp.dtype(config.accum_dtype)
-        d = cur[0].data.shape[1]
+        if cur_host is None:
+            # The whole stream was already accumulated before the kill:
+            # nothing left to recompute, solve straight off the snapshot.
+            gram, atb = ckpt.restored(cdtype)
+            if gram is None:
+                raise ValueError("empty batch stream")
+            ckpt.consume()
+            return _chol_solve(
+                gram, atb, jnp.asarray(lam, dtype=gram.dtype), refine_steps
+            )
+        cur = _put_chunks_resilient(cur_host, plan, retry)
+        mesh = cur[0][0].mesh
+        accum = _accum_gram_atb_fn(mesh, config.data_axis, _precision())
+        d = cur[0][0].data.shape[1]
         # Labels may be 1-D (a single regression/class column — the CSV
         # label_col shape); AᵀB is then (d,) and the Cholesky solve
         # accepts the vector rhs directly, same as the sync path.
-        b_tail = cur[1].data.shape[1:]
+        b_tail = cur[0][1].data.shape[1:]
         replicated = NamedSharding(mesh, P())
-        gram = jax.device_put(jnp.zeros((d, d), dtype=cdtype), replicated)
-        atb = jax.device_put(jnp.zeros((d,) + b_tail, dtype=cdtype), replicated)
+        gram, atb = ckpt.restored(cdtype)
+        if gram is not None:
+            gram = jax.device_put(gram, replicated)
+            atb = jax.device_put(atb, replicated)
+        else:
+            gram = jax.device_put(jnp.zeros((d, d), dtype=cdtype), replicated)
+            atb = jax.device_put(
+                jnp.zeros((d,) + b_tail, dtype=cdtype), replicated
+            )
         while cur is not None:
-            A, B = cur
             # Dispatch is async: the gemms run while the host fetches (the
             # producer thread parses/featurizes ahead) and stages the next
-            # chunk's transfer.
-            gram, atb = accum(gram, atb, A.data, B.data)
+            # chunk's transfer. An OOM-downshifted chunk accumulates its
+            # halves in row order.
+            for A, B in cur:
+                gram, atb = accum(gram, atb, A.data, B.data)
+            ckpt.chunk_done(gram, atb)
             nxt = next(it, None)
-            cur = None if nxt is None else _put_labeled_chunk(nxt)
+            cur = None if nxt is None else _put_chunks_resilient(nxt, plan, retry)
+    ckpt.consume()
     return _chol_solve(
         gram, atb, jnp.asarray(lam, dtype=gram.dtype), refine_steps
     )
 
 
-def _solve_chunked_sync(batches, lam: float, refine_steps: int) -> jax.Array:
+def _solve_chunked_sync(
+    batches, lam: float, refine_steps: int,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int | None = None,
+) -> jax.Array:
     """The prefetch_depth=0 path: one thread, one chunk in flight — the
     pre-overlap behavior, preserved exactly for A/B measurement and as the
-    fallback where background threads are unwelcome.
+    fallback where background threads are unwelcome. Shares the overlapped
+    path's OOM recovery and checkpoint/resume.
 
     KEYSTONE_STREAM_NO_OVERLAP=1 additionally blocks on each chunk's
     reduction, serializing ingest and compute outright — the same
@@ -171,19 +416,35 @@ def _solve_chunked_sync(batches, lam: float, refine_steps: int) -> jax.Array:
     what overlap (including plain async dispatch) buys. Never the right
     setting for real runs."""
     from keystone_tpu.config import env_flag
+    from keystone_tpu.utils.reliability import RetryPolicy, active_plan
 
     serialize = env_flag("KEYSTONE_STREAM_NO_OVERLAP")
+    plan = active_plan()
+    retry = RetryPolicy()
+    ckpt = _StreamCheckpointer(checkpoint_dir, checkpoint_every)
+    bound = False
     gram = None
     atb = None
     for chunk in batches:
-        A, B = _put_labeled_chunk(chunk)
-        g, ab = A.gram_and_atb(B)  # fused: one read of the chunk
-        if serialize:
-            jax.block_until_ready((g, ab))
-        gram = g if gram is None else gram + g
-        atb = ab if atb is None else atb + ab
+        if not bound:
+            bound = True
+            if ckpt.store is not None:
+                if chunk[1] is None:
+                    raise ValueError("chunked solve needs labeled batches")
+                ckpt.resume(chunk)
+                gram, atb = ckpt.restored(jnp.dtype(config.accum_dtype))
+        if ckpt.skipping():
+            continue
+        for A, B in _put_chunks_resilient(chunk, plan, retry):
+            g, ab = A.gram_and_atb(B)  # fused: one read of the chunk
+            if serialize:
+                jax.block_until_ready((g, ab))
+            gram = g if gram is None else gram + g
+            atb = ab if atb is None else atb + ab
+        ckpt.chunk_done(gram, atb)
     if gram is None:
         raise ValueError("empty batch stream")
+    ckpt.consume()
     return _chol_solve(
         gram, atb, jnp.asarray(lam, dtype=gram.dtype), refine_steps
     )

@@ -14,14 +14,23 @@ distributed-backend row) [unverified].
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
+import types
 from contextlib import contextmanager
 from typing import Any, Callable, Iterable, Iterator, Optional, Tuple
 
 import numpy as np
 
 from keystone_tpu.config import config
+from keystone_tpu.utils.reliability import (
+    RecordCorruptError,
+    RetryPolicy,
+    active_plan,
+)
+
+logger = logging.getLogger("keystone_tpu")
 
 Batch = Tuple[np.ndarray, Optional[np.ndarray]]
 
@@ -48,13 +57,35 @@ class PrefetchIterator:
       ``__del__``) stops the producer promptly even when it is blocked
       on a full queue.
 
+    Reliability (utils/reliability.py): transient record-read failures
+    (flaky I/O, the harness's ``io`` site) are retried with backoff on
+    the producer thread — value-identical on success, so the consumer
+    never notices. Irrecoverably corrupt records (``RecordCorruptError``,
+    the ``corrupt`` site) are quarantined — skipped and counted in
+    ``reliability_counters`` — instead of killing the stream. A producer
+    thread that dies without posting its DONE/ERROR sentinel (a real
+    crash or the ``producer_death`` site) is detected by the consumer's
+    liveness poll and restarted on the same upstream iterator, whose
+    position is intact, so the stream continues bit-identically.
+
     Single-use, like any iterator. For a re-iterable source, wrap each
     fresh iteration (``BatchIterator.prefetch`` does this).
     """
 
     _ITEM, _DONE, _ERROR = 0, 1, 2
+    #: How long the consumer blocks per queue poll before re-checking
+    #: producer liveness: the only cost of death detection is a wakeup
+    #: while STARVING (queue empty), never on the fed path.
+    _POLL_S = 0.1
+    _MAX_RESTARTS = 5
+    _JOIN_TIMEOUT_S = 5.0
 
-    def __init__(self, source: Iterable, depth: Optional[int] = None):
+    def __init__(
+        self,
+        source: Iterable,
+        depth: Optional[int] = None,
+        retry_policy: Optional[RetryPolicy] = None,
+    ):
         if depth is None:
             depth = config.prefetch_depth
         depth = int(depth)
@@ -70,13 +101,22 @@ class PrefetchIterator:
         self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._exhausted = False
-        self._thread = threading.Thread(
-            target=self._produce,
-            args=(iter(source),),
-            name="keystone-prefetch",
-            daemon=True,
+        # The upstream iterator is held on self (not closed over by the
+        # thread) so a replacement producer can resume it after a death.
+        self._it: Iterator = iter(source)
+        self._plan = active_plan()  # resolved ONCE: None = zero overhead
+        self._retry = retry_policy if retry_policy is not None else RetryPolicy()
+        self._restarts = 0
+        self._quarantined = 0
+        self._join_warned = False
+        self._thread = self._spawn_producer()
+
+    def _spawn_producer(self) -> threading.Thread:
+        t = threading.Thread(
+            target=self._produce, name="keystone-prefetch", daemon=True
         )
-        self._thread.start()
+        t.start()
+        return t
 
     # -- producer thread ---------------------------------------------------
 
@@ -90,9 +130,49 @@ class PrefetchIterator:
                 continue
         return False
 
-    def _produce(self, it: Iterator) -> None:
+    def _quarantine(self, exc: BaseException) -> None:
+        from keystone_tpu.utils.metrics import reliability_counters
+
+        reliability_counters.bump("records_quarantined")
+        self._quarantined += 1
+        log = logger.warning if self._quarantined <= 3 else logger.debug
+        log("prefetch: quarantined corrupt record #%d (%s)",
+            self._quarantined, exc)
+
+    def _produce(self) -> None:
+        it, plan, retry = self._it, self._plan, self._retry
+        # A generator whose body raises is CLOSED by the raise, so only
+        # non-generator iterators can meaningfully retry / survive
+        # ``next()`` failures; harness faults fire at the post-fetch gate
+        # and are recoverable for every source.
+        durable_src = not isinstance(it, types.GeneratorType)
         try:
-            for item in it:
+            while not self._stop.is_set():
+                if plan is not None and plan.check("producer_death"):
+                    # Exit with NO sentinel — exactly what a killed thread
+                    # leaves behind; the consumer's liveness poll recovers.
+                    return
+                try:
+                    if durable_src:
+                        item = retry.call(
+                            lambda: next(it),
+                            site="record_read", counter="io_retries",
+                        )
+                    else:
+                        item = next(it)
+                    if plan is not None:
+                        # The injected-io gate models a flaky read: a
+                        # retry re-reads the SAME record, value-identical.
+                        retry.call(
+                            lambda: plan.maybe_raise("io"),
+                            site="record_read", counter="io_retries",
+                        )
+                        plan.maybe_raise("corrupt")
+                except StopIteration:
+                    break
+                except RecordCorruptError as exc:
+                    self._quarantine(exc)
+                    continue
                 if not self._put((self._ITEM, item)):
                     return
                 depth_now = self._queue.qsize()
@@ -108,10 +188,39 @@ class PrefetchIterator:
     def __iter__(self) -> "PrefetchIterator":
         return self
 
+    def _restart_producer(self) -> None:
+        """Replace a producer that died without a sentinel. The upstream
+        iterator's position is intact (the fault fires between records),
+        so the replacement continues the stream bit-identically."""
+        from keystone_tpu.utils.metrics import reliability_counters
+
+        self._restarts += 1
+        reliability_counters.bump("producer_restarts")
+        if self._restarts > self._MAX_RESTARTS:
+            self._exhausted = True
+            raise RuntimeError(
+                f"prefetch producer died {self._restarts} times without "
+                "reporting an error; giving up on the stream"
+            )
+        logger.warning(
+            "prefetch producer died silently; restarting (%d/%d)",
+            self._restarts, self._MAX_RESTARTS,
+        )
+        self._thread = self._spawn_producer()
+
     def __next__(self) -> Any:
         if self._exhausted:
             raise StopIteration
-        kind, val = self._queue.get()
+        while True:
+            try:
+                kind, val = self._queue.get(timeout=self._POLL_S)
+                break
+            except queue.Empty:
+                if self._stop.is_set() or self._thread.is_alive():
+                    continue
+                if not self._queue.empty():
+                    continue  # died after a final put: drain it first
+                self._restart_producer()
         if self._stop.is_set():
             # close() ran while we waited: whatever we were handed (a
             # stale item the producer's in-flight put landed after the
@@ -122,10 +231,28 @@ class PrefetchIterator:
         if kind == self._ITEM:
             return val
         self._exhausted = True
-        self._thread.join(timeout=5.0)
+        self._join_producer()
         if kind == self._ERROR:
             raise val
         raise StopIteration
+
+    def _join_producer(self) -> None:
+        """Join the producer with a bounded wait; a thread still alive
+        after the timeout is LEAKED (most likely blocked in upstream I/O
+        that honors no deadline) — warn once, visibly, instead of
+        silently abandoning it. Daemonic, so it can't block exit."""
+        self._thread.join(timeout=self._JOIN_TIMEOUT_S)
+        if self._thread.is_alive() and not self._join_warned:
+            self._join_warned = True
+            from keystone_tpu.utils.metrics import reliability_counters
+
+            reliability_counters.bump("producer_leaks")
+            logger.warning(
+                "prefetch producer thread %r still alive %.0fs after "
+                "close/stop — likely blocked in upstream I/O; leaking it "
+                "(daemon thread, will not block interpreter exit)",
+                self._thread.name, self._JOIN_TIMEOUT_S,
+            )
 
     def close(self) -> None:
         """Stop the producer and release the queue. Idempotent; called on
@@ -139,7 +266,19 @@ class PrefetchIterator:
                 self._queue.get_nowait()
         except queue.Empty:
             pass
-        self._thread.join(timeout=5.0)
+        self._join_producer()
+        if not self._thread.is_alive():
+            # Release the upstream promptly (run generator finalizers,
+            # close file handles) — holding self._it for restartability
+            # otherwise defers that to GC. Only once the producer is
+            # truly gone: closing a generator another thread is executing
+            # raises.
+            close_upstream = getattr(self._it, "close", None)
+            if close_upstream is not None:
+                try:
+                    close_upstream()
+                except Exception:
+                    pass
         # Wake any consumer still parked in queue.get() (cross-thread
         # close): the sentinel turns its wait into StopIteration.
         try:

@@ -122,6 +122,25 @@ def achieved_tflops(fn: Callable, *args, repeats: int = 3) -> Dict[str, float]:
     }
 
 
+class CompileEventCounter:
+    """Counts XLA backend compiles via ``jax.monitoring`` — each compile
+    emits one compile-cache event. THE process's compile oracle, shared by
+    the serving bench and the zero-post-warmup-compile tests so they can't
+    drift apart if a jax upgrade renames the event. Listener registration
+    is global and permanent: create one per process and snapshot
+    ``.count`` around phases."""
+
+    EVENT = "/jax/compilation_cache/compile_requests_use_cache"
+
+    def __init__(self):
+        self.count = 0
+        jax.monitoring.register_event_listener(self._on_event)
+
+    def _on_event(self, name, **kwargs):
+        if name == self.EVENT:
+            self.count += 1
+
+
 class ServingCounters:
     """Process-wide serving observability: how many XLA compiles the
     bucketed apply path performed, and which buckets traffic actually
@@ -167,3 +186,53 @@ class ServingCounters:
 
 
 serving_counters = ServingCounters()
+
+
+class ReliabilityCounters:
+    """Process-wide failure/recovery observability: every reliability event
+    (utils/reliability.py and its call sites) lands here, so a chaos run
+    can assert which recoveries fired and an operator can see whether a
+    'healthy' fit was actually limping on retries. Thread-safe: producer
+    threads, the serving worker, and client threads all record.
+
+    Well-known keys (call sites may add more; snapshot returns whatever
+    was bumped):
+
+    - ``faults_injected_<site>`` — harness injections per FaultPlan site
+    - ``io_retries`` / ``h2d_retries`` — transient-failure retries at the
+      record boundary resp. the solvers' H2D step
+    - ``records_quarantined`` — irrecoverably corrupt records skipped
+    - ``producer_restarts`` / ``producer_leaks`` — prefetch producer
+      threads restarted after silent death / still alive after the join
+      timeout
+    - ``oom_downshifts`` — chunks halved after repeated RESOURCE_EXHAUSTED
+    - ``checkpoints_written`` / ``checkpoints_resumed`` /
+      ``chunks_skipped_on_resume`` — streaming-solver snapshot traffic
+    - ``requests_rejected`` / ``deadline_expired`` — serving fast-fail
+      backpressure and expired-before-run requests
+    - ``worker_restarts`` / ``futures_failed_on_close`` /
+      ``futures_failed_on_worker_death`` — serving worker lifecycle
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+
+    def bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + n
+
+    def get(self, key: str) -> int:
+        with self._lock:
+            return self._counts.get(key, 0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(sorted(self._counts.items()))
+
+
+reliability_counters = ReliabilityCounters()

@@ -1,0 +1,301 @@
+"""Fault injection, retry/backoff, and the failure taxonomy.
+
+The reference survives executor loss because Spark recomputes lost RDD
+partitions from lineage; a KeystoneML ``treeAggregate`` solve shrugs off a
+dead task. This JAX port has no scheduler underneath it, so the long-lived
+execution surfaces — the prefetch producer (loaders/stream.py), the
+chunk-accumulating solvers (linalg/normal_equations.py, linalg/bcd.py),
+and the serving micro-batcher (workflow/serving.py) — carry their own
+reliability: a transient-error classifier + exponential-backoff retry, a
+quarantine path for irrecoverably corrupt records, adaptive chunk
+splitting on device OOM (*Memory Safe Computations with XLA* motivates
+treating RESOURCE_EXHAUSTED as plannable, not fatal), and checkpointed
+accumulator state for restartable solves.
+
+Everything here is tested against the **fault-injection harness**: a
+seeded, deterministic ``FaultPlan`` parsed from ``KEYSTONE_FAULTS``
+(e.g. ``io:0.05,oom:1,producer_death:1``) that fires synthetic faults at
+the exact seams the recovery code guards. Off by default: when the env
+var is unset ``active_plan()`` is None and the hot paths hold no plan
+reference, so the disabled harness costs nothing per record.
+
+Sites (consumed where the seam lives):
+
+- ``io`` — transient ``InjectedIOError`` at the loader/record boundary
+  (probability per record, or a count). Retried by the prefetch producer.
+- ``corrupt`` — ``RecordCorruptError`` at the record boundary: the record
+  is irrecoverable; the producer quarantines (skips + counts) it.
+- ``oom`` — ``InjectedOOM`` (message carries RESOURCE_EXHAUSTED) at the
+  chunked solvers' H2D/accumulation step. Retried, then chunk-split.
+- ``producer_death`` — the prefetch producer thread exits silently, as a
+  killed thread would. The consumer detects and restarts it.
+- ``worker_death`` — the serving worker thread dies; ``submit`` detects,
+  fails in-flight futures, and restarts it.
+
+Counts (``oom:1``) fire on the first N checks of the site; probabilities
+(``io:0.05``) draw from a per-site ``random.Random`` seeded from
+``KEYSTONE_FAULTS_SEED`` + the site name, so a fixed seed reproduces the
+exact fault sequence — the determinism the chaos-equivalence tests pin.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+from keystone_tpu.config import config
+
+logger = logging.getLogger("keystone_tpu")
+
+
+# ---------------------------------------------------------------------------
+# Failure taxonomy
+# ---------------------------------------------------------------------------
+
+
+class DeadlineExceeded(TimeoutError):
+    """A serving request's deadline passed before a device call ran it."""
+
+
+class QueueFullError(RuntimeError):
+    """Fast-fail backpressure: the serving pending queue is at capacity."""
+
+
+class ServiceClosed(RuntimeError):
+    """The request hit a PipelineService that is (or has been) closed."""
+
+
+class WorkerDiedError(RuntimeError):
+    """The serving worker died while this request was in flight; the
+    request may or may not have executed. Safe to retry idempotent work."""
+
+
+class RecordCorruptError(ValueError):
+    """A record is irrecoverably corrupt — no retry can fix it. The stream
+    quarantines (skips + counts) it instead of dying."""
+
+
+class InjectedIOError(IOError):
+    """Harness-injected transient I/O failure (site ``io``)."""
+
+
+class InjectedOOM(RuntimeError):
+    """Harness-injected device allocation failure (site ``oom``). The
+    message carries RESOURCE_EXHAUSTED so the one OOM classifier covers
+    injected and real failures alike."""
+
+
+def is_oom(exc: BaseException) -> bool:
+    """Device out-of-memory, real (XLA RESOURCE_EXHAUSTED) or injected."""
+    if isinstance(exc, InjectedOOM):
+        return True
+    if isinstance(exc, MemoryError):
+        return True
+    return "RESOURCE_EXHAUSTED" in str(exc)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Worth retrying: the same operation may succeed on a fresh attempt.
+    Corrupt records are explicitly NOT transient — retrying a bad byte
+    stream reproduces it; quarantine is the only recovery."""
+    if isinstance(exc, RecordCorruptError):
+        return False
+    if isinstance(exc, (ConnectionError, TimeoutError, InterruptedError)):
+        return True
+    if isinstance(exc, OSError):
+        # I/O hiccups (NFS blips, closed sockets) retry; a missing file
+        # will be just as missing on attempt two.
+        return not isinstance(exc, (FileNotFoundError, IsADirectoryError, NotADirectoryError))
+    return is_oom(exc)
+
+
+# ---------------------------------------------------------------------------
+# Fault injection
+# ---------------------------------------------------------------------------
+
+
+class FaultPlan:
+    """Seeded, deterministic fault schedule parsed from a
+    ``site:value,...`` spec. Integer values are counts (fire on the first
+    N checks of the site); fractional values are per-check probabilities.
+    Thread-safe: producer threads and the serving worker check
+    concurrently."""
+
+    #: Exception constructors per site for ``maybe_raise``.
+    _RAISES: Dict[str, Callable[[], BaseException]] = {
+        "io": lambda: InjectedIOError(
+            "injected transient I/O fault (KEYSTONE_FAULTS io)"
+        ),
+        "corrupt": lambda: RecordCorruptError(
+            "injected corrupt record (KEYSTONE_FAULTS corrupt)"
+        ),
+        "oom": lambda: InjectedOOM(
+            "RESOURCE_EXHAUSTED: injected device OOM (KEYSTONE_FAULTS oom)"
+        ),
+    }
+
+    def __init__(self, spec: str, seed: int = 0):
+        self.spec = spec
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._prob: Dict[str, float] = {}
+        self._count: Dict[str, int] = {}
+        self._rng: Dict[str, random.Random] = {}
+        self.fired: Dict[str, int] = {}
+        self.checked: Dict[str, int] = {}
+        for token in spec.split(","):
+            token = token.strip()
+            if not token:
+                continue
+            try:
+                site, _, raw = token.partition(":")
+                site = site.strip()
+                raw = raw.strip()
+                if not site or not raw:
+                    raise ValueError
+                if "." in raw or "e" in raw.lower():
+                    p = float(raw)
+                    if not 0.0 <= p <= 1.0:
+                        raise ValueError
+                    self._prob[site] = p
+                else:
+                    n = int(raw)
+                    if n < 0:
+                        raise ValueError
+                    self._count[site] = n
+            except ValueError:
+                raise ValueError(
+                    f"KEYSTONE_FAULTS token {token!r}: expected "
+                    "'site:count' (int) or 'site:probability' (0..1 float)"
+                ) from None
+        for site in self._prob:
+            # Per-site stream: the fire pattern at one seam is a pure
+            # function of (seed, site, check index), independent of what
+            # other seams draw.
+            self._rng[site] = random.Random(f"{self.seed}:{site}")
+
+    @property
+    def sites(self) -> tuple:
+        return tuple(sorted(set(self._prob) | set(self._count)))
+
+    def check(self, site: str) -> bool:
+        """True when the plan injects a fault at this check."""
+        with self._lock:
+            self.checked[site] = self.checked.get(site, 0) + 1
+            fire = False
+            if site in self._count:
+                if self._count[site] > 0:
+                    self._count[site] -= 1
+                    fire = True
+            elif site in self._prob:
+                fire = self._rng[site].random() < self._prob[site]
+            if fire:
+                self.fired[site] = self.fired.get(site, 0) + 1
+                from keystone_tpu.utils.metrics import reliability_counters
+
+                reliability_counters.bump(f"faults_injected_{site}")
+            return fire
+
+    def maybe_raise(self, site: str) -> None:
+        """Raise the site's synthetic exception when the plan fires."""
+        if self.check(site):
+            raise self._RAISES[site]()
+
+
+_plan_lock = threading.Lock()
+_plan: Optional[FaultPlan] = None
+_plan_key: Optional[tuple] = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The process-wide FaultPlan, or None when injection is disabled.
+
+    Built from ``config.faults`` / ``config.faults_seed`` (env
+    ``KEYSTONE_FAULTS`` / ``KEYSTONE_FAULTS_SEED``) and rebuilt whenever
+    those change, so tests flip the knobs without a reload. Call sites
+    grab the plan ONCE per stream/solve/service — never per record — so
+    the disabled harness (None) adds nothing to hot loops."""
+    global _plan, _plan_key
+    spec = config.faults or ""
+    key = (spec, config.faults_seed)
+    with _plan_lock:
+        if key != _plan_key:
+            _plan = FaultPlan(spec, config.faults_seed) if spec.strip() else None
+            _plan_key = key
+        return _plan
+
+
+def reset_fault_plan() -> None:
+    """Drop the cached plan (fresh counts/RNG on next ``active_plan``)."""
+    global _plan, _plan_key
+    with _plan_lock:
+        _plan = None
+        _plan_key = None
+
+
+# ---------------------------------------------------------------------------
+# Retry/backoff
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff with full jitter and an attempt cap.
+
+    ``delay(i)`` for retry i (0-based) is uniform over
+    ``[0, min(max_delay, base * 2**i)]`` — full jitter decorrelates
+    retry storms (many producers hitting the same flaky source don't
+    resynchronize). The jitter RNG is seeded so a fixed seed reproduces
+    the exact backoff schedule; sleeps never affect VALUES, only timing,
+    so chaos-equivalence stays bit-identical regardless.
+    """
+
+    max_attempts: int = field(
+        default_factory=lambda: max(1, config.retry_attempts)
+    )
+    base_delay: float = field(
+        default_factory=lambda: config.retry_base_ms / 1e3
+    )
+    max_delay: float = field(
+        default_factory=lambda: config.retry_max_ms / 1e3
+    )
+    classify: Callable[[BaseException], bool] = is_transient
+    seed: Optional[int] = None
+    sleep: Callable[[float], None] = time.sleep
+
+    def __post_init__(self):
+        self._rng = random.Random(self.seed)
+
+    def delay(self, attempt: int) -> float:
+        cap = min(self.max_delay, self.base_delay * (2.0 ** attempt))
+        return self._rng.uniform(0.0, cap)
+
+    def call(self, fn: Callable, *, site: str = "op", counter: Optional[str] = None):
+        """Run ``fn()`` with up to ``max_attempts`` tries. Transient
+        failures (per ``classify``) back off and retry, bumping
+        ``reliability_counters[counter or f"{site}_retries"]``; the last
+        attempt's error (or any non-transient error) propagates."""
+        from keystone_tpu.utils.metrics import reliability_counters
+
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_attempts):
+            try:
+                return fn()
+            except BaseException as exc:
+                if not self.classify(exc) or attempt == self.max_attempts - 1:
+                    raise
+                last = exc
+                reliability_counters.bump(counter or f"{site}_retries")
+                pause = self.delay(attempt)
+                logger.debug(
+                    "retrying %s after %s (attempt %d/%d, backoff %.1f ms)",
+                    site, type(exc).__name__, attempt + 1,
+                    self.max_attempts, pause * 1e3,
+                )
+                if pause > 0:
+                    self.sleep(pause)
+        raise last  # unreachable; keeps the type-checker honest

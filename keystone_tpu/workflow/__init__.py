@@ -19,8 +19,12 @@ from keystone_tpu.workflow.optimizer import (
 from keystone_tpu.workflow.serialization import load_pipeline, save_pipeline
 from keystone_tpu.workflow.serving import (
     CompiledPipeline,
+    DeadlineExceeded,
     PipelineService,
+    QueueFullError,
     RowDependenceError,
+    ServiceClosed,
+    WorkerDiedError,
 )
 
 __all__ = [
@@ -47,4 +51,8 @@ __all__ = [
     "CompiledPipeline",
     "PipelineService",
     "RowDependenceError",
+    "QueueFullError",
+    "DeadlineExceeded",
+    "ServiceClosed",
+    "WorkerDiedError",
 ]

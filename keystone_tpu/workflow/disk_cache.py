@@ -28,6 +28,7 @@ import logging
 import os
 import pickle
 import tempfile
+import time
 from typing import Any, Optional
 
 logger = logging.getLogger("keystone_tpu")
@@ -131,9 +132,38 @@ def _load_entry(f) -> Any:
     return _RestrictedUnpickler(f).load()
 
 
-class DiskFitCache:
-    def __init__(self, root: str, max_bytes: Optional[int] = None):
+class DiskCache:
+    """Key-addressed atomic pickle store — the durability substrate shared
+    by the fitted-prefix cache (``DiskFitCache``) and the streaming
+    solvers' checkpoint/resume state (linalg/normal_equations.py,
+    linalg/bcd.py).
+
+    Crash-safety contract: ``put`` writes to a temp file in the cache root
+    and ``os.replace``s it into place, so a process killed mid-write can
+    never leave a truncated entry that poisons later ``get``s — the reader
+    sees either the old entry or the new one, both complete. Temp files
+    orphaned by a mid-write kill are swept (age-gated, so a concurrent
+    writer's in-flight temp survives) on the next construction. Reads go
+    through the restricted unpickler above; corrupt or unreadable entries
+    degrade to misses, never errors.
+    """
+
+    #: Entry filename suffix — namespaces co-resident stores (trim and the
+    #: stale-temp sweep only ever touch their own suffix).
+    SUFFIX = ".pkl"
+
+    #: Orphaned temp files older than this are removed at construction; the
+    #: age gate keeps a live concurrent writer's temp out of the sweep.
+    _TMP_MAX_AGE_S = 3600.0
+
+    def __init__(
+        self,
+        root: str,
+        max_bytes: Optional[int] = None,
+        suffix: Optional[str] = None,
+    ):
         self.root = root
+        self.suffix = suffix if suffix is not None else self.SUFFIX
         if max_bytes is None:
             raw = os.environ.get("KEYSTONE_CACHE_MAX_BYTES", "")
             try:
@@ -156,11 +186,42 @@ class DiskFitCache:
         # dirs keep their mode — tightening a deliberately shared cache
         # behind the owner's back would break it silently.
         os.makedirs(root, mode=0o700, exist_ok=True)
+        self._sweep_stale_tmps()
 
     _SWEEP_EVERY = 32
 
+    def _owns(self, name: str, extra: str = "") -> bool:
+        """Suffix scoping for directory sweeps. ``endswith`` alone is
+        hierarchical ('.fit.pkl' ends with '.pkl'), so additionally the
+        part before the suffix must be dot-free — true of every key this
+        layer writes (digests, snapshot names, mkstemp stems), false for
+        a longer co-resident suffix's files."""
+        tail = f"{self.suffix}{extra}"
+        return name.endswith(tail) and "." not in name[: -len(tail)]
+
+    def _sweep_stale_tmps(self) -> None:
+        """Remove temp files orphaned by a writer killed between mkstemp
+        and os.replace — they hold partial pickles nothing will ever
+        complete. Age-gated so an in-flight concurrent write survives,
+        and suffix-scoped (temps are named <suffix>.tmp) so this store
+        never touches a co-resident store's in-flight writes."""
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return
+        now = time.time()
+        for name in names:
+            if not self._owns(name, extra=".tmp"):
+                continue
+            path = os.path.join(self.root, name)
+            try:
+                if now - os.stat(path).st_mtime > self._TMP_MAX_AGE_S:
+                    os.remove(path)
+            except OSError:
+                continue  # racing sweeper/writer: theirs to handle
+
     def _path(self, key: str) -> str:
-        return os.path.join(self.root, f"{key}.fit.pkl")
+        return os.path.join(self.root, f"{key}{self.suffix}")
 
     def _trim(self) -> None:
         """Evict least-recently-USED entries (get() refreshes mtime) until
@@ -181,7 +242,7 @@ class DiskFitCache:
         entries = []
         total = 0
         for name in names:
-            if not name.endswith(".fit.pkl"):
+            if not self._owns(name):
                 continue
             path = os.path.join(self.root, name)
             try:
@@ -204,6 +265,16 @@ class DiskFitCache:
                 break
         self._approx_total = total
 
+    def delete(self, key: str) -> None:
+        """Remove one entry; missing is fine. The checkpoint stores call
+        this on successful solve completion — a consumed snapshot left
+        behind could silently resume a LATER solve over changed data whose
+        fingerprint probe happens to match."""
+        try:
+            os.remove(self._path(key))
+        except OSError:
+            pass
+
     def get(self, key: str) -> Optional[Any]:
         path = self._path(key)
         try:
@@ -225,15 +296,22 @@ class DiskFitCache:
         logger.info("disk fit cache: hit %s", key)
         return fitted
 
-    def put(self, key: str, fitted: Any) -> None:
-        # Transformer.__getstate__ drops jit caches during pickling, so the
-        # live object (still in the session cache / user's hands) keeps its
-        # warm compilation.
+    def put(self, key: str, fitted: Any, overwrite: bool = False) -> None:
+        """Persist one entry atomically (temp file + ``os.replace``).
+
+        ``overwrite=False`` (content-addressed use: the bytes behind a key
+        never change) skips keys that already exist; ``overwrite=True``
+        (checkpoint use: the same key is rewritten every K chunks)
+        replaces the entry — still atomically, so a kill mid-rewrite
+        leaves the PREVIOUS complete checkpoint, never a truncated one.
+        """
         path = self._path(key)
-        if os.path.exists(path):
+        if not overwrite and os.path.exists(path):
             return
         try:
-            fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
+            fd, tmp = tempfile.mkstemp(
+                dir=self.root, suffix=f"{self.suffix}.tmp"
+            )
             try:
                 with os.fdopen(fd, "wb") as f:
                     pickle.dump(fitted, f)
@@ -253,3 +331,11 @@ class DiskFitCache:
                 raise
         except Exception as e:  # persistence is best-effort
             logger.warning("disk fit cache: could not persist %s (%s)", key, e)
+
+
+class DiskFitCache(DiskCache):
+    """The cross-process fitted-prefix store (module docstring above): a
+    ``DiskCache`` whose keys are structural digests of estimator prefixes,
+    so entries are content-addressed and never overwritten."""
+
+    SUFFIX = ".fit.pkl"

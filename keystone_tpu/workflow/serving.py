@@ -35,6 +35,7 @@ with ``RowDependenceError`` instead of silently corrupting outputs.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
@@ -45,7 +46,16 @@ import jax
 import numpy as np
 
 from keystone_tpu.config import config, pow2_ladder
-from keystone_tpu.utils.metrics import serving_counters
+from keystone_tpu.utils.metrics import reliability_counters, serving_counters
+from keystone_tpu.utils.reliability import (
+    DeadlineExceeded,
+    QueueFullError,
+    ServiceClosed,
+    WorkerDiedError,
+    active_plan,
+)
+
+logger = logging.getLogger("keystone_tpu")
 
 
 class RowDependenceError(TypeError):
@@ -68,7 +78,11 @@ def resolve_ladder(
     if buckets is None and config.serve_buckets:
         buckets = config.serve_buckets
     if buckets is None:
-        ladder = pow2_ladder(max_batch or config.serve_max_batch)
+        # `is None`, not truthiness: an explicit max_batch=0 must hit
+        # pow2_ladder's ValueError, not silently become the config default.
+        ladder = pow2_ladder(
+            config.serve_max_batch if max_batch is None else max_batch
+        )
     else:
         ladder = tuple(sorted({int(b) for b in buckets}))
         if max_batch is not None:
@@ -409,15 +423,42 @@ class PipelineService:
     are back-to-back full buckets; the delay only bounds the latency a
     lone request pays waiting for company.
 
+    Hardened for sustained overload (utils/reliability.py):
+
+    - **Bounded pending queue.** At ``max_pending`` queued requests,
+      ``submit`` fast-fails with ``QueueFullError`` instead of growing
+      the queue — under 2× capacity, excess load becomes immediate
+      rejections while accepted requests keep a bounded p99, rather than
+      every request sliding down an unbounded-latency cliff.
+    - **Per-request deadlines.** A request still queued past its deadline
+      (per-submit ``deadline_ms``, default ``config.serve_deadline_ms``)
+      fails its future with ``DeadlineExceeded`` before wasting a device
+      call on an answer nobody is waiting for.
+    - **Worker-death detection.** If the worker thread dies (a bug, or
+      the harness's ``worker_death`` site), the next ``submit`` fails the
+      dead worker's in-flight futures with ``WorkerDiedError``, restarts
+      the worker, and the queue drains normally.
+    - **A close() that never strands a future.** ``close()`` drains by
+      default (``drain=False`` rejects immediately); either way every
+      future still unresolved when the worker is gone is failed with
+      ``ServiceClosed`` — no caller ever blocks forever on ``result()``.
+
     Requires a warmed pipeline: warmup belongs before first traffic, not
     under it.
     """
+
+    #: Upper bound on waiting for the worker to drain at close(): the
+    #: satellite guarantee is "reject, never hang" — past this, leftover
+    #: futures are failed instead of waited for.
+    _CLOSE_JOIN_S = 30.0
 
     def __init__(
         self,
         compiled: CompiledPipeline,
         max_delay_ms: float = 2.0,
         max_rows: Optional[int] = None,
+        max_pending: Optional[int] = None,
+        deadline_ms: Optional[float] = None,
     ):
         if compiled.feature_shape is None:
             raise RuntimeError(
@@ -425,26 +466,53 @@ class PipelineService:
                 "warmup() with the traffic's feature shape first"
             )
         self.compiled = compiled
-        self.max_rows = int(max_rows or compiled.max_batch)
+        # `is None`, not truthiness: an explicit max_rows=0 must error.
+        self.max_rows = int(
+            compiled.max_batch if max_rows is None else max_rows
+        )
+        if self.max_rows < 1:
+            raise ValueError(f"max_rows must be >= 1, got {self.max_rows}")
         self.max_delay = max_delay_ms / 1e3
+        self.max_pending = int(
+            max_pending if max_pending is not None else config.serve_max_pending
+        )
+        if self.max_pending < 1:
+            raise ValueError(f"max_pending must be >= 1, got {self.max_pending}")
+        self.default_deadline_s = (
+            deadline_ms if deadline_ms is not None else config.serve_deadline_ms
+        ) / 1e3
+        self._plan = active_plan()
         self._pending: deque = deque()
+        self._inflight: list = []  # futures of the group being flushed
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._closed = False
         self.requests = 0
         self.batches_run = 0
         self.rows_served = 0
-        self._worker = threading.Thread(
+        self.rejected = 0
+        self.expired = 0
+        self.worker_restarts = 0
+        self._worker = self._spawn_worker()
+
+    def _spawn_worker(self) -> threading.Thread:
+        t = threading.Thread(
             target=self._loop, name="keystone-serve", daemon=True
         )
-        self._worker.start()
+        t.start()
+        return t
 
     # -- client side -------------------------------------------------------
 
-    def submit(self, x) -> Future:
+    def submit(self, x, deadline_ms: Optional[float] = None) -> Future:
         """Queue one request: a single example (feature-shaped) or a small
         batch (leading row axis). The future resolves to the transformed
-        example/batch respectively."""
+        example/batch respectively — or fails with ``QueueFullError``
+        (raised here, synchronously), ``DeadlineExceeded``,
+        ``WorkerDiedError``, or ``ServiceClosed``; it is never stranded.
+
+        ``deadline_ms`` overrides the service default for this request;
+        0/None with a 0 default means no deadline."""
         x = np.asarray(x, dtype=self.compiled.dtype)
         datum = x.shape == self.compiled.feature_shape
         if datum:
@@ -454,16 +522,72 @@ class PipelineService:
                 f"request shape {x.shape} does not match served feature "
                 f"shape {self.compiled.feature_shape}"
             )
+        deadline_s = (
+            deadline_ms / 1e3 if deadline_ms is not None
+            else self.default_deadline_s
+        )
+        deadline = time.monotonic() + deadline_s if deadline_s > 0 else None
         fut: Future = Future()
         with self._cv:
             if self._closed:
-                raise RuntimeError("PipelineService is closed")
-            self._pending.append((x, datum, fut))
+                raise ServiceClosed("PipelineService is closed")
+            self._ensure_worker_locked()
+            if len(self._pending) >= self.max_pending:
+                # Fast-fail backpressure: reject NOW, at zero device cost,
+                # instead of queueing latency the client will time out on.
+                self.rejected += 1
+                reliability_counters.bump("requests_rejected")
+                raise QueueFullError(
+                    f"serving queue at capacity ({self.max_pending} "
+                    "pending); request rejected fast"
+                )
+            self._pending.append((x, datum, fut, deadline))
             self.requests += 1
             self._cv.notify()
         return fut
 
+    def _ensure_worker_locked(self) -> None:
+        """Detect a dead worker (caller holds the lock): fail whatever it
+        had in flight — those futures can never resolve — and restart it
+        so the queued work drains."""
+        if self._worker.is_alive():
+            return
+        dead = [f for f in self._inflight if not f.done()]
+        for f in dead:
+            self._resolve(
+                f, exc=WorkerDiedError(
+                    "serving worker died while this request was in flight"
+                )
+            )
+        if dead:
+            reliability_counters.bump(
+                "futures_failed_on_worker_death", len(dead)
+            )
+        self._inflight = []
+        self.worker_restarts += 1
+        reliability_counters.bump("worker_restarts")
+        logger.warning(
+            "PipelineService worker died; restarting (restart #%d, %d "
+            "in-flight futures failed)", self.worker_restarts, len(dead),
+        )
+        self._worker = self._spawn_worker()
+
     # -- worker side -------------------------------------------------------
+
+    @staticmethod
+    def _expired(entry) -> bool:
+        deadline = entry[3]
+        return deadline is not None and time.monotonic() > deadline
+
+    def _fail_expired(self, entry) -> None:
+        self.expired += 1
+        reliability_counters.bump("deadline_expired")
+        self._resolve(
+            entry[2],
+            exc=DeadlineExceeded(
+                "request deadline passed before the device ran it"
+            ),
+        )
 
     def _loop(self):
         while True:
@@ -472,22 +596,48 @@ class PipelineService:
                     self._cv.wait()
                 if not self._pending and self._closed:
                     return
-                group = [self._pending.popleft()]
-                rows = group[0][0].shape[0]
-                deadline = time.monotonic() + self.max_delay
-                while rows < self.max_rows:
+                if self._plan is not None and self._plan.check("worker_death"):
+                    # Die like a crashed thread would: queued entries stay
+                    # pending (the restarted worker serves them); only a
+                    # group already popped would be lost, and the restart
+                    # path fails those futures explicitly.
+                    raise WorkerDiedError(
+                        "injected worker death (KEYSTONE_FAULTS worker_death)"
+                    )
+                group: list = []
+                rows = 0
+                flush_at: Optional[float] = None
+                while True:
                     if self._pending:
-                        nxt_rows = self._pending[0][0].shape[0]
-                        if rows + nxt_rows > self.max_rows:
+                        entry = self._pending[0]
+                        if self._expired(entry):
+                            # Expired in queue: fail it before it costs a
+                            # device call, keep coalescing.
+                            self._pending.popleft()
+                            self._fail_expired(entry)
+                            continue
+                        nxt_rows = entry[0].shape[0]
+                        if group and rows + nxt_rows > self.max_rows:
                             break
                         group.append(self._pending.popleft())
                         rows += nxt_rows
+                        if flush_at is None:
+                            flush_at = time.monotonic() + self.max_delay
+                        if rows >= self.max_rows:
+                            break
                         continue
-                    remaining = deadline - time.monotonic()
+                    if not group:
+                        break  # everything pending had expired: re-wait
+                    remaining = flush_at - time.monotonic()
                     if remaining <= 0 or self._closed:
                         break
                     self._cv.wait(remaining)
+                if not group:
+                    continue
+                self._inflight = [e[2] for e in group]
             self._flush(group)
+            with self._cv:
+                self._inflight = []
 
     @staticmethod
     def _resolve(fut: Future, value=None, exc=None) -> None:
@@ -503,16 +653,26 @@ class PipelineService:
             pass
 
     def _flush(self, group):
-        try:
-            if len(group) == 1:
-                X = group[0][0]
+        # Deadlines re-checked at flush time: a request can expire while
+        # the group waits max_delay for company.
+        live = []
+        for entry in group:
+            if self._expired(entry):
+                self._fail_expired(entry)
             else:
-                X = np.concatenate([g[0] for g in group], axis=0)
+                live.append(entry)
+        if not live:
+            return
+        try:
+            if len(live) == 1:
+                X = live[0][0]
+            else:
+                X = np.concatenate([g[0] for g in live], axis=0)
             out = self.compiled(X)
             self.batches_run += 1
             self.rows_served += X.shape[0]
             off = 0
-            for x, datum, fut in group:
+            for x, datum, fut, _deadline in live:
                 m = x.shape[0]
                 piece = jax.tree_util.tree_map(
                     lambda a, o=off, m=m: a[o : o + m], out
@@ -522,18 +682,46 @@ class PipelineService:
                 off += m
                 self._resolve(fut, value=piece)
         except Exception as e:  # fail the whole flush group, keep serving
-            for _x, _d, fut in group:
+            for _x, _d, fut, _deadline in live:
                 if not fut.done():
                     self._resolve(fut, exc=e)
 
     # -- lifecycle ---------------------------------------------------------
 
-    def close(self):
-        """Drain queued requests, then stop the worker."""
+    def close(self, drain: bool = True):
+        """Stop the service without stranding a single future.
+
+        ``drain=True`` (default) lets the worker serve what is already
+        queued, then joins it; ``drain=False`` rejects queued requests
+        immediately with ``ServiceClosed``. In BOTH modes, any future
+        still unresolved once the worker is gone — queued behind a dead
+        worker, in flight when the join timed out — is failed with
+        ``ServiceClosed`` rather than left for a caller to block on
+        forever. Idempotent."""
+        rejected: list = []
         with self._cv:
             self._closed = True
+            if not drain:
+                rejected = [e[2] for e in self._pending]
+                self._pending.clear()
             self._cv.notify_all()
-        self._worker.join()
+        self._worker.join(timeout=self._CLOSE_JOIN_S)
+        with self._cv:
+            leftovers = [e[2] for e in self._pending] + list(self._inflight)
+            self._pending.clear()
+            self._inflight = []
+        failed = 0
+        for fut in rejected + leftovers:
+            if not fut.done():
+                self._resolve(
+                    fut,
+                    exc=ServiceClosed(
+                        "PipelineService closed before this request ran"
+                    ),
+                )
+                failed += 1
+        if failed:
+            reliability_counters.bump("futures_failed_on_close", failed)
 
     def __enter__(self) -> "PipelineService":
         return self
@@ -547,6 +735,9 @@ class PipelineService:
             "requests": self.requests,
             "batches_run": self.batches_run,
             "rows_served": self.rows_served,
+            "rejected": self.rejected,
+            "expired": self.expired,
+            "worker_restarts": self.worker_restarts,
             "coalesce_ratio": (
                 self.requests / self.batches_run if self.batches_run else None
             ),

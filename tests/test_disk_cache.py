@@ -408,6 +408,101 @@ class TestDiskCacheTrim:
         assert cache.get("aaa") is not None and cache.get("bbb") is not None
 
 
+class TestCrashSafety:
+    """The checkpoint/resume substrate (ISSUE 3 satellite): a process
+    killed mid-write must never leave a truncated entry a later get()
+    trips over."""
+
+    def test_put_is_atomic_no_partial_entry_visible(self, tmp_path):
+        """Simulate a kill mid-write: a pickler that dies halfway through
+        dump leaves ONLY a temp file — the addressed entry never exists in
+        a partial state."""
+        import pickle
+        from unittest import mock
+
+        from keystone_tpu.workflow.disk_cache import DiskCache
+
+        cache = DiskCache(str(tmp_path / "store"))
+        payload = {"W": np.zeros((64, 64), dtype=np.float32)}
+
+        class Killed(BaseException):
+            pass
+
+        def dying_dump(obj, f):
+            f.write(pickle.dumps(obj)[:100])  # partial bytes on disk...
+            raise Killed()  # ...then the "kill"
+
+        with mock.patch.object(pickle, "dump", dying_dump):
+            with pytest.raises(Killed):
+                cache.put("ck", payload)
+        assert cache.get("ck") is None  # entry never became addressable
+        assert not os.path.exists(cache._path("ck"))
+
+    def test_overwrite_is_atomic_old_entry_survives_killed_rewrite(
+        self, tmp_path
+    ):
+        import pickle
+        from unittest import mock
+
+        from keystone_tpu.workflow.disk_cache import DiskCache
+
+        cache = DiskCache(str(tmp_path / "store"))
+        cache.put("ck", {"chunks_done": 4}, overwrite=True)
+
+        class Killed(BaseException):
+            pass
+
+        def dying_dump(obj, f):
+            raise Killed()
+
+        with mock.patch.object(pickle, "dump", dying_dump):
+            with pytest.raises(Killed):
+                cache.put("ck", {"chunks_done": 6}, overwrite=True)
+        # The PREVIOUS complete checkpoint is still there, readable.
+        assert cache.get("ck") == {"chunks_done": 4}
+
+    def test_overwrite_replaces_and_default_put_dedups(self, tmp_path):
+        from keystone_tpu.workflow.disk_cache import DiskCache
+
+        cache = DiskCache(str(tmp_path / "store"))
+        cache.put("k", 1)
+        cache.put("k", 2)  # content-addressed default: first write wins
+        assert cache.get("k") == 1
+        cache.put("k", 3, overwrite=True)
+        assert cache.get("k") == 3
+
+    def test_stale_tmps_swept_fresh_ones_kept(self, tmp_path):
+        import time
+
+        from keystone_tpu.workflow.disk_cache import DiskCache
+
+        root = tmp_path / "store"
+        DiskCache(str(root))  # create
+        stale = root / "deadbeef.pkl.tmp"
+        fresh = root / "inflight.pkl.tmp"
+        other = root / "cafe.fit.pkl.tmp"  # a CO-RESIDENT store's orphan
+        for f in (stale, fresh, other):
+            f.write_bytes(b"partial")
+        old = time.time() - 2 * DiskCache._TMP_MAX_AGE_S
+        os.utime(stale, (old, old))
+        os.utime(other, (old, old))
+        DiskCache(str(root))  # a new store sweeps its root
+        assert not stale.exists()  # own orphan gone
+        assert fresh.exists()  # live concurrent writer's temp untouched
+        assert other.exists()  # suffix-scoped: another store's, not ours
+
+    def test_suffixes_namespace_coresident_stores(self, tmp_path):
+        from keystone_tpu.workflow.disk_cache import DiskCache, DiskFitCache
+
+        root = str(tmp_path / "store")
+        ckpt = DiskCache(root, suffix=".ckpt.pkl")
+        fits = DiskFitCache(root)
+        ckpt.put("same-key", {"kind": "checkpoint"})
+        fits.put("same-key", {"kind": "fit"})
+        assert ckpt.get("same-key") == {"kind": "checkpoint"}
+        assert fits.get("same-key") == {"kind": "fit"}
+
+
 class TestConcurrentWriters:
     @pytest.mark.slow
     def test_parallel_processes_share_one_store(self, tmp_path):

@@ -1,0 +1,716 @@
+"""Fault-injection harness + recovery-path tests.
+
+Three layers of evidence, mirroring ISSUE 3's acceptance gates:
+
+1. the harness itself is deterministic (a seed reproduces the fault
+   sequence) and inert when enabled-but-silent (a zero-probability plan
+   yields bit-identical results to a disabled one);
+2. chaos equivalence — with ``io``/``oom``/``producer_death`` faults
+   armed, the chunked solve completes and matches the fault-free run
+   bit-for-bit, and a killed fit resumes from its checkpoint recomputing
+   at most K chunks, also bit-identically;
+3. serving degrades, never cliffs: overload fast-fails with
+   ``QueueFullError``/``DeadlineExceeded``, worker death restarts, and
+   no future is ever stranded — including across ``close()``.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from keystone_tpu.config import config
+from keystone_tpu.utils import reliability
+from keystone_tpu.utils.metrics import reliability_counters
+from keystone_tpu.utils.reliability import (
+    DeadlineExceeded,
+    FaultPlan,
+    InjectedIOError,
+    InjectedOOM,
+    QueueFullError,
+    RecordCorruptError,
+    RetryPolicy,
+    ServiceClosed,
+    is_oom,
+    is_transient,
+)
+
+
+@pytest.fixture
+def faults():
+    """Arm a fault plan for the test; starts DISARMED (even under ``make
+    chaos``'s process-wide plan, so counter assertions stay exact) and
+    restores the prior plan + counters after."""
+    prior = (config.faults, config.faults_seed)
+    reliability_counters.reset()
+
+    def arm(spec: str, seed: int = 0):
+        config.faults, config.faults_seed = spec, seed
+        reliability.reset_fault_plan()
+        return reliability.active_plan()
+
+    arm("")
+    yield arm
+    config.faults, config.faults_seed = prior
+    reliability.reset_fault_plan()
+    reliability_counters.reset()
+
+
+def _stream(rng_seed=0, n=512, d=16, k=3, batch_rows=64):
+    from keystone_tpu.loaders.stream import BatchIterator
+
+    rng = np.random.default_rng(rng_seed)
+    A = rng.normal(size=(n, d)).astype(np.float32)
+    B = (A @ rng.normal(size=(d, k)).astype(np.float32))
+    return A, B, (lambda: BatchIterator.from_arrays(A, B, batch_rows=batch_rows))
+
+
+# ---------------------------------------------------------------------------
+# The harness itself
+# ---------------------------------------------------------------------------
+
+
+class TestFaultPlan:
+    def test_disabled_by_default(self, faults):
+        assert faults("") is None
+
+    def test_parse_counts_and_probabilities(self, faults):
+        plan = faults("io:0.25,oom:2,producer_death:1")
+        assert plan.sites == ("io", "oom", "producer_death")
+        # Counts fire on the first N checks, then never again.
+        assert plan.check("oom") and plan.check("oom")
+        assert not any(plan.check("oom") for _ in range(20))
+        assert plan.check("producer_death")
+        assert not plan.check("producer_death")
+
+    def test_unlisted_site_never_fires(self, faults):
+        plan = faults("io:1")
+        assert not plan.check("oom")
+        assert plan.checked["oom"] == 1  # observed, just not armed
+
+    def test_probability_sequence_is_seed_deterministic(self):
+        def seq(seed):
+            plan = FaultPlan("io:0.3", seed=seed)
+            return [plan.check("io") for _ in range(100)]
+
+        a, b, c = seq(7), seq(7), seq(8)
+        assert a == b
+        assert a != c
+        assert any(a) and not all(a)
+
+    def test_sites_draw_independent_streams(self):
+        # The io pattern must not shift when another site is added.
+        solo = FaultPlan("io:0.3", seed=3)
+        lone = [solo.check("io") for _ in range(50)]
+        plan = FaultPlan("io:0.3,corrupt:0.5", seed=3)
+        paired = []
+        for _ in range(50):
+            paired.append(plan.check("io"))
+            plan.check("corrupt")
+        assert lone == paired
+
+    def test_maybe_raise_types(self, faults):
+        plan = faults("io:1,oom:1,corrupt:1")
+        with pytest.raises(InjectedIOError):
+            plan.maybe_raise("io")
+        with pytest.raises(InjectedOOM, match="RESOURCE_EXHAUSTED"):
+            plan.maybe_raise("oom")
+        with pytest.raises(RecordCorruptError):
+            plan.maybe_raise("corrupt")
+
+    def test_malformed_spec_rejected(self):
+        for bad in ("io", "io:", ":1", "io:-1", "io:1.5", "io:x"):
+            with pytest.raises(ValueError, match="KEYSTONE_FAULTS"):
+                FaultPlan(bad)
+
+    def test_plan_rebuilds_when_config_changes(self, faults):
+        assert faults("io:1") is not None
+        assert reliability.active_plan() is reliability.active_plan()
+        assert faults("") is None
+
+
+class TestClassifierAndRetry:
+    def test_taxonomy(self):
+        assert is_transient(ConnectionResetError())
+        assert is_transient(TimeoutError())
+        assert is_transient(InjectedIOError("x"))
+        assert is_transient(InjectedOOM("RESOURCE_EXHAUSTED: x"))
+        assert not is_transient(FileNotFoundError("gone"))
+        assert not is_transient(RecordCorruptError("bad bytes"))
+        assert not is_transient(ValueError("logic bug"))
+        assert is_oom(RuntimeError("RESOURCE_EXHAUSTED: out of memory"))
+        assert not is_oom(RuntimeError("INVALID_ARGUMENT"))
+
+    def test_retry_recovers_and_counts(self):
+        reliability_counters.reset()
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionResetError("blip")
+            return "ok"
+
+        policy = RetryPolicy(max_attempts=4, base_delay=0.0, seed=0)
+        assert policy.call(flaky, site="t", counter="io_retries") == "ok"
+        assert calls["n"] == 3
+        assert reliability_counters.get("io_retries") == 2
+
+    def test_retry_gives_up_after_cap_and_skips_nontransient(self):
+        policy = RetryPolicy(max_attempts=3, base_delay=0.0, seed=0)
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise TimeoutError("never heals")
+
+        with pytest.raises(TimeoutError):
+            policy.call(always, site="t")
+        assert calls["n"] == 3
+        calls["n"] = 0
+
+        def broken():
+            calls["n"] += 1
+            raise ValueError("not transient")
+
+        with pytest.raises(ValueError):
+            policy.call(broken, site="t")
+        assert calls["n"] == 1
+
+    def test_backoff_jittered_capped_and_seeded(self):
+        p1 = RetryPolicy(max_attempts=8, base_delay=0.01, max_delay=0.04, seed=5)
+        p2 = RetryPolicy(max_attempts=8, base_delay=0.01, max_delay=0.04, seed=5)
+        d1 = [p1.delay(i) for i in range(8)]
+        assert d1 == [p2.delay(i) for i in range(8)]
+        for i, d in enumerate(d1):
+            assert 0.0 <= d <= min(0.04, 0.01 * 2**i)
+
+
+# ---------------------------------------------------------------------------
+# Chaos equivalence on the streaming solve
+# ---------------------------------------------------------------------------
+
+
+class TestChaosEquivalence:
+    def test_enabled_but_silent_is_bit_identical(self, faults):
+        from keystone_tpu.linalg import solve_least_squares_chunked
+
+        _, _, it = _stream()
+        faults("")
+        ref = np.asarray(solve_least_squares_chunked(it(), lam=0.1))
+        plan = faults("io:0.0,oom:0")
+        assert plan is not None  # armed...
+        out = np.asarray(solve_least_squares_chunked(it(), lam=0.1))
+        assert plan.fired == {}  # ...but silent
+        np.testing.assert_array_equal(ref, out)
+
+    def test_injected_faults_recover_bit_identically(self, faults):
+        """The acceptance gate: io+oom+producer_death armed, fixed seed —
+        the solve completes, recoveries fire, and the solution matches the
+        fault-free run bit-for-bit."""
+        from keystone_tpu.linalg import solve_least_squares_chunked
+
+        _, _, it = _stream()
+        faults("")
+        ref = np.asarray(solve_least_squares_chunked(it(), lam=0.1))
+        faults("io:0.2,oom:1,producer_death:1", seed=0)
+        out = np.asarray(solve_least_squares_chunked(it(), lam=0.1))
+        np.testing.assert_array_equal(ref, out)
+        snap = reliability_counters.snapshot()
+        assert snap.get("faults_injected_oom") == 1
+        assert snap.get("faults_injected_producer_death") == 1
+        assert snap.get("h2d_retries", 0) >= 1
+        assert snap.get("producer_restarts") == 1
+        # io:0.2 over ~8 record boundaries fires with seed 0; every fire
+        # was retried invisibly.
+        if snap.get("faults_injected_io", 0):
+            assert snap.get("io_retries", 0) >= snap["faults_injected_io"]
+
+    def test_sync_path_oom_downshift_still_solves(self, faults):
+        """OOM that survives the whole retry budget halves the chunk: not
+        bit-identical (different flop grouping) but the same least-squares
+        problem — and the downshift is recorded."""
+        from keystone_tpu.linalg import solve_least_squares_chunked
+
+        A, B, it = _stream(n=256, batch_rows=128)
+        faults("")
+        ref = np.asarray(solve_least_squares_chunked(it(), lam=0.1))
+        # More oom firings than retry attempts: the first chunk's retries
+        # all fail, forcing a split (then its halves succeed).
+        faults(f"oom:{config.retry_attempts}")
+        out = np.asarray(
+            solve_least_squares_chunked(it(), lam=0.1, prefetch_depth=0)
+        )
+        assert reliability_counters.get("oom_downshifts") >= 1
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+class TestCheckpointResume:
+    def test_kill_and_resume_bit_identical(self, faults, tmp_path):
+        from keystone_tpu.linalg import solve_least_squares_chunked
+
+        _, _, it = _stream()  # 8 chunks of 64 rows
+        ref = np.asarray(solve_least_squares_chunked(it(), lam=0.1))
+
+        class Kill(Exception):
+            pass
+
+        def killed_stream(at):
+            for i, batch in enumerate(it()):
+                if i == at:
+                    raise Kill()
+                yield batch
+
+        ckpt = str(tmp_path / "ckpt")
+        with pytest.raises(Kill):
+            solve_least_squares_chunked(
+                killed_stream(6), lam=0.1,
+                checkpoint_dir=ckpt, checkpoint_every=2,
+            )
+        assert reliability_counters.get("checkpoints_written") == 3  # 2,4,6
+        out = np.asarray(
+            solve_least_squares_chunked(
+                it(), lam=0.1, checkpoint_dir=ckpt, checkpoint_every=2
+            )
+        )
+        np.testing.assert_array_equal(ref, out)
+        # Resumed at the chunk-6 snapshot: recomputed 8-6=2 <= K chunks.
+        assert reliability_counters.get("checkpoints_resumed") == 1
+        assert reliability_counters.get("chunks_skipped_on_resume") == 6
+
+    def test_resume_under_chaos_matches_clean_run(self, faults, tmp_path):
+        from keystone_tpu.linalg import solve_least_squares_chunked
+
+        _, _, it = _stream()
+        ref = np.asarray(solve_least_squares_chunked(it(), lam=0.1))
+        ckpt = str(tmp_path / "ckpt")
+        # Seed a mid-stream checkpoint, then resume WITH faults armed.
+        class Kill(Exception):
+            pass
+
+        def killed_stream():
+            for i, batch in enumerate(it()):
+                if i == 5:
+                    raise Kill()
+                yield batch
+
+        with pytest.raises(Kill):
+            solve_least_squares_chunked(
+                killed_stream(), lam=0.1,
+                checkpoint_dir=ckpt, checkpoint_every=4,
+            )
+        faults("io:0.2,oom:1", seed=1)
+        out = np.asarray(
+            solve_least_squares_chunked(
+                it(), lam=0.1, checkpoint_dir=ckpt, checkpoint_every=4
+            )
+        )
+        np.testing.assert_array_equal(ref, out)
+
+    def test_completed_solve_consumes_its_checkpoint(self, faults, tmp_path):
+        """A snapshot is mid-flight state: the successful solve deletes it,
+        so a later solve over CHANGED data whose first-chunk probe happens
+        to match can never silently resume stale accumulators."""
+        from keystone_tpu.linalg import solve_least_squares_chunked
+        from keystone_tpu.linalg.normal_equations import (
+            _STREAM_CKPT_KEY,
+            _stream_ckpt_store,
+        )
+
+        ckpt = str(tmp_path / "ckpt")
+        _, _, it = _stream()
+        solve_least_squares_chunked(
+            it(), lam=0.1, checkpoint_dir=ckpt, checkpoint_every=2
+        )
+        assert _stream_ckpt_store(ckpt).get(_STREAM_CKPT_KEY) is None
+
+    def test_mismatched_fingerprint_starts_fresh(self, faults, tmp_path):
+        from keystone_tpu.linalg import solve_least_squares_chunked
+
+        ckpt = str(tmp_path / "ckpt")
+        _, _, it = _stream(rng_seed=0)
+
+        class Kill(Exception):
+            pass
+
+        def killed():
+            for i, batch in enumerate(it()):
+                if i == 6:
+                    raise Kill()
+                yield batch
+
+        # A mid-flight snapshot from one problem...
+        with pytest.raises(Kill):
+            solve_least_squares_chunked(
+                killed(), lam=0.1, checkpoint_dir=ckpt, checkpoint_every=2
+            )
+        # ...must not be resumed by a DIFFERENT problem in the same dir.
+        _, _, other = _stream(rng_seed=9)
+        out = np.asarray(
+            solve_least_squares_chunked(
+                other(), lam=0.1, checkpoint_dir=ckpt, checkpoint_every=2
+            )
+        )
+        clean = np.asarray(solve_least_squares_chunked(other(), lam=0.1))
+        np.testing.assert_array_equal(out, clean)
+        assert reliability_counters.get("checkpoints_resumed") == 0
+
+    def test_streamed_bcd_block_checkpoint_resumes_mid_epoch(
+        self, faults, tmp_path
+    ):
+        from keystone_tpu.linalg import RowMatrix
+        from keystone_tpu.linalg.bcd import (
+            _BCD_CKPT_KEY,
+            _bcd_ckpt_store,
+            assemble_blocks,
+            block_coordinate_descent_streamed,
+        )
+
+        rng = np.random.default_rng(0)
+        A = rng.normal(size=(200, 32)).astype(np.float32)
+        B = (A @ rng.normal(size=(32, 4)).astype(np.float32))
+        ref, _ = block_coordinate_descent_streamed(
+            A, RowMatrix.from_array(B), 8, 2, lam=0.1
+        )
+        ref = np.asarray(assemble_blocks(ref))
+
+        class Kill(Exception):
+            pass
+
+        class KillingMatrix(np.ndarray):
+            """A_host whose block slicing dies partway through epoch 1 —
+            the mid-fit kill, upstream of the device."""
+
+            reads = 0
+
+            def __getitem__(self, idx):
+                if (
+                    isinstance(idx, tuple)
+                    and len(idx) == 2
+                    and isinstance(idx[1], slice)
+                ):
+                    type(self).reads += 1
+                    if type(self).reads > 6:  # nb=4: dies at epoch 1 block 2
+                        raise Kill()
+                return super().__getitem__(idx)
+
+        ckpt = str(tmp_path / "bcd")
+        A_killing = A.view(KillingMatrix)
+        with pytest.raises(Kill):
+            block_coordinate_descent_streamed(
+                A_killing, RowMatrix.from_array(B), 8, 2, lam=0.1,
+                checkpoint_dir=ckpt, checkpoint_every=3,
+            )
+        # A mid-epoch block snapshot (blocks_done 3, or 6 if the consumer
+        # caught the prefetcher) outlived the kill; resume restores
+        # W/R/invs there and recomputes only the remaining block updates,
+        # bit-identically.
+        reliability_counters.reset()
+        resumed, _ = block_coordinate_descent_streamed(
+            A, RowMatrix.from_array(B), 8, 2, lam=0.1,
+            checkpoint_dir=ckpt, checkpoint_every=3,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(assemble_blocks(resumed)), ref
+        )
+        assert reliability_counters.get("checkpoints_resumed") == 1
+        # ...and the successful solve consumed its block snapshot.
+        assert _bcd_ckpt_store(ckpt).get(_BCD_CKPT_KEY) is None
+
+
+# ---------------------------------------------------------------------------
+# Prefetch producer recovery
+# ---------------------------------------------------------------------------
+
+
+class TestPrefetchRecovery:
+    def test_quarantine_skips_corrupt_records(self, faults):
+        from keystone_tpu.loaders.stream import PrefetchIterator
+
+        faults("corrupt:2")
+        out = list(PrefetchIterator(iter(range(10)), depth=2))
+        # Two records quarantined deterministically from the stream head.
+        assert out == list(range(2, 10))
+        assert reliability_counters.get("records_quarantined") == 2
+
+    def test_corrupt_from_durable_source_is_quarantined(self, faults):
+        from keystone_tpu.loaders.stream import PrefetchIterator
+
+        class Flaky:
+            """Iterator (not a generator) that survives its own raises."""
+
+            def __init__(self):
+                self.i = 0
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                self.i += 1
+                if self.i == 3:
+                    raise RecordCorruptError("bad bytes at record 3")
+                if self.i > 6:
+                    raise StopIteration
+                return self.i
+
+        out = list(PrefetchIterator(Flaky(), depth=2))
+        assert out == [1, 2, 4, 5, 6]
+        assert reliability_counters.get("records_quarantined") == 1
+
+    def test_transient_read_errors_retried_from_durable_source(self, faults):
+        from keystone_tpu.loaders.stream import PrefetchIterator
+
+        class Blippy:
+            def __init__(self):
+                self.i = 0
+                self.blipped = False
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                if self.i == 2 and not self.blipped:
+                    self.blipped = True
+                    raise ConnectionResetError("nfs blip")
+                self.i += 1
+                if self.i > 5:
+                    raise StopIteration
+                return self.i
+
+        out = list(PrefetchIterator(Blippy(), depth=2))
+        assert out == [1, 2, 3, 4, 5]
+        assert reliability_counters.get("io_retries") == 1
+
+    def test_producer_death_detected_and_restarted(self, faults):
+        from keystone_tpu.loaders.stream import PrefetchIterator
+
+        faults("producer_death:2")
+        out = list(PrefetchIterator(iter(range(12)), depth=2))
+        assert out == list(range(12))  # nothing lost, order kept
+        assert reliability_counters.get("producer_restarts") == 2
+
+    def test_restart_cap_gives_up(self, faults, monkeypatch):
+        from keystone_tpu.loaders.stream import PrefetchIterator
+
+        monkeypatch.setattr(PrefetchIterator, "_MAX_RESTARTS", 2)
+        faults("producer_death:50")
+        it = PrefetchIterator(iter(range(100)), depth=2)
+        with pytest.raises(RuntimeError, match="died"):
+            list(it)
+        it.close()
+
+    def test_close_while_blocked_on_full_queue(self):
+        """Regression (ISSUE 3 satellite): a producer parked on a FULL
+        queue must join promptly at close — and no leak warning fires."""
+        from keystone_tpu.loaders.stream import PrefetchIterator
+
+        reliability_counters.reset()
+        it = PrefetchIterator(iter(range(10_000)), depth=1)
+        deadline = time.monotonic() + 5
+        while it._queue.qsize() < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)  # producer now blocked on the full queue
+        t0 = time.monotonic()
+        it.close()
+        assert time.monotonic() - t0 < 2.0
+        assert not it._thread.is_alive()
+        assert reliability_counters.get("producer_leaks") == 0
+
+    def test_leaked_producer_warns_once_with_thread_name(
+        self, monkeypatch, caplog
+    ):
+        from keystone_tpu.loaders.stream import PrefetchIterator
+
+        reliability_counters.reset()
+        release = threading.Event()
+
+        class Stuck:
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                release.wait()  # upstream I/O that honors no deadline
+                raise StopIteration
+
+        monkeypatch.setattr(PrefetchIterator, "_JOIN_TIMEOUT_S", 0.05)
+        it = PrefetchIterator(Stuck(), depth=1)
+        with caplog.at_level("WARNING", logger="keystone_tpu"):
+            it.close()
+            it.close()  # idempotent: still exactly one warning
+        warnings = [
+            r for r in caplog.records if "still alive" in r.getMessage()
+        ]
+        assert len(warnings) == 1
+        assert "keystone-prefetch" in warnings[0].getMessage()
+        assert reliability_counters.get("producer_leaks") == 1
+        release.set()
+
+
+# ---------------------------------------------------------------------------
+# Serving under overload and failure
+# ---------------------------------------------------------------------------
+
+
+def _service(delay_s: float = 0.0, **kwargs):
+    """A warmed single-op service whose device call can be slowed to pin
+    the worker, exposing queue/deadline behavior deterministically."""
+    from keystone_tpu.workflow.pipeline import Transformer
+    from keystone_tpu.workflow.serving import CompiledPipeline, PipelineService
+
+    class Double(Transformer):
+        def apply_batch(self, X):
+            return X * 2.0
+
+    cp = CompiledPipeline(Double(), buckets=(8, 32)).warmup((4,))
+
+    class Slowed:
+        def __init__(self, inner, delay):
+            self._inner, self._delay = inner, delay
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def __call__(self, X):
+            if self._delay:
+                time.sleep(self._delay)
+            return self._inner(X)
+
+    return PipelineService(Slowed(cp, delay_s), max_delay_ms=1.0, **kwargs)
+
+
+class TestServingHardening:
+    def test_queue_full_fast_fails(self, faults):
+        svc = _service(delay_s=0.15, max_pending=2)
+        try:
+            x = np.ones(4, dtype=np.float32)
+            first = svc.submit(x)  # worker picks this up and sleeps
+            time.sleep(0.05)
+            held = [svc.submit(x) for _ in range(2)]  # fills the queue
+            with pytest.raises(QueueFullError):
+                svc.submit(x)
+            assert svc.rejected == 1
+            assert reliability_counters.get("requests_rejected") == 1
+            np.testing.assert_array_equal(first.result(timeout=5), x * 2.0)
+            for f in held:
+                f.result(timeout=5)  # accepted work still completes
+        finally:
+            svc.close()
+
+    def test_deadline_expires_before_device_call(self, faults):
+        svc = _service(delay_s=0.2, max_pending=16)
+        try:
+            x = np.ones(4, dtype=np.float32)
+            first = svc.submit(x)  # occupies the worker for 200ms
+            time.sleep(0.02)
+            doomed = svc.submit(x, deadline_ms=30.0)
+            ok = svc.submit(x)  # no deadline: waits its turn
+            with pytest.raises(DeadlineExceeded):
+                doomed.result(timeout=5)
+            np.testing.assert_array_equal(first.result(timeout=5), x * 2.0)
+            np.testing.assert_array_equal(ok.result(timeout=5), x * 2.0)
+            assert svc.expired == 1
+            assert reliability_counters.get("deadline_expired") == 1
+        finally:
+            svc.close()
+
+    def test_close_rejects_pending_instead_of_hanging(self, faults):
+        svc = _service(delay_s=0.15, max_pending=16)
+        x = np.ones(4, dtype=np.float32)
+        first = svc.submit(x)
+        time.sleep(0.05)
+        queued = [svc.submit(x) for _ in range(4)]
+        svc.close(drain=False)
+        for f in queued:
+            with pytest.raises(ServiceClosed):
+                f.result(timeout=5)
+        assert first.done()  # in-flight: served or failed, never stranded
+        assert reliability_counters.get("futures_failed_on_close") == 4
+        with pytest.raises(ServiceClosed):
+            svc.submit(x)
+
+    def test_draining_close_serves_everything(self, faults):
+        svc = _service(delay_s=0.02, max_pending=64)
+        x = np.ones(4, dtype=np.float32)
+        futs = [svc.submit(x) for _ in range(8)]
+        svc.close()  # default drain=True
+        for f in futs:
+            np.testing.assert_array_equal(f.result(timeout=5), x * 2.0)
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+    )
+    def test_worker_death_detected_and_restarted(self, faults):
+        faults("worker_death:1")
+        svc = _service(max_pending=16)
+        try:
+            x = np.ones(4, dtype=np.float32)
+            first = svc.submit(x)  # wakes the worker into the injected death
+            deadline = time.monotonic() + 5
+            while svc._worker.is_alive() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert not svc._worker.is_alive()
+            second = svc.submit(x)  # detects the corpse, restarts
+            assert svc.worker_restarts == 1
+            assert reliability_counters.get("worker_restarts") == 1
+            # Both requests still complete: pending survived the death.
+            np.testing.assert_array_equal(first.result(timeout=5), x * 2.0)
+            np.testing.assert_array_equal(second.result(timeout=5), x * 2.0)
+        finally:
+            svc.close()
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+    )
+    def test_close_after_worker_death_strands_nothing(self, faults):
+        faults("worker_death:1")
+        svc = _service(max_pending=16)
+        x = np.ones(4, dtype=np.float32)
+        fut = svc.submit(x)
+        deadline = time.monotonic() + 5
+        while svc._worker.is_alive() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        svc.close()  # worker already dead: close must fail the future
+        assert fut.done()
+        with pytest.raises(ServiceClosed):
+            fut.result(timeout=1)
+
+    def test_sustained_overload_bounded_not_cliff(self, faults):
+        """2x-capacity style hammering: excess fast-fails, accepted work
+        completes, and EVERY future resolves one way or the other."""
+        svc = _service(delay_s=0.01, max_pending=4)
+        x = np.ones(4, dtype=np.float32)
+        outcomes = {"ok": 0, "rejected": 0, "expired": 0}
+        futs = []
+        lock = threading.Lock()
+
+        def client(n):
+            for _ in range(n):
+                try:
+                    f = svc.submit(x, deadline_ms=250.0)
+                    with lock:
+                        futs.append(f)
+                except QueueFullError:
+                    with lock:
+                        outcomes["rejected"] += 1
+        threads = [
+            threading.Thread(target=client, args=(25,)) for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        svc.close()
+        for f in futs:
+            assert f.done()  # the no-stranded-future invariant
+            try:
+                f.result(timeout=0)
+                outcomes["ok"] += 1
+            except DeadlineExceeded:
+                outcomes["expired"] += 1
+            except ServiceClosed:
+                pass
+        assert outcomes["ok"] >= 1
+        assert outcomes["rejected"] >= 1  # backpressure actually engaged
+        assert outcomes["ok"] + outcomes["expired"] + outcomes[
+            "rejected"
+        ] <= 100

@@ -20,7 +20,7 @@ from keystone_tpu.nodes.stats.hellinger import SignedHellingerMapper
 from keystone_tpu.nodes.stats.normalizer import L2Normalizer
 from keystone_tpu.nodes.stats.random_features import CosineRandomFeatures
 from keystone_tpu.nodes.stats.scalers import StandardScaler, StandardScalerModel
-from keystone_tpu.utils.metrics import serving_counters
+from keystone_tpu.utils.metrics import CompileEventCounter, serving_counters
 from keystone_tpu.workflow import (
     CompiledPipeline,
     PipelineService,
@@ -45,21 +45,9 @@ def serve_config():
     serving_counters.reset()
 
 
-class _CompileEvents:
-    """Counts XLA backend compiles via jax.monitoring."""
-
-    EVENT = "/jax/compilation_cache/compile_requests_use_cache"
-
-    def __init__(self):
-        self.count = 0
-        jax.monitoring.register_event_listener(self._on)
-
-    def _on(self, name, **kw):
-        if name == self.EVENT:
-            self.count += 1
-
-
-_compile_events = _CompileEvents()
+# The compile oracle shared with tools/bench_serve.py — one listener per
+# process (registration is global and permanent).
+_compile_events = CompileEventCounter()
 
 
 def _head(d=8, D=16, k=3, seed=0):
