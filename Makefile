@@ -1,6 +1,6 @@
 # Developer entry points. Pipelines launch via bin/run-pipeline.sh.
 
-.PHONY: test t1 chaos native bench bench-serve bench-serve-overload dryrun clean tpu-checkride sentinel northstar acceptance
+.PHONY: test t1 chaos native bench bench-serve bench-serve-overload trace-demo dryrun clean tpu-checkride sentinel northstar acceptance
 
 # The canonical tier-1 verify (ROADMAP.md), verbatim at the defaults —
 # builders and CI invoke this one entry point instead of hand-copying the
@@ -61,6 +61,15 @@ bench-serve:
 # be bounded (rejections, not a latency cliff) and no future stranded.
 bench-serve-overload:
 	python tools/bench_serve.py --overload
+
+# Observability smoke: a small fit + streamed solve + serve under
+# KEYSTONE_TRACE=1, Chrome-trace exported to /tmp/keystone_trace.json,
+# schema-validated, and checked for full span coverage (executor nodes,
+# solver chunks, prefetch residency, serving lifecycle). Tier-1 runs the
+# same demo in-process via tests/test_observability.py.
+trace-demo:
+	KEYSTONE_TRACE=1 JAX_PLATFORMS=cpu python tools/trace_demo.py --out /tmp/keystone_trace.json
+	JAX_PLATFORMS=cpu python tools/trace_report.py /tmp/keystone_trace.json --top 12
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -196,7 +196,7 @@ def worker(scale_key: str, dtype: str) -> None:
     backend = jax.default_backend()
     # HBM high-water (TPU runtimes report it; CPU returns None) — the
     # donation/aliasing evidence channel (SURVEY.md §5 sanitizer row).
-    from keystone_tpu.utils.metrics import peak_hbm_bytes
+    from keystone_tpu.utils.metrics import environment_fingerprint, peak_hbm_bytes
     tflops_per_chip = bcd_flops(n, d, k, block, iters) / dt / 1e12 / n_dev
     peak = PLAUSIBLE_PEAK_TFLOPS[dtype]
     line = {
@@ -205,6 +205,7 @@ def worker(scale_key: str, dtype: str) -> None:
         "unit": "TFLOPS/chip",
         "vs_baseline": round(tflops_per_chip / BASELINE_NODE_TFLOPS, 2),
         "backend": backend,
+        "env": environment_fingerprint(),
         "detail": {
             "n": n,
             "d": d,

@@ -261,6 +261,20 @@ class Config:
     fuse_chains: bool = field(
         default_factory=lambda: not env_flag("KEYSTONE_NO_FUSE")
     )
+    # Process-wide span tracing (utils/metrics.py Tracer): executor nodes,
+    # solver chunks, prefetch queue residency, and serving request
+    # lifecycle record into a bounded ring buffer, exportable as
+    # Chrome-trace JSON (Perfetto-viewable; tools/trace_report.py). Off by
+    # default: call sites resolve ``active_tracer()`` ONCE per
+    # stream/solve/service — like ``active_plan()`` — so the disabled
+    # tracer is a None check, never a per-record cost. Env: KEYSTONE_TRACE.
+    trace: bool = field(default_factory=lambda: env_flag("KEYSTONE_TRACE"))
+    # Span ring-buffer capacity: the tracer keeps the most recent N spans,
+    # so a long-running traced process holds bounded memory instead of an
+    # unbounded event log. Env: KEYSTONE_TRACE_BUFFER.
+    trace_buffer: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_TRACE_BUFFER", 65536)
+    )
 
 
 config = Config()

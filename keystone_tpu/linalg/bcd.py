@@ -860,9 +860,11 @@ def block_coordinate_descent_streamed(
     ridge inverses computed so far, and the block cursor — a killed fit
     resumes recomputing at most K block updates.
     """
+    from keystone_tpu.utils.metrics import active_tracer
     from keystone_tpu.utils.reliability import RetryPolicy, active_plan
     from keystone_tpu.utils.sparse import SparseBatch
 
+    tracer = active_tracer()  # resolved once per solve, like the plan
     sparse = isinstance(A_host, SparseBatch)
     if sparse and col_center is not None:
         raise ValueError(
@@ -910,12 +912,7 @@ def block_coordinate_descent_streamed(
     plan = active_plan()
     retry = RetryPolicy()
 
-    def put_host(block: np.ndarray) -> jax.Array:
-        """H2D one prepared block, retrying transient RESOURCE_EXHAUSTED
-        (real or the harness's ``oom`` site). Unlike the row-chunked
-        solver there is no downshift — halving a column block would
-        change the solve — so a persistent OOM propagates, annotated."""
-
+    def _transfer(block: np.ndarray) -> jax.Array:
         def attempt():
             if plan is not None:
                 plan.maybe_raise("oom")
@@ -933,6 +930,22 @@ def block_coordinate_descent_streamed(
                     "after retries; reduce block_size]"
                 ) from exc
             raise
+
+    def put_host(block: np.ndarray) -> jax.Array:
+        """H2D one prepared block, retrying transient RESOURCE_EXHAUSTED
+        (real or the harness's ``oom`` site). Unlike the row-chunked
+        solver there is no downshift — halving a column block would
+        change the solve — so a persistent OOM propagates, annotated.
+        Spanned per block when tracing is live."""
+        if tracer is None:
+            return _transfer(block)
+        t0 = tracer.now()
+        out = _transfer(block)
+        tracer.record(
+            "bcd.h2d", "solver", t0,
+            shape=[int(block.shape[0]), int(block.shape[1])],
+        )
+        return out
 
     def put(i: int) -> jax.Array:
         return put_host(host_block(i))
@@ -1047,12 +1060,22 @@ def block_coordinate_descent_streamed(
                     # (double buffering): H2D DMA overlaps the MXU work.
                     if consumed < total:
                         next_buf = put_ahead((i + 1) % nb)
+                was_cached = invs[i] is not None
+                t0 = tracer.now() if tracer is not None else 0
                 if invs[i] is None:
                     R, W[i], invs[i] = first(cur, R, W[i], lam_arr, w_rows)
                 else:
                     R, W[i] = cached(cur, invs[i], R, W[i], w_rows)
                 if throttle:
                     R.block_until_ready()
+                if tracer is not None:
+                    # Dispatch time unless throttled (the block above makes
+                    # the CPU path synchronous anyway).
+                    tracer.record(
+                        "bcd.block_update", "solver", t0, epoch=epoch,
+                        block=i, cached_inverse=was_cached,
+                        async_dispatch=not throttle,
+                    )
                 blocks_done += 1
                 if ckpt_store is not None and blocks_done % every == 0:
                     _bcd_ckpt_save(

@@ -144,6 +144,32 @@ def _put_chunks_resilient(chunk, plan, retry):
         )
 
 
+def _chol_solve_maybe_traced(tracer, gram, atb, lam, refine_steps):
+    """The final replicated Cholesky, spanned when tracing is live."""
+    lam_arr = jnp.asarray(lam, dtype=gram.dtype)
+    if tracer is None:
+        return _chol_solve(gram, atb, lam_arr, refine_steps)
+    t0 = tracer.now()
+    out = _chol_solve(gram, atb, lam_arr, refine_steps)
+    tracer.record("solve.cholesky", "solver", t0, d=int(gram.shape[0]))
+    return out
+
+
+def _put_chunks_traced(chunk, plan, retry, tracer, idx: int):
+    """``_put_chunks_resilient`` wrapped in a per-chunk H2D span (chunk
+    index, rows, and how many OOM-downshift splits it took). The untraced
+    path calls ``_put_chunks_resilient`` directly — zero added work."""
+    import numpy as np
+
+    t0 = tracer.now()
+    out = _put_chunks_resilient(chunk, plan, retry)
+    tracer.record(
+        "solve.h2d", "solver", t0, chunk=idx,
+        rows=int(np.asarray(chunk[0]).shape[0]), splits=len(out),
+    )
+    return out
+
+
 _STREAM_CKPT_KEY = "stream_solve"
 
 
@@ -333,8 +359,11 @@ def solve_least_squares_chunked(
             batches, lam, refine_steps, checkpoint_dir, checkpoint_every
         )
 
+    from keystone_tpu.utils.metrics import active_tracer
+
     plan = active_plan()
     retry = RetryPolicy()
+    tracer = active_tracer()  # resolved once per solve, like the plan
     ckpt = _StreamCheckpointer(checkpoint_dir, checkpoint_every)
 
     # Respect an upstream-constructed prefetcher (the bench hands one in to
@@ -363,10 +392,13 @@ def solve_least_squares_chunked(
             if gram is None:
                 raise ValueError("empty batch stream")
             ckpt.consume()
-            return _chol_solve(
-                gram, atb, jnp.asarray(lam, dtype=gram.dtype), refine_steps
+            return _chol_solve_maybe_traced(
+                tracer, gram, atb, lam, refine_steps
             )
-        cur = _put_chunks_resilient(cur_host, plan, retry)
+        if tracer is None:
+            cur = _put_chunks_resilient(cur_host, plan, retry)
+        else:
+            cur = _put_chunks_traced(cur_host, plan, retry, tracer, ckpt.done)
         mesh = cur[0][0].mesh
         accum = _accum_gram_atb_fn(mesh, config.data_axis, _precision())
         d = cur[0][0].data.shape[1]
@@ -389,15 +421,30 @@ def solve_least_squares_chunked(
             # producer thread parses/featurizes ahead) and stages the next
             # chunk's transfer. An OOM-downshifted chunk accumulates its
             # halves in row order.
-            for A, B in cur:
-                gram, atb = accum(gram, atb, A.data, B.data)
+            if tracer is None:
+                for A, B in cur:
+                    gram, atb = accum(gram, atb, A.data, B.data)
+            else:
+                t0 = tracer.now()
+                for A, B in cur:
+                    gram, atb = accum(gram, atb, A.data, B.data)
+                # The span measures DISPATCH, not device completion — the
+                # gemms drain asynchronously (flagged so the trace reads
+                # honestly next to the blocking H2D spans).
+                tracer.record(
+                    "solve.accum", "solver", t0,
+                    chunk=ckpt.done, async_dispatch=True,
+                )
             ckpt.chunk_done(gram, atb)
             nxt = next(it, None)
-            cur = None if nxt is None else _put_chunks_resilient(nxt, plan, retry)
+            if nxt is None:
+                cur = None
+            elif tracer is None:
+                cur = _put_chunks_resilient(nxt, plan, retry)
+            else:
+                cur = _put_chunks_traced(nxt, plan, retry, tracer, ckpt.done)
     ckpt.consume()
-    return _chol_solve(
-        gram, atb, jnp.asarray(lam, dtype=gram.dtype), refine_steps
-    )
+    return _chol_solve_maybe_traced(tracer, gram, atb, lam, refine_steps)
 
 
 def _solve_chunked_sync(
@@ -416,11 +463,13 @@ def _solve_chunked_sync(
     what overlap (including plain async dispatch) buys. Never the right
     setting for real runs."""
     from keystone_tpu.config import env_flag
+    from keystone_tpu.utils.metrics import active_tracer
     from keystone_tpu.utils.reliability import RetryPolicy, active_plan
 
     serialize = env_flag("KEYSTONE_STREAM_NO_OVERLAP")
     plan = active_plan()
     retry = RetryPolicy()
+    tracer = active_tracer()
     ckpt = _StreamCheckpointer(checkpoint_dir, checkpoint_every)
     bound = False
     gram = None
@@ -435,16 +484,24 @@ def _solve_chunked_sync(
                 gram, atb = ckpt.restored(jnp.dtype(config.accum_dtype))
         if ckpt.skipping():
             continue
-        for A, B in _put_chunks_resilient(chunk, plan, retry):
+        if tracer is None:
+            pairs = _put_chunks_resilient(chunk, plan, retry)
+        else:
+            pairs = _put_chunks_traced(chunk, plan, retry, tracer, ckpt.done)
+            t0 = tracer.now()
+        for A, B in pairs:
             g, ab = A.gram_and_atb(B)  # fused: one read of the chunk
             if serialize:
                 jax.block_until_ready((g, ab))
             gram = g if gram is None else gram + g
             atb = ab if atb is None else atb + ab
+        if tracer is not None:
+            tracer.record(
+                "solve.accum", "solver", t0,
+                chunk=ckpt.done, async_dispatch=not serialize,
+            )
         ckpt.chunk_done(gram, atb)
     if gram is None:
         raise ValueError("empty batch stream")
     ckpt.consume()
-    return _chol_solve(
-        gram, atb, jnp.asarray(lam, dtype=gram.dtype), refine_steps
-    )
+    return _chol_solve_maybe_traced(tracer, gram, atb, lam, refine_steps)

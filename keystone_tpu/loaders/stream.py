@@ -105,6 +105,15 @@ class PrefetchIterator:
         # thread) so a replacement producer can resume it after a death.
         self._it: Iterator = iter(source)
         self._plan = active_plan()  # resolved ONCE: None = zero overhead
+        from keystone_tpu.utils.metrics import active_tracer, metrics_registry
+
+        # Same discipline as the fault plan: the tracer is resolved once
+        # per stream, so the untraced producer/consumer pay a None check.
+        self._tracer = active_tracer()
+        # Process-level gauge: concurrent streams share it (last writer
+        # wins on value; max is the high-water across all of them).
+        self._depth_gauge = metrics_registry.gauge("prefetch.queue_depth")
+        self._produced = 0
         self._retry = retry_policy if retry_policy is not None else RetryPolicy()
         self._restarts = 0
         self._quarantined = 0
@@ -121,7 +130,11 @@ class PrefetchIterator:
     # -- producer thread ---------------------------------------------------
 
     def _put(self, msg) -> bool:
-        """Blocking put that stays responsive to close(); False = closed."""
+        """Blocking put that stays responsive to close(); False = closed.
+        When tracing, the message carries its enqueue timestamp so the
+        consumer can record the cross-thread queue-residency span."""
+        if self._tracer is not None:
+            msg = msg + (self._tracer.now(),)
         while not self._stop.is_set():
             try:
                 self._queue.put(msg, timeout=0.05)
@@ -146,12 +159,14 @@ class PrefetchIterator:
         # ``next()`` failures; harness faults fire at the post-fetch gate
         # and are recoverable for every source.
         durable_src = not isinstance(it, types.GeneratorType)
+        tr = self._tracer
         try:
             while not self._stop.is_set():
                 if plan is not None and plan.check("producer_death"):
                     # Exit with NO sentinel — exactly what a killed thread
                     # leaves behind; the consumer's liveness poll recovers.
                     return
+                t0 = tr.now() if tr is not None else 0
                 try:
                     if durable_src:
                         item = retry.call(
@@ -173,11 +188,17 @@ class PrefetchIterator:
                 except RecordCorruptError as exc:
                     self._quarantine(exc)
                     continue
+                if tr is not None:
+                    tr.record(
+                        "prefetch.produce", "stream", t0, batch=self._produced
+                    )
+                self._produced += 1
                 if not self._put((self._ITEM, item)):
                     return
                 depth_now = self._queue.qsize()
                 if depth_now > self.max_queued:
                     self.max_queued = depth_now
+                self._depth_gauge.set(depth_now)
         except BaseException as exc:  # surfaced in the consumer
             self._put((self._ERROR, exc))
         else:
@@ -211,9 +232,11 @@ class PrefetchIterator:
     def __next__(self) -> Any:
         if self._exhausted:
             raise StopIteration
+        tr = self._tracer
+        t_wait = tr.now() if tr is not None else 0
         while True:
             try:
-                kind, val = self._queue.get(timeout=self._POLL_S)
+                msg = self._queue.get(timeout=self._POLL_S)
                 break
             except queue.Empty:
                 if self._stop.is_set() or self._thread.is_alive():
@@ -221,6 +244,15 @@ class PrefetchIterator:
                 if not self._queue.empty():
                     continue  # died after a final put: drain it first
                 self._restart_producer()
+        kind, val = msg[0], msg[1]
+        if tr is not None and kind == self._ITEM:
+            now = tr.now()
+            # How long the consumer stood starved at the queue...
+            tr.record("prefetch.consumer_wait", "stream", t_wait, now)
+            # ...and how long the batch sat queued (cross-thread span:
+            # producer enqueue timestamp → this dequeue).
+            if len(msg) > 2:
+                tr.record("prefetch.queue_residency", "stream", msg[2], now)
         if self._stop.is_set():
             # close() ran while we waited: whatever we were handed (a
             # stale item the producer's in-flight put landed after the
@@ -231,6 +263,7 @@ class PrefetchIterator:
         if kind == self._ITEM:
             return val
         self._exhausted = True
+        self._depth_gauge.set(0)  # the stream is over; depth reads current
         self._join_producer()
         if kind == self._ERROR:
             raise val
@@ -261,6 +294,7 @@ class PrefetchIterator:
         queue holding file handles."""
         self._exhausted = True
         self._stop.set()
+        self._depth_gauge.set(0)
         try:
             while True:
                 self._queue.get_nowait()

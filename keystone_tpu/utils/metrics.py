@@ -1,18 +1,40 @@
-"""Observability: stage timers and XLA cost introspection.
+"""Observability: tracing, latency histograms, the unified metrics
+registry, stage timers, and XLA cost introspection.
 
 Ref: the reference's `Logging` trait with per-stage wall times in pipeline
-mains + Spark metrics (SURVEY.md §5 metrics row) [unverified]. Here:
-structured stage timing plus FLOP/byte counts straight from the compiled
-HLO (`cost_analysis`), which is what per-chip TFLOPS reporting uses.
+mains + Spark metrics (SURVEY.md §5 metrics row) [unverified]. KeystoneML
+attributed per-stage wall time to every pipeline node to drive its
+optimizer; the analog here is three layers:
+
+- ``Tracer`` — nested spans (name, start, duration, thread, attrs) in a
+  bounded ring buffer, exported as Chrome-trace JSON viewable in Perfetto
+  next to ``jax.profiler`` captures from ``maybe_trace``. Gated on
+  ``KEYSTONE_TRACE`` and resolved ONCE per stream/solve/service via
+  ``active_tracer()`` (the ``active_plan()`` discipline), so the disabled
+  tracer costs a None check, never a per-record context manager.
+- ``LatencyHistogram`` / ``Gauge`` — HdrHistogram-style fixed log buckets
+  (p50/p95/p99 within one bucket's ~4% quantization) and point-in-time
+  gauges with a high-water mark, both thread-safe.
+- ``MetricsRegistry`` — every process-wide metric component (serving
+  counters, reliability counters, histograms, gauges) under one
+  ``snapshot()``/``reset()``; bench tools and the serving health surface
+  read this instead of keeping private copies.
+
+Plus the pre-existing FLOP/byte counts straight from the compiled HLO
+(`cost_analysis`), which is what per-chip TFLOPS reporting uses.
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import math
+import os
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 
@@ -66,6 +88,441 @@ def maybe_trace(tag: str):
     with jax.profiler.trace(path):
         yield
     logger.info("profiler trace written to %s", path)
+
+
+# ---------------------------------------------------------------------------
+# Span tracing
+# ---------------------------------------------------------------------------
+
+
+class Tracer:
+    """Process-wide span recorder: a bounded ring buffer of (name, cat,
+    start, duration, thread, attrs) spans, exported as Chrome-trace JSON.
+
+    Spans nest two ways: timestamps on one thread track contain each other
+    (which is all Perfetto needs to draw the flame), and ``span()``
+    additionally records the per-thread parent name so tests and the
+    report CLI can assert nesting without reconstructing it from time.
+    ``record()`` takes externally-captured endpoints — the shape the hot
+    paths use (one ``now()`` before, one ``record()`` after, no generator
+    frame in the timed region) and the shape cross-thread spans need
+    (queue residency starts on the producer, ends on the consumer).
+
+    Thread-safe; the ring (``deque(maxlen=...)``) keeps the most recent
+    ``capacity`` spans so a long traced run holds bounded memory.
+    """
+
+    def __init__(self, capacity: int = 65536):
+        self.capacity = int(capacity)
+        self.epoch_ns = time.perf_counter_ns()
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=self.capacity)
+        self._tls = threading.local()
+        self.dropped = 0  # spans evicted by the ring bound
+
+    @staticmethod
+    def now() -> int:
+        """Monotonic timestamp (ns) on the tracer's clock."""
+        return time.perf_counter_ns()
+
+    def record(
+        self,
+        name: str,
+        cat: str,
+        start_ns: int,
+        end_ns: Optional[int] = None,
+        **attrs,
+    ) -> None:
+        """Record one completed span from explicit endpoints (``end_ns``
+        None = now). ``attrs`` must be JSON-representable."""
+        if end_ns is None:
+            end_ns = time.perf_counter_ns()
+        t = threading.current_thread()
+        with self._lock:
+            if len(self._spans) == self.capacity:
+                self.dropped += 1
+            self._spans.append(
+                {
+                    "name": name,
+                    "cat": cat,
+                    "start_ns": start_ns,
+                    "dur_ns": max(0, end_ns - start_ns),
+                    "tid": t.ident,
+                    "thread": t.name,
+                    "args": attrs,
+                }
+            )
+
+    def instant(self, name: str, cat: str = "app", **attrs) -> None:
+        """A zero-duration marker (cache hits, rejections)."""
+        now = time.perf_counter_ns()
+        self.record(name, cat, now, now, **attrs)
+
+    @contextmanager
+    def span(self, name: str, cat: str = "app", **attrs):
+        """Context-managed span; yields the attrs dict so the body can add
+        keys it only knows afterwards (e.g. an output shape). Tracks the
+        per-thread span stack and stamps the parent name."""
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        if stack:
+            attrs.setdefault("parent", stack[-1])
+        stack.append(name)
+        t0 = time.perf_counter_ns()
+        try:
+            yield attrs
+        finally:
+            end = time.perf_counter_ns()
+            stack.pop()
+            self.record(name, cat, t0, end, **attrs)
+
+    def spans(self) -> List[dict]:
+        """Snapshot of the ring's current spans (oldest first)."""
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self.dropped = 0
+
+    def export(self, path: Optional[str] = None) -> dict:
+        """The ring as a Chrome-trace document (``{"traceEvents": [...]}``,
+        timestamps/durations in microseconds) — loadable by Perfetto /
+        chrome://tracing alongside ``maybe_trace``'s jax profiler capture.
+        With ``path``, also written as JSON to that file."""
+        pid = os.getpid()
+        events = []
+        threads: Dict[int, str] = {}
+        for s in self.spans():
+            threads.setdefault(s["tid"], s["thread"])
+            events.append(
+                {
+                    "name": s["name"],
+                    "cat": s["cat"],
+                    "ph": "X",
+                    "ts": (s["start_ns"] - self.epoch_ns) / 1e3,
+                    "dur": s["dur_ns"] / 1e3,
+                    "pid": pid,
+                    "tid": s["tid"],
+                    "args": s["args"],
+                }
+            )
+        for tid, tname in threads.items():
+            events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"name": tname},
+                }
+            )
+        doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if path is not None:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+            logger.info("chrome trace (%d events) written to %s",
+                        len(events), path)
+        return doc
+
+
+_tracer_lock = threading.Lock()
+_tracer: Optional[Tracer] = None
+_tracer_key: Optional[tuple] = None
+
+
+def active_tracer() -> Optional[Tracer]:
+    """The process-wide Tracer, or None when tracing is disabled.
+
+    Built from ``config.trace`` / ``config.trace_buffer`` (env
+    ``KEYSTONE_TRACE`` / ``KEYSTONE_TRACE_BUFFER``) and rebuilt when those
+    change, so tests flip the knob without a reload. Call sites grab the
+    tracer ONCE per stream/solve/service/execution — never per record —
+    so the disabled tracer (None) adds nothing to hot loops (the
+    ``active_plan()`` discipline)."""
+    global _tracer, _tracer_key
+    from keystone_tpu.config import config
+
+    if not config.trace:
+        return None
+    key = (True, config.trace_buffer)
+    with _tracer_lock:
+        if key != _tracer_key or _tracer is None:
+            _tracer = Tracer(config.trace_buffer)
+            _tracer_key = key
+        return _tracer
+
+
+def reset_tracer() -> None:
+    """Drop the cached tracer (a fresh empty ring on next resolve)."""
+    global _tracer, _tracer_key
+    with _tracer_lock:
+        _tracer = None
+        _tracer_key = None
+
+
+def validate_chrome_trace(doc: Any) -> List[str]:
+    """Schema check of a Chrome-trace document; returns the list of
+    problems (empty = valid). Shared by ``tools/trace_report.py`` and the
+    tier-1 trace-demo test so the exporter and its validator can't
+    drift."""
+    errors: List[str] = []
+    if not isinstance(doc, dict) or not isinstance(
+        doc.get("traceEvents"), list
+    ):
+        return ["document must be an object with a 'traceEvents' list"]
+    for i, ev in enumerate(doc["traceEvents"]):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in ("X", "M", "i", "B", "E"):
+            errors.append(f"{where}: bad phase {ph!r}")
+            continue
+        if not isinstance(ev.get("name"), str) or "pid" not in ev:
+            errors.append(f"{where}: missing name/pid")
+        if ph == "X":
+            ts, dur = ev.get("ts"), ev.get("dur")
+            if not isinstance(ts, (int, float)) or not isinstance(
+                dur, (int, float)
+            ):
+                errors.append(f"{where}: X event needs numeric ts/dur")
+            elif dur < 0:
+                errors.append(f"{where}: negative duration")
+            if "args" in ev and not isinstance(ev["args"], dict):
+                errors.append(f"{where}: args must be an object")
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# Latency histograms and gauges
+# ---------------------------------------------------------------------------
+
+
+class LatencyHistogram:
+    """Fixed log-bucket latency histogram, HdrHistogram-style.
+
+    Buckets grow geometrically by ``2**(1/sub)`` from ``min_s`` to
+    ``max_s`` (defaults: 1 µs → 1000 s at sub=16 ≈ 480 buckets, ~4.4%
+    quantization per bucket — well inside the 10% agreement budget the
+    serving acceptance check demands). ``record()`` is one ``log2`` + a
+    locked bucket increment; min/max/sum are tracked exactly, so mean and
+    the extreme percentiles don't pay the quantization. Thread-safe:
+    client threads and the serving worker record concurrently."""
+
+    def __init__(self, min_s: float = 1e-6, max_s: float = 1e3, sub: int = 16):
+        assert min_s > 0 and max_s > min_s and sub >= 1
+        self._lo = float(min_s)
+        self._sub = int(sub)
+        self._nbuckets = int(math.ceil(math.log2(max_s / min_s) * sub)) + 2
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * self._nbuckets
+            self._n = 0
+            self._sum = 0.0
+            self._min = math.inf
+            self._max = 0.0
+
+    def _index(self, seconds: float) -> int:
+        if seconds <= self._lo:
+            return 0
+        i = int(math.log2(seconds / self._lo) * self._sub) + 1
+        return min(i, self._nbuckets - 1)
+
+    def _value(self, index: int) -> float:
+        """Representative (geometric-midpoint) value of a bucket."""
+        if index <= 0:
+            return self._lo
+        return self._lo * 2.0 ** ((index - 0.5) / self._sub)
+
+    def record(self, seconds: float) -> None:
+        if seconds < 0:
+            seconds = 0.0
+        i = self._index(seconds)
+        with self._lock:
+            self._counts[i] += 1
+            self._n += 1
+            self._sum += seconds
+            if seconds < self._min:
+                self._min = seconds
+            if seconds > self._max:
+                self._max = seconds
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._n
+
+    def _percentile_locked(self, p: float) -> float:
+        """Nearest-rank percentile (caller holds the lock, _n > 0)."""
+        target = max(1, math.ceil(self._n * p / 100.0))
+        acc = 0
+        for i, c in enumerate(self._counts):
+            acc += c
+            if acc >= target:
+                return min(max(self._value(i), self._min), self._max)
+        return self._max  # unreachable
+
+    def percentile(self, p: float) -> Optional[float]:
+        """The p-th percentile in seconds (nearest-rank over buckets), or
+        None when empty. Clamped to the exactly-tracked min/max so p0/p100
+        don't carry bucket quantization."""
+        with self._lock:
+            if self._n == 0:
+                return None
+            return self._percentile_locked(p)
+
+    def snapshot(self) -> Dict[str, Any]:
+        # ONE lock acquisition for counts AND percentiles: a concurrent
+        # reset() between them would hand a poller percentile()=None.
+        with self._lock:
+            if self._n == 0:
+                return {"count": 0}
+            to_ms = lambda s: round(s * 1e3, 4)  # noqa: E731
+            return {
+                "count": self._n,
+                "mean_ms": to_ms(self._sum / self._n),
+                "min_ms": to_ms(self._min),
+                "p50_ms": to_ms(self._percentile_locked(50)),
+                "p95_ms": to_ms(self._percentile_locked(95)),
+                "p99_ms": to_ms(self._percentile_locked(99)),
+                "max_ms": to_ms(self._max),
+            }
+
+
+class Gauge:
+    """A point-in-time value with a high-water mark (queue depth,
+    in-flight requests). Thread-safe."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+            self._max = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+            if value > self._max:
+                self._max = value
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {"value": self._value, "max": self._max}
+
+
+class MetricsRegistry:
+    """THE process-wide metrics surface: every counter set, histogram, and
+    gauge registers here, and one ``snapshot()``/``reset()`` covers them
+    all — bench tools and ``PipelineService.stats()`` read this instead of
+    keeping private copies that drift. Components need only
+    ``snapshot()``/``reset()`` methods."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._parts: Dict[str, Any] = {}
+
+    def register(self, name: str, part: Any) -> Any:
+        with self._lock:
+            existing = self._parts.get(name)
+            if existing is not None and existing is not part:
+                raise ValueError(f"metric {name!r} already registered")
+            self._parts[name] = part
+        return part
+
+    def _get_or_create(self, name: str, factory: Callable[[], Any]) -> Any:
+        with self._lock:
+            part = self._parts.get(name)
+            if part is None:
+                part = self._parts[name] = factory()
+            return part
+
+    def histogram(self, name: str, **kwargs) -> LatencyHistogram:
+        """Get-or-create a named latency histogram."""
+        part = self._get_or_create(name, lambda: LatencyHistogram(**kwargs))
+        if not isinstance(part, LatencyHistogram):
+            raise TypeError(f"metric {name!r} is a {type(part).__name__}")
+        return part
+
+    def gauge(self, name: str) -> Gauge:
+        """Get-or-create a named gauge."""
+        part = self._get_or_create(name, Gauge)
+        if not isinstance(part, Gauge):
+            raise TypeError(f"metric {name!r} is a {type(part).__name__}")
+        return part
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._parts)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            parts = dict(self._parts)
+        return {name: part.snapshot() for name, part in sorted(parts.items())}
+
+    def reset(self) -> None:
+        with self._lock:
+            parts = list(self._parts.values())
+        for part in parts:
+            part.reset()
+
+
+metrics_registry = MetricsRegistry()
+
+
+def environment_fingerprint(devices: bool = True) -> Dict[str, Any]:
+    """Provenance block for every bench JSON writer: the jax/runtime
+    identity plus whichever ``KEYSTONE_*`` knobs were in effect, so
+    cross-run comparisons (e.g. a p99 delta between rounds) are
+    interpretable instead of mystery noise.
+
+    ``devices=False`` skips the device probe — for orchestrator processes
+    (bench.py's driver, tools/bench_mfu.py) that deliberately never
+    initialize the backend in-process because a dead TPU plugin can HANG
+    initialization, not just fail it."""
+    import platform as _platform
+
+    fp: Dict[str, Any] = {
+        "jax": getattr(jax, "__version__", None),
+        "python": _platform.python_version(),
+        "cpu_count": os.cpu_count(),
+        "keystone_env": {
+            k: v for k, v in sorted(os.environ.items())
+            if k.startswith("KEYSTONE_")
+        },
+    }
+    try:
+        import numpy as _np
+
+        fp["numpy"] = _np.__version__
+    except Exception:
+        pass
+    if not devices:
+        return fp
+    try:
+        devs = jax.local_devices()
+        fp["backend"] = jax.default_backend()
+        fp["device_kind"] = devs[0].device_kind if devs else None
+        fp["device_count"] = jax.device_count()
+    except Exception as e:  # deviceless / dead backend: record, don't die
+        fp["backend_error"] = str(e)[:200]
+    return fp
 
 
 def device_hbm_bytes(default: int | None = None) -> int:
@@ -159,10 +616,16 @@ class ServingCounters:
             self.rows_in = 0
             self.rows_padded = 0
             self.bucket_hits: Dict[int, int] = {}
+            self.compiles_by_bucket: Dict[int, int] = {}
 
     def record_compile(self, bucket: int) -> None:
         with self._lock:
             self.compiles += 1
+            # Per-bucket attribution: warmup evidence can then NAME which
+            # bucket compiled instead of reporting an anonymous total.
+            self.compiles_by_bucket[bucket] = (
+                self.compiles_by_bucket.get(bucket, 0) + 1
+            )
 
     def record_call(self, bucket: int, rows: int) -> None:
         with self._lock:
@@ -182,10 +645,14 @@ class ServingCounters:
                     self.rows_padded / self.rows_in if self.rows_in else 0.0
                 ),
                 "bucket_hits": dict(sorted(self.bucket_hits.items())),
+                "compiles_by_bucket": dict(
+                    sorted(self.compiles_by_bucket.items())
+                ),
             }
 
 
 serving_counters = ServingCounters()
+metrics_registry.register("serving", serving_counters)
 
 
 class ReliabilityCounters:
@@ -236,3 +703,4 @@ class ReliabilityCounters:
 
 
 reliability_counters = ReliabilityCounters()
+metrics_registry.register("reliability", reliability_counters)

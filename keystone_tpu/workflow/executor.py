@@ -39,6 +39,20 @@ class UnboundSourceError(RuntimeError):
     pass
 
 
+def _span_shape(value) -> Any:
+    """A JSON-able shape for a node-span attr: array shape, list length,
+    or None — best-effort, never a failure."""
+    shape = getattr(value, "shape", None)
+    if shape is not None:
+        try:
+            return [int(s) for s in shape]
+        except (TypeError, ValueError):
+            return None
+    if isinstance(value, (list, tuple)):
+        return [len(value)]
+    return None
+
+
 def _no_sources(sid: SourceId):
     raise UnboundSourceError(
         f"graph has unbound source {sid!r}; apply the pipeline to data first"
@@ -59,6 +73,11 @@ class GraphExecutor:
         subgraph is never visited — cached values short-circuit
         recomputation, not just value storage.
         """
+        from keystone_tpu.utils.metrics import active_tracer
+
+        # Resolved once per execution walk (the active_plan discipline):
+        # the untraced walk pays one None check per node, nothing more.
+        tracer = active_tracer()
         for t in targets:
             if isinstance(t, SourceId):
                 _no_sources(t)
@@ -121,6 +140,10 @@ class GraphExecutor:
                 hit = self.env.node_cache[h][0]
             if hit is not None:
                 values[gid] = by_hash[h] = hit
+                if tracer is not None:
+                    tracer.instant(
+                        "node:" + op.label(), "executor", cache="hit"
+                    )
                 continue  # leaf: do not descend into its dependencies
             stack.append((gid, True))
             for dep in graph.dependencies[gid]:
@@ -132,6 +155,10 @@ class GraphExecutor:
             op = graph.operators[nid]
             if h in by_hash:
                 values[nid] = by_hash[h]
+                if tracer is not None:
+                    tracer.instant(
+                        "node:" + op.label(), "executor", cache="memo"
+                    )
                 # A cache node hashes identically to its dependency (it's an
                 # identity), so it lands here — still persist its value.
                 if getattr(op, "persist", False) and h not in self.env.node_cache:
@@ -141,7 +168,15 @@ class GraphExecutor:
                     )
                 continue
             deps = [values[d] for d in graph.dependencies[nid]]
-            out = op.execute(deps)
+            if tracer is None:
+                out = op.execute(deps)
+            else:
+                t0 = tracer.now()
+                out = op.execute(deps)
+                tracer.record(
+                    "node:" + op.label(), "executor", t0,
+                    cache="miss", shape=_span_shape(out),
+                )
             values[nid] = by_hash[h] = out
             if isinstance(op, EstimatorOperator):
                 self._cache_fit(graph, nid, h, op, out)

@@ -417,15 +417,28 @@ class Pipeline:
         the out-of-core scoring/featurization loop of the streamed
         pipelines.
         """
-        from keystone_tpu.loaders.stream import prefetched
+        from contextlib import nullcontext
 
+        from keystone_tpu.loaders.stream import prefetched
+        from keystone_tpu.utils.metrics import active_tracer
+
+        tracer = active_tracer()  # once per stream, like the fault plan
         with prefetched(iter(batches), prefetch_depth) as src:
-            for item in src:
+            for i, item in enumerate(src):
                 if isinstance(item, tuple) and len(item) == 2:
                     X, y = item
                 else:
                     X, y = item, None
-                yield self.apply(X).get(), y
+                ctx = (
+                    tracer.span(
+                        "pipeline.apply_batch", "pipeline", batch=i,
+                        rows=int(getattr(X, "shape", (len(X),))[0]),
+                    )
+                    if tracer is not None else nullcontext()
+                )
+                with ctx:
+                    out = self.apply(X).get()
+                yield out, y
 
     def apply_datum(self, datum) -> Any:
         """Apply to a single datum, eagerly (driver-local in the reference).
@@ -453,9 +466,19 @@ class Pipeline:
 
         Ref: Pipeline.fit returning FittedPipeline [unverified].
         """
+        from contextlib import nullcontext
+
+        from keystone_tpu.utils.metrics import active_tracer
         from keystone_tpu.workflow.executor import PipelineEnv
 
-        graph = PipelineEnv.get().executor.fit_estimators(self.graph, self.sink)
+        # Cold path (once per fit): nullcontext keeps one call body; the
+        # hot loops (solvers, prefetch, serving) branch explicitly instead.
+        tracer = active_tracer()
+        with (tracer.span("pipeline.fit", "pipeline")
+              if tracer is not None else nullcontext()):
+            graph = PipelineEnv.get().executor.fit_estimators(
+                self.graph, self.sink
+            )
         # Prune to the subgraph feeding our sink.
         return Pipeline(graph, self.source, self.sink)
 
@@ -528,9 +551,23 @@ class PipelineDataset:
 
     def get(self) -> Any:
         if not self._computed:
+            from contextlib import nullcontext
+
+            from keystone_tpu.utils.metrics import active_tracer
             from keystone_tpu.workflow.executor import PipelineEnv
 
-            self._value = PipelineEnv.get().optimize_and_execute(self.graph, self.sink)
+            tracer = active_tracer()
+            ctx = (
+                tracer.span("pipeline.apply", "pipeline")
+                if tracer is not None else nullcontext({})
+            )
+            with ctx as attrs:
+                self._value = PipelineEnv.get().optimize_and_execute(
+                    self.graph, self.sink
+                )
+                shape = getattr(self._value, "shape", None)
+                if shape is not None:
+                    attrs["shape"] = [int(s) for s in shape]
             self._computed = True
         return self._value
 

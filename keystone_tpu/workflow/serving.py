@@ -46,7 +46,13 @@ import jax
 import numpy as np
 
 from keystone_tpu.config import config, pow2_ladder
-from keystone_tpu.utils.metrics import reliability_counters, serving_counters
+from keystone_tpu.utils.metrics import (
+    LatencyHistogram,
+    active_tracer,
+    metrics_registry,
+    reliability_counters,
+    serving_counters,
+)
 from keystone_tpu.utils.reliability import (
     DeadlineExceeded,
     QueueFullError,
@@ -56,6 +62,17 @@ from keystone_tpu.utils.reliability import (
 )
 
 logger = logging.getLogger("keystone_tpu")
+
+# Registry-backed serving health metrics (utils/metrics.MetricsRegistry):
+# per-device-call and end-to-end submit latency histograms plus
+# queue-depth / in-flight gauges. Always on — one clock read and a locked
+# bucket increment per REQUEST (not per row), noise against a device call
+# — so `MetricsRegistry.snapshot()` reports serving p50/p95/p99 without
+# anyone having had to pre-arm tracing before the incident.
+request_latency = metrics_registry.histogram("serve.request_latency")
+e2e_latency = metrics_registry.histogram("serve.e2e_latency")
+queue_depth_gauge = metrics_registry.gauge("serve.queue_depth")
+inflight_gauge = metrics_registry.gauge("serve.inflight")
 
 
 class RowDependenceError(TypeError):
@@ -288,8 +305,15 @@ class CompiledPipeline:
         self.feature_shape: Optional[Tuple[int, ...]] = None
         self._dtype = None
         self.compile_count = 0
+        # Per-ENGINE bucket attribution (serving_counters keeps the
+        # process-wide view): two engines in one process must not read
+        # each other's compiles off their own stats().
+        self.compiles_by_bucket: dict = {}
         self.warmup_seconds: Optional[float] = None
         self._lock = threading.Lock()
+        # Resolved ONCE per engine (the active_plan discipline): tracing
+        # disabled = a None check on the hot call, nothing more.
+        self._tracer = active_tracer()
 
     @property
     def dtype(self):
@@ -347,6 +371,7 @@ class CompiledPipeline:
         )
         self._executables[b] = self._jit.lower(spec).compile()
         self.compile_count += 1
+        self.compiles_by_bucket[b] = self.compiles_by_bucket.get(b, 0) + 1
         serving_counters.record_compile(b)
         return self._executables[b]
 
@@ -359,6 +384,7 @@ class CompiledPipeline:
             # the first-traffic latency pays the whole ladder. Call
             # warmup() ahead of traffic instead.
             self.warmup(np.asarray(X))
+        t0 = time.perf_counter()
         X = np.asarray(X, dtype=self._dtype)
         if X.shape[1:] != self.feature_shape:
             raise ValueError(
@@ -373,10 +399,15 @@ class CompiledPipeline:
             chunk = X[start : min(start + self.max_batch, n)]
             outs.append(self._serve_chunk(chunk))
         if len(outs) == 1:
-            return outs[0]
-        return jax.tree_util.tree_map(
-            lambda *xs: np.concatenate(xs, axis=0), *outs
-        )
+            out = outs[0]
+        else:
+            out = jax.tree_util.tree_map(
+                lambda *xs: np.concatenate(xs, axis=0), *outs
+            )
+        # Boundaries match what an external caller times around this call,
+        # so the registry's percentiles agree with bench_serve's.
+        request_latency.record(time.perf_counter() - t0)
+        return out
 
     def _serve_chunk(self, chunk: np.ndarray):
         m = chunk.shape[0]
@@ -390,18 +421,30 @@ class CompiledPipeline:
                 ex = self._executables.get(b)
                 if ex is None:  # cold bucket (warmup skipped): counted miss
                     ex = self._compile_bucket(b)
+        tr = self._tracer
+        t0 = tr.now() if tr is not None else 0
         out = ex(chunk)
         serving_counters.record_call(b, m)
         # np.asarray blocks on the transfer, so latency measurements around
         # this call see the true device time; slicing happens on host.
-        return jax.tree_util.tree_map(lambda a: np.asarray(a)[:m], out)
+        out = jax.tree_util.tree_map(lambda a: np.asarray(a)[:m], out)
+        if tr is not None:
+            tr.record("serve.device", "serving", t0, rows=m, bucket=b)
+        return out
 
     def stats(self) -> dict:
         return {
             "ladder": list(self.ladder),
             "compile_count": self.compile_count,
+            "compiles_by_bucket": dict(sorted(
+                self.compiles_by_bucket.items()
+            )),
             "warmup_seconds": self.warmup_seconds,
             "donate": self.donate,
+            # Explicitly process-wide (every engine records into the one
+            # registry histogram); per-engine latency needs one engine per
+            # process or the trace's serve.device spans.
+            "process_request_latency": request_latency.snapshot(),
         }
 
 
@@ -482,6 +525,12 @@ class PipelineService:
             deadline_ms if deadline_ms is not None else config.serve_deadline_ms
         ) / 1e3
         self._plan = active_plan()
+        self._tracer = active_tracer()  # resolved once per service
+        # Per-SERVICE latency/depth (the process-global registry metrics
+        # aggregate every service; two services in one process must not
+        # read each other's numbers off their own stats()).
+        self._e2e = LatencyHistogram()
+        self._depth_max = 0
         self._pending: deque = deque()
         self._inflight: list = []  # futures of the group being flushed
         self._lock = threading.Lock()
@@ -528,6 +577,9 @@ class PipelineService:
         )
         deadline = time.monotonic() + deadline_s if deadline_s > 0 else None
         fut: Future = Future()
+        # Lifecycle clock: queued → flushed → device → resolved spans and
+        # the e2e histogram all measure from this submit timestamp.
+        t_sub = time.perf_counter_ns()
         with self._cv:
             if self._closed:
                 raise ServiceClosed("PipelineService is closed")
@@ -537,12 +589,20 @@ class PipelineService:
                 # instead of queueing latency the client will time out on.
                 self.rejected += 1
                 reliability_counters.bump("requests_rejected")
+                if self._tracer is not None:
+                    self._tracer.instant(
+                        "serve.rejected", "serving", rows=int(x.shape[0])
+                    )
                 raise QueueFullError(
                     f"serving queue at capacity ({self.max_pending} "
                     "pending); request rejected fast"
                 )
-            self._pending.append((x, datum, fut, deadline))
+            self._pending.append((x, datum, fut, deadline, t_sub))
             self.requests += 1
+            depth = len(self._pending)
+            queue_depth_gauge.set(depth)
+            if depth > self._depth_max:
+                self._depth_max = depth
             self._cv.notify()
         return fut
 
@@ -582,6 +642,11 @@ class PipelineService:
     def _fail_expired(self, entry) -> None:
         self.expired += 1
         reliability_counters.bump("deadline_expired")
+        if self._tracer is not None:
+            self._tracer.record(
+                "serve.request", "serving", entry[4], outcome="expired",
+                rows=int(entry[0].shape[0]),
+            )
         self._resolve(
             entry[2],
             exc=DeadlineExceeded(
@@ -632,12 +697,25 @@ class PipelineService:
                     if remaining <= 0 or self._closed:
                         break
                     self._cv.wait(remaining)
+                # Gauge updated even when everything popped had expired
+                # (group empty): the queue really did shrink.
+                queue_depth_gauge.set(len(self._pending))
                 if not group:
                     continue
                 self._inflight = [e[2] for e in group]
+                inflight_gauge.set(len(group))
+                if self._tracer is not None:
+                    # Queue residency per request: submit → flush-group pop.
+                    now = self._tracer.now()
+                    for e in group:
+                        self._tracer.record(
+                            "serve.queued", "serving", e[4], now,
+                            rows=int(e[0].shape[0]),
+                        )
             self._flush(group)
             with self._cv:
                 self._inflight = []
+                inflight_gauge.set(0)
 
     @staticmethod
     def _resolve(fut: Future, value=None, exc=None) -> None:
@@ -663,6 +741,8 @@ class PipelineService:
                 live.append(entry)
         if not live:
             return
+        tr = self._tracer
+        t_flush = tr.now() if tr is not None else 0
         try:
             if len(live) == 1:
                 X = live[0][0]
@@ -672,7 +752,7 @@ class PipelineService:
             self.batches_run += 1
             self.rows_served += X.shape[0]
             off = 0
-            for x, datum, fut, _deadline in live:
+            for x, datum, fut, _deadline, t_sub in live:
                 m = x.shape[0]
                 piece = jax.tree_util.tree_map(
                     lambda a, o=off, m=m: a[o : o + m], out
@@ -680,11 +760,33 @@ class PipelineService:
                 if datum:
                     piece = jax.tree_util.tree_map(lambda a: a[0], piece)
                 off += m
+                # Latency stamped BEFORE resolving: set_result runs client
+                # done-callbacks inline, and their cost must not count as
+                # serving latency (for this request or the rest of the
+                # group).
+                now_ns = time.perf_counter_ns()
+                self._e2e.record((now_ns - t_sub) / 1e9)
+                e2e_latency.record((now_ns - t_sub) / 1e9)
+                if tr is not None:
+                    tr.record(
+                        "serve.request", "serving", t_sub, now_ns,
+                        outcome="ok", rows=m,
+                    )
                 self._resolve(fut, value=piece)
+            if tr is not None:
+                tr.record(
+                    "serve.flush", "serving", t_flush,
+                    requests=len(live), rows=int(X.shape[0]),
+                )
         except Exception as e:  # fail the whole flush group, keep serving
-            for _x, _d, fut, _deadline in live:
+            for _x, _d, fut, _deadline, t_sub in live:
                 if not fut.done():
                     self._resolve(fut, exc=e)
+                    if tr is not None:
+                        tr.record(
+                            "serve.request", "serving", t_sub,
+                            outcome=type(e).__name__,
+                        )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -710,6 +812,8 @@ class PipelineService:
             leftovers = [e[2] for e in self._pending] + list(self._inflight)
             self._pending.clear()
             self._inflight = []
+            queue_depth_gauge.set(0)
+            inflight_gauge.set(0)
         failed = 0
         for fut in rejected + leftovers:
             if not fut.done():
@@ -731,6 +835,14 @@ class PipelineService:
         return False
 
     def stats(self) -> dict:
+        """The service health surface: request accounting, end-to-end
+        latency percentiles (registry-backed, always on), queue/in-flight
+        state, and the engine's compile evidence — one dict an operator or
+        bench can poll instead of assembling it from private counters."""
+        with self._lock:
+            pending = len(self._pending)
+            inflight = len(self._inflight)
+            alive = self._worker.is_alive()
         return {
             "requests": self.requests,
             "batches_run": self.batches_run,
@@ -741,4 +853,12 @@ class PipelineService:
             "coalesce_ratio": (
                 self.requests / self.batches_run if self.batches_run else None
             ),
+            "pending": pending,
+            "inflight": inflight,
+            "worker_alive": alive,
+            "closed": self._closed,
+            # Per-service, not the process-global registry aggregates.
+            "latency": self._e2e.snapshot(),
+            "queue_depth": {"value": pending, "max": self._depth_max},
+            "compiled": self.compiled.stats(),
         }

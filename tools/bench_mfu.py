@@ -42,7 +42,15 @@ def main() -> None:
     )
     args = ap.parse_args()
 
+    from keystone_tpu.utils.metrics import environment_fingerprint
     from keystone_tpu.utils.platform import cpu_mesh_env, probe_backend
+
+    # One provenance line up front (deviceless: this process never inits
+    # the backend — workers do); each row then only carries its backend.
+    print(json.dumps({
+        "metric": "env_fingerprint",
+        **environment_fingerprint(devices=False),
+    }), flush=True)
 
     def probe_live_tpu() -> bool:
         info = probe_backend(timeout=120)
@@ -66,6 +74,15 @@ def main() -> None:
         for block in args.blocks:
             env = dict(base_env)
             env["KEYSTONE_BENCH_BLOCK"] = str(block)
+            # KEYSTONE_PROFILE_DIR=... captures a jax profiler trace of
+            # every sweep config: the worker's timed loop runs under
+            # maybe_trace, and a per-config subdirectory keeps same-dtype
+            # configs (identical worker-side tags) from overwriting each
+            # other.
+            if env.get("KEYSTONE_PROFILE_DIR"):
+                env["KEYSTONE_PROFILE_DIR"] = os.path.join(
+                    env["KEYSTONE_PROFILE_DIR"], f"mfu_b{block}_{dtype}"
+                )
             # bench._run_worker tails worker stderr on failure — the
             # diagnostics contract the round-1 gate failure taught us.
             r = bench._run_worker(env, scale_key, dtype, args.timeout)

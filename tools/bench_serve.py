@@ -86,6 +86,18 @@ def lat_stats(lats_s) -> dict:
     }
 
 
+def nearest_rank_ms(lats_s, p: float) -> float:
+    """Nearest-rank percentile in ms — the estimator the registry's
+    log-bucket histogram implements, used for the agreement cross-check so
+    both sides measure the SAME order statistic (numpy's default linear
+    interpolation can smooth across a tail jump that nearest-rank, by
+    design, reports)."""
+    import math
+
+    s = sorted(lats_s)
+    return s[max(0, math.ceil(len(s) * p / 100.0) - 1)] * 1e3
+
+
 def run_overload(cp, args) -> dict:
     """2x-capacity open-loop hammering of the bounded-queue service."""
     from keystone_tpu.utils.reliability import (
@@ -241,7 +253,12 @@ def main() -> None:
     import jax
 
     from keystone_tpu.config import config
-    from keystone_tpu.utils.metrics import CompileEventCounter, serving_counters
+    from keystone_tpu.utils.metrics import (
+        CompileEventCounter,
+        environment_fingerprint,
+        maybe_trace,
+        metrics_registry,
+    )
     from keystone_tpu.workflow.serving import (
         CompiledPipeline,
         PipelineService,
@@ -259,15 +276,20 @@ def main() -> None:
             max_batch=args.max_batch,
         )
         cp.warmup((args.d,))
+        # KEYSTONE_PROFILE_DIR=... additionally captures a jax profiler
+        # trace of the overload run, no code edits needed.
+        with maybe_trace("bench_serve_overload"):
+            overload = run_overload(cp, args)
         result = {
             "metric": "serve_overload",
             "backend": backend,
             "host_cores": os.cpu_count(),
+            "env": environment_fingerprint(),
             "d": args.d,
             "features": args.features,
             "classes": args.classes,
             "ladder": list(cp.ladder),
-            "overload": run_overload(cp, args),
+            "overload": overload,
         }
         line = json.dumps(result)
         print(line)
@@ -283,48 +305,60 @@ def main() -> None:
         rng.normal(size=(int(n), args.d)).astype(np.float32) for n in sizes
     ]
 
-    # -- naive: per-shape jit ------------------------------------------------
-    naive = build_chain(args.d, args.features, args.classes, args.seed)
-    # One warm call at the top size — the naive server has seen SOME traffic;
-    # every new row count in the trace still recompiles.
-    jax.block_until_ready(naive.batch_call(trace[0][: args.max_batch]))
-    ev0 = compile_events.count
-    naive_lats = []
-    t0 = time.perf_counter()
-    for x in trace:
-        t1 = time.perf_counter()
-        jax.block_until_ready(naive.batch_call(x))
-        naive_lats.append(time.perf_counter() - t1)
-    naive_wall = time.perf_counter() - t0
-    naive_compiles = compile_events.count - ev0
+    # KEYSTONE_PROFILE_DIR=... captures a jax profiler trace of both
+    # serving phases alongside the timing, no code edits needed.
+    with maybe_trace("bench_serve"):
+        # -- naive: per-shape jit ---------------------------------------------
+        naive = build_chain(args.d, args.features, args.classes, args.seed)
+        # One warm call at the top size — the naive server has seen SOME
+        # traffic; every new row count in the trace still recompiles.
+        jax.block_until_ready(naive.batch_call(trace[0][: args.max_batch]))
+        ev0 = compile_events.count
+        naive_lats = []
+        t0 = time.perf_counter()
+        for x in trace:
+            t1 = time.perf_counter()
+            jax.block_until_ready(naive.batch_call(x))
+            naive_lats.append(time.perf_counter() - t1)
+        naive_wall = time.perf_counter() - t0
+        naive_compiles = compile_events.count - ev0
 
-    # -- bucketed + AOT warmup -----------------------------------------------
-    serving_counters.reset()
-    cp = CompiledPipeline(
-        build_chain(args.d, args.features, args.classes, args.seed),
-        max_batch=args.max_batch,
-    )
-    ev0 = compile_events.count
-    cp.warmup((args.d,))
-    warmup_compiles = compile_events.count - ev0
-    ev0 = compile_events.count
-    bucketed_lats = []
-    t0 = time.perf_counter()
-    for x in trace:
-        t1 = time.perf_counter()
-        cp(x)  # host-out: the np result is already synchronized
-        bucketed_lats.append(time.perf_counter() - t1)
-    bucketed_wall = time.perf_counter() - t0
-    post_warmup_compiles = compile_events.count - ev0
+        # -- bucketed + AOT warmup --------------------------------------------
+        # One registry reset covers the serving counters AND the
+        # request-latency histogram the bucketed phase is about to fill.
+        metrics_registry.reset()
+        cp = CompiledPipeline(
+            build_chain(args.d, args.features, args.classes, args.seed),
+            max_batch=args.max_batch,
+        )
+        ev0 = compile_events.count
+        cp.warmup((args.d,))
+        warmup_compiles = compile_events.count - ev0
+        ev0 = compile_events.count
+        bucketed_lats = []
+        t0 = time.perf_counter()
+        for x in trace:
+            t1 = time.perf_counter()
+            cp(x)  # host-out: the np result is already synchronized
+            bucketed_lats.append(time.perf_counter() - t1)
+        bucketed_wall = time.perf_counter() - t0
+        post_warmup_compiles = compile_events.count - ev0
 
     rows = int(sizes.sum())
     naive_p99 = float(np.percentile(np.asarray(naive_lats) * 1e3, 99))
     bucketed_p99 = float(np.percentile(np.asarray(bucketed_lats) * 1e3, 99))
+    # The unified registry is THE counter source — one snapshot feeds the
+    # serving counters and the internal latency histogram (which must
+    # agree with this bench's own external timing within 10%).
+    registry_snap = metrics_registry.snapshot()
+    counters = registry_snap["serving"]
+    reg_lat = registry_snap["serve.request_latency"]
 
     result = {
         "metric": "serve_bucketed_vs_pershape",
         "backend": backend,
         "host_cores": os.cpu_count(),
+        "env": environment_fingerprint(),
         "requests": args.requests,
         "rows": rows,
         "d": args.d,
@@ -344,12 +378,24 @@ def main() -> None:
             "warmup_compiles": warmup_compiles,
             "post_warmup_compiles": post_warmup_compiles,
             "serving_counter_compiles_post_warmup": (
-                serving_counters.snapshot()["compiles"] - len(cp.ladder)
+                counters["compiles"] - len(cp.ladder)
             ),
-            "pad_overhead": round(
-                serving_counters.snapshot()["pad_overhead"], 4
+            "compiles_by_bucket": counters["compiles_by_bucket"],
+            "pad_overhead": round(counters["pad_overhead"], 4),
+            "bucket_hits": counters["bucket_hits"],
+        },
+        "registry_latency": {
+            # MetricsRegistry's internal histogram vs this bench's own
+            # external stopwatch over the same requests: the acceptance
+            # contract is agreement within 10%, nearest-rank on both sides
+            # (see nearest_rank_ms).
+            **reg_lat,
+            "p50_vs_external": round(
+                reg_lat["p50_ms"] / nearest_rank_ms(bucketed_lats, 50), 3
             ),
-            "bucket_hits": serving_counters.snapshot()["bucket_hits"],
+            "p99_vs_external": round(
+                reg_lat["p99_ms"] / nearest_rank_ms(bucketed_lats, 99), 3
+            ),
         },
         "speedup": {
             "p50": round(
@@ -363,6 +409,10 @@ def main() -> None:
         "pass": {
             "zero_post_warmup_compiles": post_warmup_compiles == 0,
             "p99_speedup_ge_2x": naive_p99 / bucketed_p99 >= 2.0,
+            "registry_p99_within_10pct": (
+                abs(reg_lat["p99_ms"] / nearest_rank_ms(bucketed_lats, 99)
+                    - 1.0) <= 0.10
+            ),
         },
     }
 
@@ -401,6 +451,9 @@ def main() -> None:
             "device_batches": stats["batches_run"],
             "coalesce_ratio": round(stats["coalesce_ratio"], 2),
             "rows_per_s": round(stats["rows_served"] / svc_wall, 1),
+            # The service's own registry-backed e2e histogram, next to the
+            # client-side stopwatch numbers above.
+            "internal_latency": stats["latency"],
         }
 
     line = json.dumps(result)

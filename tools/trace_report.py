@@ -1,0 +1,101 @@
+"""Report/validate a Chrome-trace JSON exported by ``Tracer.export()``.
+
+Validates the document against the Chrome trace-event schema (the shared
+``utils.metrics.validate_chrome_trace`` check — the same one the tier-1
+trace-demo test runs, so the exporter and this CLI can't drift) and prints
+a per-(category, name) aggregate table: span count, total/mean/max
+duration. The file itself opens directly in Perfetto
+(https://ui.perfetto.dev) or chrome://tracing for the timeline view.
+
+Usage:
+    python tools/trace_report.py TRACE.json [--validate-only] [--top N]
+
+Exit status: 0 = valid trace, 1 = schema problems (listed on stderr).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def summarize(doc: dict) -> dict:
+    """Aggregate X-phase events per (cat, name): count and duration stats
+    (milliseconds)."""
+    rows: dict = {}
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        key = (ev.get("cat", ""), ev["name"])
+        r = rows.setdefault(
+            key, {"count": 0, "total_ms": 0.0, "max_ms": 0.0}
+        )
+        dur_ms = float(ev.get("dur", 0.0)) / 1e3
+        r["count"] += 1
+        r["total_ms"] += dur_ms
+        if dur_ms > r["max_ms"]:
+            r["max_ms"] = dur_ms
+    return {
+        f"{cat}/{name}": {
+            "count": r["count"],
+            "total_ms": round(r["total_ms"], 3),
+            "mean_ms": round(r["total_ms"] / r["count"], 4),
+            "max_ms": round(r["max_ms"], 3),
+        }
+        for (cat, name), r in sorted(rows.items())
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace", help="Chrome-trace JSON file (Tracer.export)")
+    ap.add_argument("--validate-only", action="store_true",
+                    help="schema check only, no summary table")
+    ap.add_argument("--top", type=int, default=0,
+                    help="only the N rows with the largest total time")
+    args = ap.parse_args(argv)
+
+    from keystone_tpu.utils.metrics import validate_chrome_trace
+
+    with open(args.trace) as f:
+        doc = json.load(f)
+    errors = validate_chrome_trace(doc)
+    if errors:
+        for e in errors[:20]:
+            print(f"INVALID: {e}", file=sys.stderr)
+        if len(errors) > 20:
+            print(f"... and {len(errors) - 20} more", file=sys.stderr)
+        return 1
+    if args.validate_only:
+        print(json.dumps({
+            "trace": args.trace, "valid": True,
+            "events": len(doc["traceEvents"]),
+        }))
+        return 0
+
+    rows = summarize(doc)
+    if args.top > 0:
+        rows = dict(sorted(
+            rows.items(), key=lambda kv: -kv[1]["total_ms"]
+        )[: args.top])
+    print(json.dumps({
+        "trace": args.trace, "valid": True,
+        "events": len(doc["traceEvents"]), "spans": rows,
+    }))
+    if rows:
+        w = max(len(k) for k in rows)
+        print(f"\n{'span':<{w}}  {'count':>7}  {'total ms':>10}  "
+              f"{'mean ms':>9}  {'max ms':>9}", file=sys.stderr)
+        for k, r in rows.items():
+            print(f"{k:<{w}}  {r['count']:>7}  {r['total_ms']:>10.3f}  "
+                  f"{r['mean_ms']:>9.4f}  {r['max_ms']:>9.3f}",
+                  file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
