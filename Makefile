@@ -1,6 +1,6 @@
 # Developer entry points. Pipelines launch via bin/run-pipeline.sh.
 
-.PHONY: test t1 chaos native bench bench-serve bench-serve-overload trace-demo dryrun clean tpu-checkride sentinel northstar acceptance
+.PHONY: test t1 chaos native bench bench-serve bench-serve-overload bench-serve-replicas trace-demo dryrun clean tpu-checkride sentinel northstar acceptance
 
 # The canonical tier-1 verify (ROADMAP.md), verbatim at the defaults —
 # builders and CI invoke this one entry point instead of hand-copying the
@@ -61,6 +61,16 @@ bench-serve:
 # be bounded (rejections, not a latency cliff) and no future stranded.
 bench-serve-overload:
 	python tools/bench_serve.py --overload
+
+# Replica-pool scaling on the forced 8-host-device CPU mesh: the same
+# uniform trace served at devices=1 vs devices=4 through the pipelined
+# dispatcher. Gates: outputs bit-identical to single-device, every
+# replica serves traffic (dispatch balance max/min <= 3x); the >=1.3x
+# throughput gate is hard only on >=2-core hosts (fingerprinted in the
+# appended BENCH_serve.json row).
+bench-serve-replicas:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  python tools/bench_serve.py --devices 4 --out BENCH_serve.json
 
 # Observability smoke: a small fit + streamed solve + serve under
 # KEYSTONE_TRACE=1, Chrome-trace exported to /tmp/keystone_trace.json,

@@ -183,6 +183,24 @@ class Config:
     serve_max_batch: int = field(
         default_factory=lambda: _env_int("KEYSTONE_SERVE_MAX_BATCH", 1024)
     )
+    # Serving replica pool width: how many local devices CompiledPipeline
+    # AOT-warms its bucket ladder onto (one replica per device, each owning
+    # its own compiled executables). 0 = all local devices — the training
+    # side already spans the whole mesh; serving should too. 1 pins the
+    # pre-replica single-device behavior exactly.
+    # Env: KEYSTONE_SERVE_DEVICES.
+    serve_devices: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_SERVE_DEVICES", 0)
+    )
+    # Per-replica in-flight window for pipelined serving dispatch: the
+    # micro-batcher launches up to this many flush groups per replica
+    # before waiting on a completion, riding JAX async dispatch so replica
+    # B computes while replica A's results materialize. 1 serializes
+    # launch->materialize per replica (with one replica, exactly the
+    # pre-pipelining flush loop). Env: KEYSTONE_SERVE_INFLIGHT.
+    serve_inflight: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_SERVE_INFLIGHT", 2)
+    )
     # Whole-pipeline auto-caching (profile a sample run, persist the best
     # time-saved-per-byte intermediates under a budget). Opt-in: profiling
     # costs a sample execution per optimization.

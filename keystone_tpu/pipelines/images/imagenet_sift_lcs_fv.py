@@ -43,6 +43,29 @@ from keystone_tpu.nodes.util import ClassLabelIndicators, TopKClassifier
 from keystone_tpu.workflow import Pipeline
 
 
+def _scoring_engine(model, stream_batch: int):
+    """The classifier head as a replica-pool serving engine for the
+    streamed scorer's data-parallel offline apply, or None when the model
+    can't take the AOT path (not jittable / row-coupled) — the caller
+    falls back to ``batch_call``. A single bucket at the stream batch
+    size keeps warmup to one compile per device: the stream only ever
+    yields full batches plus one trailing partial (padded up)."""
+    from keystone_tpu.workflow.serving import (
+        CompiledPipeline,
+        RowDependenceError,
+    )
+
+    try:
+        # Stable name = explicit aggregation key: repeated scoring passes
+        # in one process reuse the same registry entries instead of
+        # leaking a fresh serve.dispatch[cpN]/gauge set per pass.
+        return CompiledPipeline(
+            model, buckets=(stream_batch,), name="imagenet-score-head"
+        )
+    except (TypeError, RowDependenceError):
+        return None
+
+
 @dataclass
 class ImageNetSiftLcsFVConfig:
     data_path: Optional[str] = None
@@ -246,7 +269,19 @@ def run_streamed(conf: ImageNetSiftLcsFVConfig) -> dict:
         thread), the TTA path prefetches raw batches and expands views on
         the consumer side (the view tensor must stay sub-batch-bounded)."""
         if patcher is None:
-            for F, y in featurizer.apply_batches(test_batches()):
+            head = _scoring_engine(model, conf.stream_batch)
+            feats = featurizer.apply_batches(test_batches())
+            if head is not None:
+                # Data-parallel offline scoring: the classifier head runs
+                # from its replica pool (one AOT ladder per local device),
+                # round-robining featurized batches so up to
+                # inflight x replicas device calls overlap the prefetch
+                # thread's decode/featurize. prefetch_depth=0: the source
+                # generator already prefetches; the async window supplies
+                # the overlap here.
+                yield from head.apply_batches(feats, prefetch_depth=0)
+                return
+            for F, y in feats:
                 # batch_call (not apply_batch) so the classifier head runs
                 # jitted and, under KEYSTONE_SERVE_BUCKETS, shape-stable:
                 # the stream's trailing partial batch otherwise recompiles

@@ -427,6 +427,33 @@ class Gauge:
             return {"value": self._value, "max": self._max}
 
 
+class CounterSet:
+    """Thread-safe string-keyed monotonic counters — the registry's
+    generic tally component (request outcomes, dispatch balance,
+    reliability events). Keys are created on first ``bump``; ``snapshot``
+    returns whatever was bumped, sorted."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+
+    def bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + n
+
+    def get(self, key: str) -> int:
+        with self._lock:
+            return self._counts.get(key, 0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(sorted(self._counts.items()))
+
+
 class MetricsRegistry:
     """THE process-wide metrics surface: every counter set, histogram, and
     gauge registers here, and one ``snapshot()``/``reset()`` covers them
@@ -464,6 +491,16 @@ class MetricsRegistry:
         """Get-or-create a named gauge."""
         part = self._get_or_create(name, Gauge)
         if not isinstance(part, Gauge):
+            raise TypeError(f"metric {name!r} is a {type(part).__name__}")
+        return part
+
+    def counters(self, name: str) -> CounterSet:
+        """Get-or-create a named counter set (outcome tallies, dispatch
+        balance). Per-instance serving metrics use ``base[instance]``
+        names — ``serve.requests[svc0]`` — so two services in one process
+        never overwrite each other's readings."""
+        part = self._get_or_create(name, CounterSet)
+        if not isinstance(part, CounterSet):
             raise TypeError(f"metric {name!r} is a {type(part).__name__}")
         return part
 
@@ -655,7 +692,7 @@ serving_counters = ServingCounters()
 metrics_registry.register("serving", serving_counters)
 
 
-class ReliabilityCounters:
+class ReliabilityCounters(CounterSet):
     """Process-wide failure/recovery observability: every reliability event
     (utils/reliability.py and its call sites) lands here, so a chaos run
     can assert which recoveries fired and an operator can see whether a
@@ -679,27 +716,10 @@ class ReliabilityCounters:
       backpressure and expired-before-run requests
     - ``worker_restarts`` / ``futures_failed_on_close`` /
       ``futures_failed_on_worker_death`` — serving worker lifecycle
+    - ``replica_deaths`` / ``replica_revivals`` /
+      ``serve_groups_redispatched`` — serving replica-pool lifecycle (a
+      dead replica's in-flight groups re-dispatch to survivors)
     """
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._counts: Dict[str, int] = {}
-
-    def bump(self, key: str, n: int = 1) -> None:
-        with self._lock:
-            self._counts[key] = self._counts.get(key, 0) + n
-
-    def get(self, key: str) -> int:
-        with self._lock:
-            return self._counts.get(key, 0)
-
-    def reset(self) -> None:
-        with self._lock:
-            self._counts.clear()
-
-    def snapshot(self) -> Dict[str, int]:
-        with self._lock:
-            return dict(sorted(self._counts.items()))
 
 
 reliability_counters = ReliabilityCounters()

@@ -31,6 +31,9 @@ Sites (consumed where the seam lives):
   killed thread would. The consumer detects and restarts it.
 - ``worker_death`` — the serving worker thread dies; ``submit`` detects,
   fails in-flight futures, and restarts it.
+- ``replica_death`` — one serving replica's completion thread dies; its
+  in-flight flush groups re-queue and re-dispatch to the surviving
+  replicas (a fully dead pool revives itself). Zero stranded futures.
 
 Counts (``oom:1``) fire on the first N checks of the site; probabilities
 (``io:0.05``) draw from a per-site ``random.Random`` seeded from

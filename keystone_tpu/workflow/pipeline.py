@@ -403,7 +403,9 @@ class Pipeline:
     def __call__(self, data) -> "PipelineDataset":
         return self.apply(data)
 
-    def apply_batches(self, batches, prefetch_depth: Optional[int] = None):
+    def apply_batches(
+        self, batches, prefetch_depth: Optional[int] = None, engine=None
+    ):
         """Stream row batches through the pipeline with ingest overlap.
 
         ``batches`` is any iterable of ``(features, labels-or-None)`` pairs
@@ -416,11 +418,24 @@ class Pipeline:
         path. Yields ``(transformed_batch, labels)`` in source order —
         the out-of-core scoring/featurization loop of the streamed
         pipelines.
+
+        ``engine`` takes a ``workflow.serving.CompiledPipeline`` (e.g.
+        ``self.compiled()``) and round-robins batches over its device
+        replica pool instead of executing the graph per batch: up to
+        in-flight × replicas device calls overlap with the prefetcher —
+        the data-parallel offline apply. Requires the serve chain to be
+        linear, jittable, and row-independent (``compiled()`` enforces
+        this); outputs are the padded-bucket executables' and so can
+        differ from graph execution in the last ulp across gemm shapes.
         """
         from contextlib import nullcontext
 
         from keystone_tpu.loaders.stream import prefetched
         from keystone_tpu.utils.metrics import active_tracer
+
+        if engine is not None:
+            yield from engine.apply_batches(batches, prefetch_depth)
+            return
 
         tracer = active_tracer()  # once per stream, like the fault plan
         with prefetched(iter(batches), prefetch_depth) as src:
@@ -482,19 +497,25 @@ class Pipeline:
         # Prune to the subgraph feeding our sink.
         return Pipeline(graph, self.source, self.sink)
 
-    def compiled(self, buckets=None, max_batch=None, donate=None):
+    def compiled(
+        self, buckets=None, max_batch=None, donate=None, devices=None,
+        inflight=None,
+    ):
         """Fit (if needed) and lower to a shape-stable serving engine.
 
         Returns a ``workflow.serving.CompiledPipeline``: call ``warmup()``
         with the traffic's feature shape to AOT-compile the whole bucket
-        ladder before first traffic, then serve mixed-size batches with
-        zero steady-state recompiles. Requires the serve path to be a
-        linear chain of jittable, row-independent transformers.
+        ladder — on every device of the replica pool (``devices=``, env
+        ``KEYSTONE_SERVE_DEVICES``, default all local) — before first
+        traffic, then serve mixed-size batches with zero steady-state
+        recompiles. Requires the serve path to be a linear chain of
+        jittable, row-independent transformers.
         """
         from keystone_tpu.workflow.serving import CompiledPipeline
 
         return CompiledPipeline(
-            self, buckets=buckets, max_batch=max_batch, donate=donate
+            self, buckets=buckets, max_batch=max_batch, donate=donate,
+            devices=devices, inflight=inflight,
         )
 
     # -- introspection -----------------------------------------------------

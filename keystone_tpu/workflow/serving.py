@@ -1,4 +1,5 @@
-"""Shape-stable serving: bucketed batch apply with AOT warmup.
+"""Shape-stable serving: bucketed batch apply with AOT warmup, served
+from a multi-device replica pool with pipelined dispatch.
 
 Fitted pipelines are *applied* far more often than they are fit, and the
 north-star workload is request traffic whose batch sizes vary per call. A
@@ -10,16 +11,39 @@ outputs, run ONE ahead-of-time compiled executable per bucket, and slice
 the result (arXiv:1810.09868 AOT compilation; arXiv:2206.14148 bounded
 shapes).
 
+The training side already spans the whole local mesh; serving does too:
+
+- **Replica pool** — ``CompiledPipeline`` AOT-warms the bucket ladder
+  once per device (``devices=`` / ``KEYSTONE_SERVE_DEVICES``, default all
+  local devices), each replica owning its own compiled executables. One
+  controller dispatches to many devices (arXiv:2112.09017's
+  single-controller pattern); the offline batch path maps batches over
+  the same pool (the arXiv:2403.07128 map-over-devices shape).
+- **Pipelined dispatch** — the micro-batcher's dispatcher picks the
+  least-outstanding replica (round-robin on ties) and launches the
+  device call WITHOUT waiting for it: JAX async dispatch returns as soon
+  as the work is enqueued, so replica B computes while replica A's
+  results are still materializing. A bounded in-flight window
+  (``KEYSTONE_SERVE_INFLIGHT``, default 2 per replica) stops the
+  dispatcher from running unboundedly ahead; result slicing and future
+  resolution happen on per-replica completion threads, off the dispatch
+  critical path. A dead replica's in-flight groups re-dispatch to the
+  survivors (fault site ``replica_death``); with ``devices=1`` and
+  window 1 the flush loop is exactly the pre-replica serial path.
+- **Oversize sharding** — batches beyond the top bucket shard across
+  replicas instead of chunking serially through one device.
+
 Three layers, outermost first:
 
 - ``PipelineService`` — a micro-batcher: concurrent ``submit()`` calls
   coalesce into one bucketed device call (the serving analog of the
   reference's per-partition map — amortize dispatch across requests).
 - ``CompiledPipeline`` — the per-process serving engine: bucket ladder,
-  mask-safe padding, AOT warmup of every bucket before first traffic,
-  donated input buffers on the hot call, host-in/host-out so the steady
-  state issues NO jax operations beyond the pre-compiled executable
-  (zero steady-state recompiles, measured by tools/bench_serve.py).
+  mask-safe padding, AOT warmup of every bucket on every replica before
+  first traffic, donated input buffers on the hot call, host-in/host-out
+  so the steady state issues NO jax operations beyond the pre-compiled
+  executables (zero steady-state recompiles, measured by
+  tools/bench_serve.py).
 - ``bucketed_call`` — the in-graph wiring: ``Transformer.batch_call``
   routes through it when ``config.serve_buckets`` is non-empty (env
   ``KEYSTONE_SERVE_BUCKETS``), so executor-driven applies and
@@ -35,6 +59,7 @@ with ``RowDependenceError`` instead of silently corrupting outputs.
 
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
 import time
@@ -64,15 +89,21 @@ from keystone_tpu.utils.reliability import (
 logger = logging.getLogger("keystone_tpu")
 
 # Registry-backed serving health metrics (utils/metrics.MetricsRegistry):
-# per-device-call and end-to-end submit latency histograms plus
-# queue-depth / in-flight gauges. Always on — one clock read and a locked
-# bucket increment per REQUEST (not per row), noise against a device call
-# — so `MetricsRegistry.snapshot()` reports serving p50/p95/p99 without
-# anyone having had to pre-arm tracing before the incident.
+# per-device-call and end-to-end submit latency histograms. Always on —
+# one clock read and a locked bucket increment per REQUEST (not per row),
+# noise against a device call — so `MetricsRegistry.snapshot()` reports
+# serving p50/p95/p99 without anyone having had to pre-arm tracing before
+# the incident. These two are deliberately PROCESS-WIDE aggregates (every
+# engine/service records into them); per-instance metrics — queue depth,
+# in-flight, per-replica outstanding, dispatch balance, request outcomes —
+# are namespaced ``base[instance]`` so two services in one process never
+# overwrite each other's readings.
 request_latency = metrics_registry.histogram("serve.request_latency")
 e2e_latency = metrics_registry.histogram("serve.e2e_latency")
-queue_depth_gauge = metrics_registry.gauge("serve.queue_depth")
-inflight_gauge = metrics_registry.gauge("serve.inflight")
+
+#: Process-wide instance sequencers behind the per-instance metric names.
+_engine_seq = itertools.count()
+_service_seq = itertools.count()
 
 
 class RowDependenceError(TypeError):
@@ -118,6 +149,48 @@ def bucket_for(n: int, ladder: Sequence[int]) -> Optional[int]:
         if n <= b:
             return b
     return None
+
+
+def resolve_serve_devices(devices=None) -> tuple:
+    """The replica pool's devices: an explicit jax-device sequence, an int
+    replica count (prefix of the local devices), or None →
+    ``config.serve_devices`` (env ``KEYSTONE_SERVE_DEVICES``; 0 = every
+    local device)."""
+    if devices is None:
+        devices = config.serve_devices
+    if isinstance(devices, int):
+        local = jax.local_devices()
+        if devices == 0:
+            return tuple(local)
+        if devices < 1:
+            raise ValueError(
+                f"devices must be >= 1 (or 0 = all local), got {devices}"
+            )
+        if devices > len(local):
+            raise ValueError(
+                f"devices={devices} exceeds the {len(local)} local devices"
+            )
+        return tuple(local[:devices])
+    devs = tuple(devices)
+    if not devs:
+        raise ValueError("devices sequence must not be empty")
+    return devs
+
+
+def _least_outstanding(n, cursor, outstanding, eligible=None):
+    """THE dispatch policy, shared by the engine (direct calls, oversize
+    sharding) and the service (slot-capped, dead-skipping): the index
+    minimizing ``outstanding(i)`` among ``eligible(i)`` replicas, scanned
+    round-robin from ``cursor`` so ties rotate and a sequential caller
+    still covers the whole pool. None when nothing is eligible."""
+    best = None
+    for k in range(n):
+        i = (cursor + k) % n
+        if eligible is not None and not eligible(i):
+            continue
+        if best is None or outstanding(i) < outstanding(best):
+            best = i
+    return best
 
 
 def _jit_cache_size(jit_fn) -> int:
@@ -240,7 +313,7 @@ def bucketed_call(transformer, X):
 
 
 # ---------------------------------------------------------------------------
-# CompiledPipeline — AOT-warmed bucketed serving engine
+# CompiledPipeline — AOT-warmed bucketed serving engine over a replica pool
 # ---------------------------------------------------------------------------
 
 
@@ -265,22 +338,159 @@ def _serving_transformer(target):
     raise TypeError(f"cannot serve a {type(target).__name__}")
 
 
+class _Replica:
+    """One device's slice of the serving pool: its own AOT-compiled
+    executables plus launch accounting (outstanding = launched chunks not
+    yet materialized; dispatches = the balance evidence)."""
+
+    __slots__ = ("index", "device", "executables", "outstanding",
+                 "dispatches")
+
+    def __init__(self, index: int, device):
+        self.index = index
+        self.device = device
+        self.executables: dict = {}
+        self.outstanding = 0
+        self.dispatches = 0
+
+
+class _Launched:
+    """A chunk in flight on one replica: the un-materialized device output
+    and everything the completion side needs to slice and attribute it."""
+
+    __slots__ = ("replica", "out", "m", "b", "t0")
+
+    def __init__(self, replica, out, m, b, t0):
+        self.replica = replica
+        self.out = out
+        self.m = m
+        self.b = b
+        self.t0 = t0
+
+
+class _AsyncResult:
+    """Handle for an asynchronously served batch: chunks launch up to a
+    bounded window ahead (riding JAX async dispatch), ``wait()``
+    materializes them in source order and concatenates. With one replica
+    and window 1 this is exactly the serial launch→materialize loop."""
+
+    __slots__ = ("_cp", "_X", "_pin", "_window", "_starts", "_next",
+                 "_launched", "_outs", "_result", "_done", "_exc", "_t0")
+
+    def __init__(self, cp: "CompiledPipeline", X: np.ndarray,
+                 pin: Optional[int], window: int, t0: float):
+        self._cp = cp
+        self._X = X
+        self._pin = pin
+        self._t0 = t0
+        self._window = max(1, int(window))
+        self._starts = list(range(0, X.shape[0], cp.max_batch))
+        self._next = 0
+        self._launched: deque = deque()
+        self._outs: list = []
+        self._result = None
+        self._done = False
+        self._exc: Optional[BaseException] = None
+        self._fill()
+
+    def _fill(self) -> None:
+        while (
+            self._next < len(self._starts)
+            and len(self._launched) < self._window
+        ):
+            s = self._starts[self._next]
+            chunk = self._X[s : s + self._cp.max_batch]
+            self._launched.append(self._cp._launch_chunk(chunk, self._pin))
+            self._next += 1
+
+    def wait(self):
+        """Block until every chunk has materialized; returns the host
+        (numpy) result sliced to the real row count. Idempotent: repeat
+        calls return the same result — or re-raise the same failure."""
+        if self._done:
+            if self._exc is not None:
+                raise self._exc
+            return self._result
+        try:
+            while self._launched:
+                self._outs.append(
+                    self._cp._complete_chunk(self._launched.popleft())
+                )
+                self._fill()
+        except BaseException as e:
+            # A failed chunk must not leak the OTHER launched chunks'
+            # replica slots — least-outstanding dispatch would forever
+            # see the replica as busy.
+            self.abandon()
+            self._exc = e
+            raise
+        if len(self._outs) == 1:
+            self._result = self._outs[0]
+        else:
+            self._result = jax.tree_util.tree_map(
+                lambda *xs: np.concatenate(xs, axis=0), *self._outs
+            )
+        self._outs = []
+        self._X = None  # free the input batch
+        self._done = True
+        # Every engine-served batch lands in the always-on histogram —
+        # the pipelined service path included, which never goes through
+        # __call__. Boundaries: call_async entry (post-warmup) →
+        # materialized, matching what an external caller times around a
+        # synchronous cp(X).
+        request_latency.record(time.perf_counter() - self._t0)
+        return self._result
+
+    def abandon(self) -> None:
+        """Discard the result WITHOUT materializing: releases the replica
+        slots of launched-but-unfinished chunks (the device work itself
+        is dropped — safe, the serve chain is pure). Used when the owner
+        of this handle dies (replica death, close) so the engine's
+        least-outstanding accounting doesn't leak busy slots forever.
+        Not thread-safe against a concurrent ``wait()`` — only the
+        handle's owner may call it."""
+        if self._done:
+            return
+        while self._launched:
+            self._cp._release_slot(self._launched.popleft())
+        self._outs = []
+        self._X = None
+        self._result = None
+        self._done = True
+
+    def __del__(self):
+        # A dropped handle (caller raised between call_async and wait, or
+        # just discarded it) must not leak its replica slots when the GC
+        # collects it. Idempotent via _done; errors at interpreter
+        # teardown are swallowed.
+        try:
+            self.abandon()
+        except Exception:
+            pass
+
+
 class CompiledPipeline:
-    """A fitted pipeline compiled for shape-stable serving.
+    """A fitted pipeline compiled for shape-stable serving on a pool of
+    device replicas.
 
     - Rounds incoming batches up the bucket ladder, pads with mask-safe
       rows (the last real row, replicated — numerically inert for
       row-independent chains and immune to 0-row pathologies like
       divide-by-norm), runs the bucket's pre-compiled executable, slices.
-    - ``warmup()`` AOT-compiles the WHOLE ladder via
-      ``jit(...).lower(spec).compile()`` before first traffic.
+    - ``warmup()`` AOT-compiles the WHOLE ladder — on EVERY replica — via
+      ``jit(...).lower(spec).compile()`` before first traffic, lowering
+      each replica's executables against its own device sharding.
     - Donates the padded input buffer on the hot call (we own it — it was
       built by padding — so donation is always safe; auto-disabled on CPU
       where XLA ignores it).
     - Host-in/host-out: padding is numpy, results come back as numpy. The
       steady state therefore issues zero jax tracing/compile work — only
-      pre-compiled executable calls. Oversize batches chunk through the
-      top bucket.
+      pre-compiled executable calls. Oversize batches shard across the
+      replica pool (least-outstanding, round-robin on ties) instead of
+      chunking serially through one device.
+    - ``call_async()`` returns a handle without waiting for the device —
+      the dispatch primitive the micro-batcher and the offline
+      ``apply_batches`` data-parallel path pipeline on.
     """
 
     def __init__(
@@ -289,6 +499,9 @@ class CompiledPipeline:
         buckets: Optional[Sequence[int]] = None,
         max_batch: Optional[int] = None,
         donate: Optional[bool] = None,
+        devices=None,
+        inflight: Optional[int] = None,
+        name: Optional[str] = None,
     ):
         self.transformer = _serving_transformer(target)
         check_row_independent(self.transformer)
@@ -301,7 +514,21 @@ class CompiledPipeline:
             self.transformer.apply_batch,
             donate_argnums=(0,) if self.donate else (),
         )
-        self._executables: dict = {}
+        self.devices = resolve_serve_devices(devices)
+        self.replicas = [
+            _Replica(i, d) for i, d in enumerate(self.devices)
+        ]
+        # `is None`, not truthiness: an explicit inflight=0 must error.
+        self.inflight = int(
+            config.serve_inflight if inflight is None else inflight
+        )
+        if self.inflight < 1:
+            raise ValueError(f"inflight must be >= 1, got {self.inflight}")
+        # Auto names are process-unique; registry entries live for the
+        # process, so a caller that constructs engines repeatedly should
+        # pass a stable ``name`` (an explicit aggregation key — same name
+        # = shared dispatch counters/gauges) to bound metric cardinality.
+        self.name = name or f"cp{next(_engine_seq)}"
         self.feature_shape: Optional[Tuple[int, ...]] = None
         self._dtype = None
         self.compile_count = 0
@@ -311,6 +538,17 @@ class CompiledPipeline:
         self.compiles_by_bucket: dict = {}
         self.warmup_seconds: Optional[float] = None
         self._lock = threading.Lock()
+        self._rr = 0  # round-robin cursor breaking least-outstanding ties
+        # Per-instance registry metrics: dispatch balance across the pool
+        # and each replica's outstanding-launch gauge, tagged with the
+        # engine name and device id so multiple engines coexist.
+        self._dispatch_counters = metrics_registry.counters(
+            f"serve.dispatch[{self.name}]"
+        )
+        self._out_gauges = [
+            metrics_registry.gauge(f"serve.outstanding[{self.name}:d{d.id}]")
+            for d in self.devices
+        ]
         # Resolved ONCE per engine (the active_plan discipline): tracing
         # disabled = a None check on the hot call, nothing more.
         self._tracer = active_tracer()
@@ -324,7 +562,8 @@ class CompiledPipeline:
     def warmup(
         self, example: Union[Tuple[int, ...], Any], dtype=None
     ) -> "CompiledPipeline":
-        """AOT-compile every bucket before first traffic.
+        """AOT-compile every bucket, on every replica, before first
+        traffic.
 
         ``example`` is either the per-row feature shape (a tuple of ints)
         or a sample batch (leading axis = rows) whose ``shape[1:]``/dtype
@@ -354,31 +593,132 @@ class CompiledPipeline:
                 and (self.feature_shape, self._dtype) != (feature_shape, dt)
             ):
                 # New traffic signature: previous executables can't serve it.
-                self._executables.clear()
+                for r in self.replicas:
+                    r.executables.clear()
             self.feature_shape, self._dtype = feature_shape, dt
             t0 = time.perf_counter()
-            for b in self.ladder:
-                if b not in self._executables:
-                    self._compile_bucket(b)
+            for r in self.replicas:
+                for b in self.ladder:
+                    if b not in r.executables:
+                        self._compile_bucket(r, b)
             self.warmup_seconds = time.perf_counter() - t0
         return self
 
-    def _compile_bucket(self, b: int):
-        """Lower + compile one bucket's executable (caller holds the lock or
-        is single-threaded setup code)."""
+    def _compile_bucket(self, replica: _Replica, b: int):
+        """Lower + compile one bucket's executable for one replica's
+        device (caller holds the lock or is single-threaded setup code)."""
         spec = jax.ShapeDtypeStruct(
-            (b,) + self.feature_shape, self._dtype
+            (b,) + self.feature_shape,
+            self._dtype,
+            sharding=jax.sharding.SingleDeviceSharding(replica.device),
         )
-        self._executables[b] = self._jit.lower(spec).compile()
+        replica.executables[b] = self._jit.lower(spec).compile()
         self.compile_count += 1
         self.compiles_by_bucket[b] = self.compiles_by_bucket.get(b, 0) + 1
         serving_counters.record_compile(b)
-        return self._executables[b]
+        return replica.executables[b]
 
     # -- hot path ----------------------------------------------------------
 
-    def __call__(self, X):
-        """Serve one batch: returns numpy, sliced to the real row count."""
+    def _pick_replica_locked(self) -> _Replica:
+        """Least-outstanding replica, ties broken round-robin (caller
+        holds the lock)."""
+        n = len(self.replicas)
+        idx = _least_outstanding(
+            n, self._rr, lambda i: self.replicas[i].outstanding
+        )
+        self._rr = (idx + 1) % n
+        return self.replicas[idx]
+
+    def _launch_chunk(
+        self, chunk: np.ndarray, pin: Optional[int] = None
+    ) -> _Launched:
+        """Pad one ≤max_batch chunk onto its bucket and launch it on a
+        replica (``pin`` overrides the least-outstanding pick). Returns
+        without waiting: JAX async dispatch hands back un-materialized
+        device arrays."""
+        m = chunk.shape[0]
+        b = bucket_for(m, self.ladder)
+        if m != b:
+            pad = np.broadcast_to(chunk[-1:], (b - m,) + chunk.shape[1:])
+            chunk = np.concatenate([chunk, pad], axis=0)
+        with self._lock:
+            r = (
+                self.replicas[pin] if pin is not None
+                else self._pick_replica_locked()
+            )
+            ex = r.executables.get(b)
+            if ex is None:  # cold bucket (warmup skipped): counted miss
+                ex = self._compile_bucket(r, b)
+            r.outstanding += 1
+            r.dispatches += 1
+            # Gauge published under the lock: value capture and set stay
+            # ordered, so concurrent launch/complete can't publish stale
+            # readings out of order and leave the gauge stuck.
+            self._out_gauges[r.index].set(r.outstanding)
+        self._dispatch_counters.bump(f"d{r.device.id}")
+        tr = self._tracer
+        t0 = tr.now() if tr is not None else 0
+        try:
+            out = ex(chunk)
+        except BaseException:
+            # A failed launch (e.g. transient RESOURCE_EXHAUSTED) has no
+            # _Launched record for abandon() to release — undo the slot
+            # here or the replica reads busier forever.
+            with self._lock:
+                r.outstanding -= 1
+                self._out_gauges[r.index].set(r.outstanding)
+            raise
+        serving_counters.record_call(b, m)
+        return _Launched(r, out, m, b, t0)
+
+    def _release_slot(self, lc: _Launched) -> None:
+        """Release one launched chunk's replica slot without touching its
+        result (the abandon path — see ``_AsyncResult.abandon``)."""
+        with self._lock:
+            lc.replica.outstanding -= 1
+            self._out_gauges[lc.replica.index].set(lc.replica.outstanding)
+
+    def _complete_chunk(self, lc: _Launched):
+        """Materialize one launched chunk: block on the transfer, slice to
+        the real rows on host, release the replica slot, and close the
+        ``serve.device`` span (launch → materialized) tagged with the
+        device that served it."""
+        # np.asarray blocks on the transfer, so latency measurements around
+        # launch+complete see the true device time; slicing is host-side.
+        try:
+            out = jax.tree_util.tree_map(
+                lambda a: np.asarray(a)[: lc.m], lc.out
+            )
+        except BaseException:
+            self._release_slot(lc)  # a failed chunk must not leak its slot
+            raise
+        with self._lock:
+            lc.replica.outstanding -= 1
+            self._out_gauges[lc.replica.index].set(lc.replica.outstanding)
+        tr = self._tracer
+        if tr is not None:
+            tr.record(
+                "serve.device", "serving", lc.t0, rows=lc.m, bucket=lc.b,
+                device=lc.replica.device.id, replica=lc.replica.index,
+            )
+        return out
+
+    def call_async(
+        self,
+        X,
+        replica: Optional[int] = None,
+        window: Optional[int] = None,
+    ) -> _AsyncResult:
+        """Launch a batch without waiting for the device: returns an
+        ``_AsyncResult`` whose ``wait()`` yields the numpy output.
+
+        Chunks beyond the top bucket shard across the replica pool
+        (least-outstanding); ``replica=i`` pins every chunk to one
+        replica — the micro-batcher's dispatcher uses this so its
+        in-flight window is attributable per replica. ``window`` bounds
+        how many chunks ride async dispatch at once (default: the
+        engine's per-replica in-flight window × the replicas in play)."""
         if self.feature_shape is None:
             # Lazy warmup off the first request's signature: correct, but
             # the first-traffic latency pays the whole ladder. Call
@@ -391,56 +731,81 @@ class CompiledPipeline:
                 f"request feature shape {X.shape[1:]} != warmed shape "
                 f"{self.feature_shape}; re-warm the pipeline for new traffic"
             )
-        n = X.shape[0]
-        if n == 0:
+        if X.shape[0] == 0:
             raise ValueError("cannot serve an empty batch")
-        outs = []
-        for start in range(0, n, self.max_batch):
-            chunk = X[start : min(start + self.max_batch, n)]
-            outs.append(self._serve_chunk(chunk))
-        if len(outs) == 1:
-            out = outs[0]
-        else:
-            out = jax.tree_util.tree_map(
-                lambda *xs: np.concatenate(xs, axis=0), *outs
+        if replica is not None and not 0 <= replica < len(self.replicas):
+            raise ValueError(
+                f"replica {replica} out of range for a "
+                f"{len(self.replicas)}-replica pool"
             )
-        # Boundaries match what an external caller times around this call,
-        # so the registry's percentiles agree with bench_serve's.
-        request_latency.record(time.perf_counter() - t0)
-        return out
+        if window is None:
+            window = self.inflight * (
+                1 if replica is not None else len(self.replicas)
+            )
+        return _AsyncResult(self, X, replica, window, t0)
 
-    def _serve_chunk(self, chunk: np.ndarray):
-        m = chunk.shape[0]
-        b = bucket_for(m, self.ladder)
-        if m != b:
-            pad = np.broadcast_to(chunk[-1:], (b - m,) + chunk.shape[1:])
-            chunk = np.concatenate([chunk, pad], axis=0)
-        ex = self._executables.get(b)
-        if ex is None:
-            with self._lock:
-                ex = self._executables.get(b)
-                if ex is None:  # cold bucket (warmup skipped): counted miss
-                    ex = self._compile_bucket(b)
-        tr = self._tracer
-        t0 = tr.now() if tr is not None else 0
-        out = ex(chunk)
-        serving_counters.record_call(b, m)
-        # np.asarray blocks on the transfer, so latency measurements around
-        # this call see the true device time; slicing happens on host.
-        out = jax.tree_util.tree_map(lambda a: np.asarray(a)[:m], out)
-        if tr is not None:
-            tr.record("serve.device", "serving", t0, rows=m, bucket=b)
-        return out
+    def __call__(self, X):
+        """Serve one batch synchronously: returns numpy, sliced to the
+        real row count. The handle's wait() records the always-on
+        ``serve.request_latency`` sample (boundaries match an external
+        stopwatch around this call, so registry and bench percentiles
+        agree)."""
+        return self.call_async(X).wait()
+
+    # -- offline data parallelism -----------------------------------------
+
+    def apply_batches(
+        self,
+        batches,
+        prefetch_depth: Optional[int] = None,
+        window: Optional[int] = None,
+    ):
+        """Stream ``(X, labels-or-None)`` pairs (or bare batches) through
+        the replica pool with a bounded async window: up to ``window``
+        batches (default in-flight × replicas) are in flight at once, so
+        out-of-core scoring overlaps N device calls with the PR-1
+        prefetcher instead of serializing through one device. Yields
+        ``(transformed, labels)`` in source order."""
+        from keystone_tpu.loaders.stream import prefetched
+
+        if window is None:
+            window = self.inflight * len(self.replicas)
+        window = max(1, int(window))
+        pending: deque = deque()
+        with prefetched(iter(batches), prefetch_depth) as src:
+            for item in src:
+                if isinstance(item, tuple) and len(item) == 2:
+                    X, y = item
+                else:
+                    X, y = item, None
+                pending.append((self.call_async(np.asarray(X)), y))
+                if len(pending) >= window:
+                    handle, y0 = pending.popleft()
+                    yield handle.wait(), y0
+            while pending:
+                handle, y0 = pending.popleft()
+                yield handle.wait(), y0
 
     def stats(self) -> dict:
         return {
+            "name": self.name,
             "ladder": list(self.ladder),
+            "devices": [d.id for d in self.devices],
+            "inflight": self.inflight,
             "compile_count": self.compile_count,
             "compiles_by_bucket": dict(sorted(
                 self.compiles_by_bucket.items()
             )),
             "warmup_seconds": self.warmup_seconds,
             "donate": self.donate,
+            # Dispatch-balance evidence: chunks launched per replica. The
+            # registry mirror is serve.dispatch[<name>].
+            "replica_dispatches": {
+                f"d{r.device.id}": r.dispatches for r in self.replicas
+            },
+            "replica_outstanding": {
+                f"d{r.device.id}": r.outstanding for r in self.replicas
+            },
             # Explicitly process-wide (every engine records into the one
             # registry histogram); per-engine latency needs one engine per
             # process or the trace's serve.device spans.
@@ -449,22 +814,42 @@ class CompiledPipeline:
 
 
 # ---------------------------------------------------------------------------
-# PipelineService — request coalescing micro-batcher
+# PipelineService — request coalescing micro-batcher over the replica pool
 # ---------------------------------------------------------------------------
 
 
+class _FlightRec:
+    """A flush group launched on a replica, awaiting completion."""
+
+    __slots__ = ("live", "handle", "t_flush", "rows")
+
+    def __init__(self, live, handle, t_flush, rows):
+        self.live = live
+        self.handle = handle
+        self.t_flush = t_flush
+        self.rows = rows
+
+
 class PipelineService:
-    """Coalesces concurrent small requests into one bucketed device call.
+    """Coalesces concurrent small requests into bucketed device calls,
+    pipelined across the engine's replica pool.
 
     ``submit(x)`` returns a ``concurrent.futures.Future``. A background
-    worker drains the request queue: it takes the oldest request, then
+    dispatcher drains the request queue: it takes the oldest request, then
     keeps absorbing queued requests until the flush would exceed
     ``max_rows`` or ``max_delay_ms`` has passed since the flush group
-    opened, concatenates them into one batch, runs the warmed
-    ``CompiledPipeline`` once, and splits the result back per-request.
-    Under load the delay never waits — the queue is non-empty, so flushes
-    are back-to-back full buckets; the delay only bounds the latency a
-    lone request pays waiting for company.
+    opened, concatenates them into one batch, and launches it on the
+    least-outstanding replica (round-robin on ties) WITHOUT waiting for
+    the device — JAX async dispatch returns as soon as the call is
+    enqueued, so the dispatcher immediately forms the next group while
+    per-replica completion threads materialize results, slice them back
+    per-request, and resolve the futures. A bounded in-flight window
+    (``inflight`` / ``KEYSTONE_SERVE_INFLIGHT``, default 2 per replica)
+    keeps the dispatcher from running unboundedly ahead. With one replica
+    and window 1 the service runs the exact pre-pipelining serial flush
+    loop (pinned by tests). Under load the delay never waits — the queue
+    is non-empty, so flushes are back-to-back full buckets; the delay only
+    bounds the latency a lone request pays waiting for company.
 
     Hardened for sustained overload (utils/reliability.py):
 
@@ -477,13 +862,17 @@ class PipelineService:
       (per-submit ``deadline_ms``, default ``config.serve_deadline_ms``)
       fails its future with ``DeadlineExceeded`` before wasting a device
       call on an answer nobody is waiting for.
-    - **Worker-death detection.** If the worker thread dies (a bug, or
-      the harness's ``worker_death`` site), the next ``submit`` fails the
-      dead worker's in-flight futures with ``WorkerDiedError``, restarts
-      the worker, and the queue drains normally.
+    - **Worker-death detection.** If the dispatcher thread dies (a bug,
+      or the harness's ``worker_death`` site), the next ``submit`` fails
+      the dead dispatcher's un-launched futures with ``WorkerDiedError``,
+      restarts it, and the queue drains normally.
+    - **Replica-death re-dispatch.** If a replica dies (the harness's
+      ``replica_death`` site), its in-flight groups re-queue at the front
+      of the pending queue and re-dispatch to the surviving replicas — no
+      future is stranded. If every replica is dead the pool is revived.
     - **A close() that never strands a future.** ``close()`` drains by
       default (``drain=False`` rejects immediately); either way every
-      future still unresolved when the worker is gone is failed with
+      future still unresolved when the workers are gone is failed with
       ``ServiceClosed`` — no caller ever blocks forever on ``result()``.
 
     Requires a warmed pipeline: warmup belongs before first traffic, not
@@ -502,6 +891,8 @@ class PipelineService:
         max_rows: Optional[int] = None,
         max_pending: Optional[int] = None,
         deadline_ms: Optional[float] = None,
+        inflight: Optional[int] = None,
+        name: Optional[str] = None,
     ):
         if compiled.feature_shape is None:
             raise RuntimeError(
@@ -524,6 +915,14 @@ class PipelineService:
         self.default_deadline_s = (
             deadline_ms if deadline_ms is not None else config.serve_deadline_ms
         ) / 1e3
+        self.inflight_limit = int(
+            config.serve_inflight if inflight is None else inflight
+        )
+        if self.inflight_limit < 1:
+            raise ValueError(
+                f"inflight must be >= 1, got {self.inflight_limit}"
+            )
+        self.name = name or f"svc{next(_service_seq)}"
         self._plan = active_plan()
         self._tracer = active_tracer()  # resolved once per service
         # Per-SERVICE latency/depth (the process-global registry metrics
@@ -531,8 +930,22 @@ class PipelineService:
         # read each other's numbers off their own stats()).
         self._e2e = LatencyHistogram()
         self._depth_max = 0
+        # Per-instance registry metrics: namespaced on the service name so
+        # two services never get-or-create (and overwrite) the same gauge.
+        self._queue_gauge = metrics_registry.gauge(
+            f"serve.queue_depth[{self.name}]"
+        )
+        self._inflight_gauge = metrics_registry.gauge(
+            f"serve.inflight[{self.name}]"
+        )
+        # Outcome-tagged request accounting (ok / expired / rejected /
+        # error / closed): overload analyses read rejected+expired from
+        # the registry instead of being blind to failed work.
+        self._outcomes = metrics_registry.counters(
+            f"serve.requests[{self.name}]"
+        )
         self._pending: deque = deque()
-        self._inflight: list = []  # futures of the group being flushed
+        self._inflight: list = []  # futures popped but not yet launched
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._closed = False
@@ -542,11 +955,49 @@ class PipelineService:
         self.rejected = 0
         self.expired = 0
         self.worker_restarts = 0
+        self.replica_deaths = 0
+        self.replica_revivals = 0
+        # Replica-pool dispatch state. Engines without a pool (or wrapped
+        # engines that hide call_async) serve through the serial path.
+        replicas = getattr(compiled, "replicas", None)
+        self._n_replicas = len(replicas) if replicas else 1
+        self._pipelined = (
+            (self._n_replicas > 1 or self.inflight_limit > 1)
+            and callable(getattr(compiled, "call_async", None))
+        )
+        self._rr = 0
+        self._outstanding = [0] * self._n_replicas
+        self._dead = [False] * self._n_replicas
+        # One lock, TWO wait-sets: the dispatcher waits on self._cv
+        # (pending work / free slots), each replica's completion thread on
+        # its own condition — a submit's notify() must never be consumed
+        # by a completer while the dispatcher sleeps on (lost wakeup).
+        self._ccvs = [
+            threading.Condition(self._lock)
+            for _ in range(self._n_replicas)
+        ]
+        self._cqueues: list = [deque() for _ in range(self._n_replicas)]
+        self._cq_active: list = [None] * self._n_replicas
+        self._completers: list = []
+        # Worker first: completion threads poll self._worker liveness for
+        # their exit condition, so it must exist before they start.
         self._worker = self._spawn_worker()
+        if self._pipelined:
+            self._completers = [
+                self._spawn_completer(r) for r in range(self._n_replicas)
+            ]
 
     def _spawn_worker(self) -> threading.Thread:
         t = threading.Thread(
             target=self._loop, name="keystone-serve", daemon=True
+        )
+        t.start()
+        return t
+
+    def _spawn_completer(self, r: int) -> threading.Thread:
+        t = threading.Thread(
+            target=self._complete_loop, args=(r,),
+            name=f"keystone-serve-complete-{r}", daemon=True,
         )
         t.start()
         return t
@@ -584,11 +1035,13 @@ class PipelineService:
             if self._closed:
                 raise ServiceClosed("PipelineService is closed")
             self._ensure_worker_locked()
+            self._revive_dead_locked()
             if len(self._pending) >= self.max_pending:
                 # Fast-fail backpressure: reject NOW, at zero device cost,
                 # instead of queueing latency the client will time out on.
                 self.rejected += 1
                 reliability_counters.bump("requests_rejected")
+                self._outcomes.bump("rejected")
                 if self._tracer is not None:
                     self._tracer.instant(
                         "serve.rejected", "serving", rows=int(x.shape[0])
@@ -600,16 +1053,17 @@ class PipelineService:
             self._pending.append((x, datum, fut, deadline, t_sub))
             self.requests += 1
             depth = len(self._pending)
-            queue_depth_gauge.set(depth)
+            self._queue_gauge.set(depth)
             if depth > self._depth_max:
                 self._depth_max = depth
             self._cv.notify()
         return fut
 
     def _ensure_worker_locked(self) -> None:
-        """Detect a dead worker (caller holds the lock): fail whatever it
-        had in flight — those futures can never resolve — and restart it
-        so the queued work drains."""
+        """Detect a dead dispatcher (caller holds the lock): fail whatever
+        it had popped but not launched — those futures can never resolve —
+        and restart it so the queued work drains. Groups already launched
+        belong to the completion threads and survive the restart."""
         if self._worker.is_alive():
             return
         dead = [f for f in self._inflight if not f.done()]
@@ -640,19 +1094,32 @@ class PipelineService:
         return deadline is not None and time.monotonic() > deadline
 
     def _fail_expired(self, entry) -> None:
+        if not self._resolve(
+            entry[2],
+            exc=DeadlineExceeded(
+                "request deadline passed before the device ran it"
+            ),
+        ):
+            return  # another path got there first: don't double-count
         self.expired += 1
         reliability_counters.bump("deadline_expired")
+        self._outcomes.bump("expired")
         if self._tracer is not None:
             self._tracer.record(
                 "serve.request", "serving", entry[4], outcome="expired",
                 rows=int(entry[0].shape[0]),
             )
-        self._resolve(
-            entry[2],
-            exc=DeadlineExceeded(
-                "request deadline passed before the device ran it"
-            ),
-        )
+
+    def _filter_expired(self, group) -> list:
+        """Deadlines re-checked at flush time: a request can expire while
+        the group waits max_delay for company."""
+        live = []
+        for entry in group:
+            if self._expired(entry):
+                self._fail_expired(entry)
+            else:
+                live.append(entry)
+        return live
 
     def _loop(self):
         while True:
@@ -699,11 +1166,12 @@ class PipelineService:
                     self._cv.wait(remaining)
                 # Gauge updated even when everything popped had expired
                 # (group empty): the queue really did shrink.
-                queue_depth_gauge.set(len(self._pending))
+                self._queue_gauge.set(len(self._pending))
                 if not group:
                     continue
                 self._inflight = [e[2] for e in group]
-                inflight_gauge.set(len(group))
+                if not self._pipelined:
+                    self._inflight_gauge.set(len(group))
                 if self._tracer is not None:
                     # Queue residency per request: submit → flush-group pop.
                     now = self._tracer.now()
@@ -712,94 +1180,317 @@ class PipelineService:
                             "serve.queued", "serving", e[4], now,
                             rows=int(e[0].shape[0]),
                         )
-            self._flush(group)
-            with self._cv:
-                self._inflight = []
-                inflight_gauge.set(0)
+            if self._pipelined:
+                self._dispatch(group)
+            else:
+                self._flush(group)
+                with self._cv:
+                    self._inflight = []
+                    self._inflight_gauge.set(0)
 
     @staticmethod
-    def _resolve(fut: Future, value=None, exc=None) -> None:
-        """Resolve a future, tolerating client-side cancellation: a future
-        the client cancelled mid-flight must not poison the rest of its
-        coalesced group (set_result on it raises InvalidStateError)."""
+    def _resolve(fut: Future, value=None, exc=None) -> bool:
+        """Resolve a future, tolerating client-side cancellation and
+        already-resolved futures (a close()-swept group whose stuck
+        completer later finishes): set_result on those raises
+        InvalidStateError, which must not poison the rest of the
+        coalesced group. Returns whether THIS call won the resolution —
+        outcome counters key off it so one request is never counted
+        twice (e.g. both 'closed' and 'ok')."""
         try:
             if exc is not None:
                 fut.set_exception(exc)
             else:
                 fut.set_result(value)
+            return True
         except InvalidStateError:
-            pass
+            return False
+
+    @staticmethod
+    def _concat(live):
+        if len(live) == 1:
+            return live[0][0]
+        return np.concatenate([g[0] for g in live], axis=0)
+
+    def _deliver(self, live, out, tr, t_flush, rows) -> None:
+        """Slice one flush's output back per request and resolve the
+        futures (the completion path, shared by the serial flush and the
+        per-replica completion threads)."""
+        off = 0
+        for x, datum, fut, _deadline, t_sub in live:
+            m = x.shape[0]
+            piece = jax.tree_util.tree_map(
+                lambda a, o=off, m=m: a[o : o + m], out
+            )
+            if datum:
+                piece = jax.tree_util.tree_map(lambda a: a[0], piece)
+            off += m
+            # Latency captured BEFORE resolving (set_result runs client
+            # done-callbacks inline; their cost must not count as serving
+            # latency) but recorded only when this path actually resolved
+            # the future — a request another path already failed (close,
+            # worker death) must not double-count as 'ok'.
+            now_ns = time.perf_counter_ns()
+            if not self._resolve(fut, value=piece):
+                continue
+            self._e2e.record((now_ns - t_sub) / 1e9)
+            e2e_latency.record((now_ns - t_sub) / 1e9)
+            self._outcomes.bump("ok")
+            if tr is not None:
+                tr.record(
+                    "serve.request", "serving", t_sub, now_ns,
+                    outcome="ok", rows=m,
+                )
+        if tr is not None:
+            tr.record(
+                "serve.flush", "serving", t_flush,
+                requests=len(live), rows=rows,
+            )
+
+    def _fail_group(self, live, e, tr) -> None:
+        """Fail every unresolved future in a flush group, keep serving."""
+        for x, _d, fut, _deadline, t_sub in live:
+            if not fut.done() and self._resolve(fut, exc=e):
+                self._outcomes.bump("error")
+                if tr is not None:
+                    tr.record(
+                        "serve.request", "serving", t_sub,
+                        outcome=type(e).__name__, rows=int(x.shape[0]),
+                    )
 
     def _flush(self, group):
-        # Deadlines re-checked at flush time: a request can expire while
-        # the group waits max_delay for company.
-        live = []
-        for entry in group:
-            if self._expired(entry):
-                self._fail_expired(entry)
-            else:
-                live.append(entry)
+        """Serial flush (one replica, window 1): launch AND materialize
+        inline — byte-for-byte the pre-pipelining behavior."""
+        live = self._filter_expired(group)
         if not live:
             return
         tr = self._tracer
         t_flush = tr.now() if tr is not None else 0
         try:
-            if len(live) == 1:
-                X = live[0][0]
-            else:
-                X = np.concatenate([g[0] for g in live], axis=0)
+            X = self._concat(live)
             out = self.compiled(X)
             self.batches_run += 1
             self.rows_served += X.shape[0]
-            off = 0
-            for x, datum, fut, _deadline, t_sub in live:
-                m = x.shape[0]
-                piece = jax.tree_util.tree_map(
-                    lambda a, o=off, m=m: a[o : o + m], out
-                )
-                if datum:
-                    piece = jax.tree_util.tree_map(lambda a: a[0], piece)
-                off += m
-                # Latency stamped BEFORE resolving: set_result runs client
-                # done-callbacks inline, and their cost must not count as
-                # serving latency (for this request or the rest of the
-                # group).
-                now_ns = time.perf_counter_ns()
-                self._e2e.record((now_ns - t_sub) / 1e9)
-                e2e_latency.record((now_ns - t_sub) / 1e9)
-                if tr is not None:
-                    tr.record(
-                        "serve.request", "serving", t_sub, now_ns,
-                        outcome="ok", rows=m,
-                    )
-                self._resolve(fut, value=piece)
-            if tr is not None:
-                tr.record(
-                    "serve.flush", "serving", t_flush,
-                    requests=len(live), rows=int(X.shape[0]),
-                )
+            self._deliver(live, out, tr, t_flush, int(X.shape[0]))
         except Exception as e:  # fail the whole flush group, keep serving
-            for _x, _d, fut, _deadline, t_sub in live:
-                if not fut.done():
-                    self._resolve(fut, exc=e)
-                    if tr is not None:
-                        tr.record(
-                            "serve.request", "serving", t_sub,
-                            outcome=type(e).__name__,
-                        )
+            self._fail_group(live, e, tr)
+
+    # -- pipelined dispatch ------------------------------------------------
+
+    def _pick_slot_locked(self) -> Optional[int]:
+        """A live replica with in-flight room, least-outstanding first and
+        round-robin on ties — or None when the window is full everywhere
+        (caller holds the lock)."""
+        idx = _least_outstanding(
+            self._n_replicas,
+            self._rr,
+            self._outstanding.__getitem__,
+            lambda i: (
+                not self._dead[i]
+                and self._outstanding[i] < self.inflight_limit
+            ),
+        )
+        if idx is not None:
+            self._rr = (idx + 1) % self._n_replicas
+        return idx
+
+    def _dispatch(self, group):
+        """Launch one flush group on a replica without waiting for the
+        device; the replica's completion thread resolves the futures."""
+        live = self._filter_expired(group)
+        tr = self._tracer
+        if not live:
+            with self._cv:
+                self._inflight = []
+                self._cv.notify_all()
+            return
+        with self._cv:
+            while True:
+                r = self._pick_slot_locked()
+                if r is not None:
+                    break
+                self._revive_if_all_dead_locked()
+                r = self._pick_slot_locked()
+                if r is not None:
+                    break
+                # Timed wait: a completion notifies _cv when a slot frees,
+                # but the timeout keeps the revive check live regardless.
+                self._cv.wait(0.1)
+            self._outstanding[r] += 1
+            self._inflight_gauge.set(sum(self._outstanding))
+        # Everything between the slot claim and the completer hand-off
+        # runs under one try: an exception here (concat OOM, launch
+        # failure) must release the slot and fail the group, never kill
+        # the dispatcher with the slot still counted — leaked slots
+        # shrink the window forever and the restart path can't see them.
+        handle = None
+        t_flush = 0
+        rows = 0
+        try:
+            # Deadlines re-checked AFTER the slot wait: under overload
+            # the window can hold a group long enough to expire it, and
+            # the PR-3 contract is that expired requests fail BEFORE the
+            # device call.
+            live = self._filter_expired(live)
+            if live:
+                X = self._concat(live)
+                rows = int(X.shape[0])
+                t_flush = tr.now() if tr is not None else 0
+                # The service's window also bounds the chunk-launch depth
+                # of a multi-chunk (oversize) group: one knob, one value.
+                handle = self.compiled.call_async(
+                    X, replica=r, window=self.inflight_limit
+                )
+        except Exception as e:
+            self._fail_group(live, e, tr)
+            handle = None
+        if handle is None:  # expired-out or failed: slot goes back
+            with self._cv:
+                self._outstanding[r] = max(0, self._outstanding[r] - 1)
+                self._inflight_gauge.set(sum(self._outstanding))
+                self._inflight = []
+                self._cv.notify_all()
+            return
+        rec = _FlightRec(live, handle, t_flush, rows)
+        with self._cv:
+            if self._dead[r]:
+                # The replica died between the slot pick and this enqueue
+                # (its completer already drained the queue and exited):
+                # abandon the launched work and re-queue the group at the
+                # pending front for the survivors — appending to the dead
+                # queue would strand every future in it. The kill path
+                # already zeroed outstanding[r].
+                abandon = getattr(handle, "abandon", None)
+                if abandon is not None:
+                    abandon()
+                for e in reversed(live):
+                    self._pending.appendleft(e)
+                reliability_counters.bump("serve_groups_redispatched")
+                self._queue_gauge.set(len(self._pending))
+                self._inflight = []
+            else:
+                self._cqueues[r].append(rec)
+                self._inflight = []
+                self._ccvs[r].notify()
+
+    def _complete_loop(self, r: int):
+        """Per-replica completion thread: materialize launched groups in
+        order, deliver results, release the in-flight slot. Checks the
+        ``replica_death`` fault site per group — a killed replica
+        re-queues its in-flight groups for the survivors and exits."""
+        while True:
+            with self._ccvs[r]:
+                while not self._cqueues[r]:
+                    if self._dead[r]:
+                        return
+                    if self._closed and not self._worker.is_alive():
+                        return
+                    # Timed wait: dispatch notifies this replica's own
+                    # condition; the timeout re-checks liveness/closure.
+                    self._ccvs[r].wait(0.1)
+                if self._dead[r]:
+                    return
+                if self._plan is not None and self._plan.check(
+                    "replica_death"
+                ):
+                    self._kill_replica_locked(r)
+                    return
+                rec = self._cqueues[r].popleft()
+                self._cq_active[r] = rec
+            tr = self._tracer
+            try:
+                out = rec.handle.wait()
+            except Exception as e:
+                out = None
+                self._fail_group(rec.live, e, tr)
+            if out is not None:
+                try:
+                    with self._lock:
+                        self.batches_run += 1
+                        self.rows_served += rec.rows
+                    self._deliver(rec.live, out, tr, rec.t_flush, rec.rows)
+                except Exception as e:  # never die with futures in hand
+                    self._fail_group(rec.live, e, tr)
+            with self._cv:
+                self._cq_active[r] = None
+                # Clamped: a concurrent kill+revive zeroes the count while
+                # this group was still in flight.
+                self._outstanding[r] = max(0, self._outstanding[r] - 1)
+                self._inflight_gauge.set(sum(self._outstanding))
+                self._cv.notify_all()
+
+    def _kill_replica_locked(self, r: int) -> None:
+        """Mark replica r dead and re-queue its in-flight groups at the
+        FRONT of the pending queue, order-preserved, so the surviving
+        replicas re-dispatch them — zero stranded futures (caller holds
+        the lock; the launched device work is abandoned, which is safe:
+        the serve chain is pure)."""
+        self._dead[r] = True
+        recs = list(self._cqueues[r])
+        self._cqueues[r].clear()
+        entries = [e for rec in recs for e in rec.live]
+        for rec in recs:
+            # Release the engine-level replica slots of the abandoned
+            # launches, or least-outstanding dispatch (direct calls,
+            # apply_batches) would see the dead replica as busy forever.
+            abandon = getattr(rec.handle, "abandon", None)
+            if abandon is not None:
+                abandon()
+        for e in reversed(entries):
+            self._pending.appendleft(e)
+        self._outstanding[r] = 0
+        self.replica_deaths += 1
+        reliability_counters.bump("replica_deaths")
+        if recs:
+            reliability_counters.bump(
+                "serve_groups_redispatched", len(recs)
+            )
+        self._queue_gauge.set(len(self._pending))
+        self._inflight_gauge.set(sum(self._outstanding))
+        logger.warning(
+            "PipelineService %s: replica %d died; %d in-flight group(s) "
+            "(%d request(s)) re-dispatched to survivors",
+            self.name, r, len(recs), len(entries),
+        )
+        self._cv.notify_all()
+
+    def _revive_dead_locked(self) -> None:
+        """Restart any dead replica (caller holds the lock): executables
+        are intact — death is a thread-level condition — so a fresh
+        completion thread restores it. Called at the next ``submit`` (the
+        same detection point as worker death), so a partially dead pool
+        heals instead of serving at reduced capacity forever."""
+        for i in range(self._n_replicas):
+            if not self._dead[i]:
+                continue
+            self._dead[i] = False
+            self._completers[i] = self._spawn_completer(i)
+            self.replica_revivals += 1
+            reliability_counters.bump("replica_revivals")
+            logger.warning(
+                "PipelineService %s: replica %d revived", self.name, i,
+            )
+
+    def _revive_if_all_dead_locked(self) -> None:
+        """The dispatcher's fallback when NO replica is eligible (caller
+        holds the lock): with every replica dead and no submit arriving
+        to heal the pool, revive it here so already-queued work drains."""
+        if not self._dead or not all(self._dead):
+            return
+        self._revive_dead_locked()
 
     # -- lifecycle ---------------------------------------------------------
 
     def close(self, drain: bool = True):
         """Stop the service without stranding a single future.
 
-        ``drain=True`` (default) lets the worker serve what is already
-        queued, then joins it; ``drain=False`` rejects queued requests
-        immediately with ``ServiceClosed``. In BOTH modes, any future
-        still unresolved once the worker is gone — queued behind a dead
-        worker, in flight when the join timed out — is failed with
-        ``ServiceClosed`` rather than left for a caller to block on
-        forever. Idempotent."""
+        ``drain=True`` (default) lets the workers serve what is already
+        queued and in flight, then joins them; ``drain=False`` rejects
+        queued requests immediately with ``ServiceClosed``. In BOTH modes,
+        any future still unresolved once the workers are gone — queued
+        behind a dead worker, in flight when the join timed out — is
+        failed with ``ServiceClosed`` rather than left for a caller to
+        block on forever. Idempotent."""
         rejected: list = []
         with self._cv:
             self._closed = True
@@ -807,22 +1498,41 @@ class PipelineService:
                 rejected = [e[2] for e in self._pending]
                 self._pending.clear()
             self._cv.notify_all()
+            for c in self._ccvs:
+                c.notify_all()
         self._worker.join(timeout=self._CLOSE_JOIN_S)
+        for t in self._completers:
+            t.join(timeout=self._CLOSE_JOIN_S)
         with self._cv:
             leftovers = [e[2] for e in self._pending] + list(self._inflight)
+            for q in self._cqueues:
+                for rec in q:
+                    leftovers.extend(e[2] for e in rec.live)
+                    # Queued (unowned) records release their slots; an
+                    # ACTIVE record's handle belongs to its completer —
+                    # abandoning it here would race a stuck wait().
+                    abandon = getattr(rec.handle, "abandon", None)
+                    if abandon is not None:
+                        abandon()
+                q.clear()
+            for i, rec in enumerate(self._cq_active):
+                if rec is not None:
+                    leftovers.extend(e[2] for e in rec.live)
+                # In place: a late completer still holds this list.
+                self._cq_active[i] = None
             self._pending.clear()
             self._inflight = []
-            queue_depth_gauge.set(0)
-            inflight_gauge.set(0)
+            self._queue_gauge.set(0)
+            self._inflight_gauge.set(0)
         failed = 0
         for fut in rejected + leftovers:
-            if not fut.done():
-                self._resolve(
-                    fut,
-                    exc=ServiceClosed(
-                        "PipelineService closed before this request ran"
-                    ),
-                )
+            if not fut.done() and self._resolve(
+                fut,
+                exc=ServiceClosed(
+                    "PipelineService closed before this request ran"
+                ),
+            ):
+                self._outcomes.bump("closed")
                 failed += 1
         if failed:
             reliability_counters.bump("futures_failed_on_close", failed)
@@ -837,13 +1547,20 @@ class PipelineService:
     def stats(self) -> dict:
         """The service health surface: request accounting, end-to-end
         latency percentiles (registry-backed, always on), queue/in-flight
-        state, and the engine's compile evidence — one dict an operator or
-        bench can poll instead of assembling it from private counters."""
+        state, replica-pool dispatch balance, and the engine's compile
+        evidence — one dict an operator or bench can poll instead of
+        assembling it from private counters."""
         with self._lock:
             pending = len(self._pending)
-            inflight = len(self._inflight)
+            inflight = (
+                sum(self._outstanding) if self._pipelined
+                else len(self._inflight)
+            )
             alive = self._worker.is_alive()
+            outstanding = list(self._outstanding)
+            dead = list(self._dead)
         return {
+            "name": self.name,
             "requests": self.requests,
             "batches_run": self.batches_run,
             "rows_served": self.rows_served,
@@ -855,8 +1572,18 @@ class PipelineService:
             ),
             "pending": pending,
             "inflight": inflight,
+            "inflight_limit": self.inflight_limit,
+            "pipelined": self._pipelined,
             "worker_alive": alive,
             "closed": self._closed,
+            "replicas": {
+                "count": self._n_replicas,
+                "outstanding": outstanding,
+                "dead": dead,
+                "deaths": self.replica_deaths,
+                "revivals": self.replica_revivals,
+            },
+            "outcomes": self._outcomes.snapshot(),
             # Per-service, not the process-global registry aggregates.
             "latency": self._e2e.snapshot(),
             "queue_depth": {"value": pending, "max": self._depth_max},

@@ -361,7 +361,9 @@ def test_record_compile_attributes_bucket():
     from keystone_tpu.workflow.serving import CompiledPipeline
 
     serving_counters.reset()
-    cp = CompiledPipeline(L2Normalizer(), buckets=(2, 4, 16))
+    # devices=1 pins the single-replica attribution this test is about;
+    # the replica pool multiplies every bucket count by the pool width.
+    cp = CompiledPipeline(L2Normalizer(), buckets=(2, 4, 16), devices=1)
     cp.warmup((3,))
     snap = serving_counters.snapshot()
     assert snap["compiles_by_bucket"] == {2: 1, 4: 1, 16: 1}

@@ -379,8 +379,13 @@ class TestCheckpointResume:
             pass
 
         class KillingMatrix(np.ndarray):
-            """A_host whose block slicing dies partway through epoch 1 —
-            the mid-fit kill, upstream of the device."""
+            """A_host whose block slicing dies on the LAST block of epoch
+            0 — the mid-fit kill, upstream of the device. Killing before
+            the epoch completes keeps the test deterministic: epoch 0
+            never finishes, so no async orbax epoch save races the block
+            snapshot for resume precedence (a later kill point made the
+            outcome depend on whether that save committed before the
+            resume run checked)."""
 
             reads = 0
 
@@ -391,7 +396,7 @@ class TestCheckpointResume:
                     and isinstance(idx[1], slice)
                 ):
                     type(self).reads += 1
-                    if type(self).reads > 6:  # nb=4: dies at epoch 1 block 2
+                    if type(self).reads > 4:  # nb=4: dies at epoch 0 block 3
                         raise Kill()
                 return super().__getitem__(idx)
 
@@ -402,10 +407,9 @@ class TestCheckpointResume:
                 A_killing, RowMatrix.from_array(B), 8, 2, lam=0.1,
                 checkpoint_dir=ckpt, checkpoint_every=3,
             )
-        # A mid-epoch block snapshot (blocks_done 3, or 6 if the consumer
-        # caught the prefetcher) outlived the kill; resume restores
-        # W/R/invs there and recomputes only the remaining block updates,
-        # bit-identically.
+        # The mid-epoch block snapshot (blocks_done 3 = epoch 0, block 3)
+        # outlived the kill; resume restores W/R/invs there and recomputes
+        # only the remaining block updates, bit-identically.
         reliability_counters.reset()
         resumed, _ = block_coordinate_descent_streamed(
             A, RowMatrix.from_array(B), 8, 2, lam=0.1,
@@ -553,7 +557,12 @@ class TestPrefetchRecovery:
 
 def _service(delay_s: float = 0.0, **kwargs):
     """A warmed single-op service whose device call can be slowed to pin
-    the worker, exposing queue/deadline behavior deterministically."""
+    the worker, exposing queue/deadline behavior deterministically.
+
+    Pinned to devices=1 / inflight=1 — the serial flush path, where the
+    slowed ``__call__`` really does occupy the one worker (the pipelined
+    dispatcher launches through ``call_async`` and would bypass the
+    wrapper's delay). Multi-replica behavior has its own tests below."""
     from keystone_tpu.workflow.pipeline import Transformer
     from keystone_tpu.workflow.serving import CompiledPipeline, PipelineService
 
@@ -561,7 +570,7 @@ def _service(delay_s: float = 0.0, **kwargs):
         def apply_batch(self, X):
             return X * 2.0
 
-    cp = CompiledPipeline(Double(), buckets=(8, 32)).warmup((4,))
+    cp = CompiledPipeline(Double(), buckets=(8, 32), devices=1).warmup((4,))
 
     class Slowed:
         def __init__(self, inner, delay):
@@ -575,7 +584,9 @@ def _service(delay_s: float = 0.0, **kwargs):
                 time.sleep(self._delay)
             return self._inner(X)
 
-    return PipelineService(Slowed(cp, delay_s), max_delay_ms=1.0, **kwargs)
+    return PipelineService(
+        Slowed(cp, delay_s), max_delay_ms=1.0, inflight=1, **kwargs
+    )
 
 
 class TestServingHardening:

@@ -165,7 +165,8 @@ def test_zero_compiles_after_warmup_on_mixed_trace(rng):
     d = 8
     cp = CompiledPipeline(_head(d=d), max_batch=32).warmup((d,))
     warm_compiles = cp.compile_count
-    assert warm_compiles == len(cp.ladder)
+    # One ladder per replica: warmup covers the whole pool.
+    assert warm_compiles == len(cp.ladder) * len(cp.replicas)
     ev0 = _compile_events.count
     c0 = serving_counters.snapshot()["compiles"]
     sizes = rng.integers(1, 33, size=50)
@@ -188,11 +189,12 @@ def test_warmup_idempotent_and_cold_bucket_counted(rng):
     cp.warmup((d,))  # no-op: every bucket already compiled
     assert cp.compile_count == n
 
-    # A never-warmed engine warms the whole ladder off the first request's
-    # signature (correct, but first-traffic latency pays the ladder).
+    # A never-warmed engine warms the whole ladder (on every replica) off
+    # the first request's signature (correct, but first-traffic latency
+    # pays the ladder).
     cold = CompiledPipeline(_head(d=d, seed=1), max_batch=8)
     cold(rng.normal(size=(3, d)).astype(np.float32))
-    assert cold.compile_count == len(cold.ladder)
+    assert cold.compile_count == len(cold.ladder) * len(cold.replicas)
 
     # Re-warming a shape-polymorphic chain for a NEW traffic signature
     # drops the stale executables and recompiles the ladder.

@@ -1,0 +1,690 @@
+"""Multi-device serving: replica pool, pipelined dispatch, re-dispatch.
+
+What is pinned here, mirroring ISSUE 6's acceptance gates:
+
+1. replica-pool outputs are BIT-identical to the single-device engine on
+   canonical fused chains — padding and replica choice must not change a
+   single ulp (the same XLA program runs on same-kind devices);
+2. with ``devices=1`` and in-flight window 1 the service takes the exact
+   pre-pipelining serial flush path (the enabled-but-silent gate);
+3. every replica serves traffic under a uniform trace — dispatch-balance
+   counters within 3x — and a traced run shows temporally OVERLAPPING
+   ``serve.device`` spans on distinct devices (the pipelining evidence);
+4. chaos: a dead replica's in-flight groups re-dispatch to survivors
+   with zero stranded futures; a fully dead pool revives; the pipelined
+   dispatcher survives ``worker_death`` like the serial one;
+5. the offline data-parallel path (``CompiledPipeline.apply_batches`` /
+   ``Pipeline.apply_batches(engine=)``) preserves source order and
+   matches per-batch serving;
+6. per-instance metric namespacing: two services never share a
+   queue-depth/in-flight gauge, and failed/expired/rejected requests
+   land in an outcome-tagged registry counter;
+7. the ``make bench-serve-replicas`` flow runs in-process (fast variant)
+   with its bit-identity and balance gates green.
+"""
+
+import importlib.util
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from keystone_tpu.config import config
+from keystone_tpu.utils import reliability
+from keystone_tpu.utils.metrics import (
+    active_tracer,
+    metrics_registry,
+    reliability_counters,
+    reset_tracer,
+)
+from keystone_tpu.workflow.pipeline import FusedTransformer, Transformer
+from keystone_tpu.workflow.serving import (
+    CompiledPipeline,
+    PipelineService,
+    resolve_serve_devices,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def faults():
+    """Arm a fault plan for the test (test_reliability's idiom)."""
+    prior = (config.faults, config.faults_seed)
+    reliability_counters.reset()
+
+    def arm(spec: str, seed: int = 0):
+        config.faults, config.faults_seed = spec, seed
+        reliability.reset_fault_plan()
+        return reliability.active_plan()
+
+    arm("")
+    yield arm
+    config.faults, config.faults_seed = prior
+    reliability.reset_fault_plan()
+    reliability_counters.reset()
+
+
+@pytest.fixture
+def traced():
+    """Arm process-wide tracing for the test (test_observability's
+    idiom)."""
+    prior = config.trace
+
+    def arm(on: bool = True):
+        config.trace = on
+        reset_tracer()
+        return active_tracer()
+
+    try:
+        yield arm
+    finally:
+        config.trace = prior
+        reset_tracer()
+
+
+def _head(d=8, D=16, k=3, seed=0):
+    from keystone_tpu.nodes.learning.linear_mapper import LinearMapper
+    from keystone_tpu.nodes.stats.hellinger import SignedHellingerMapper
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+    from keystone_tpu.nodes.stats.random_features import CosineRandomFeatures
+    from keystone_tpu.nodes.stats.scalers import StandardScalerModel
+
+    rng = np.random.default_rng(seed)
+    return FusedTransformer(
+        [
+            StandardScalerModel(
+                rng.normal(size=d).astype(np.float32),
+                (1.0 + rng.uniform(size=d)).astype(np.float32),
+            ),
+            CosineRandomFeatures.create(d, D, seed=seed),
+            SignedHellingerMapper(),
+            L2Normalizer(),
+            LinearMapper(rng.normal(size=(D, k)).astype(np.float32)),
+        ]
+    )
+
+
+# ---------------------------------------------------------------------------
+# Pool resolution + bit-identity
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_serve_devices_validation():
+    import jax
+
+    local = jax.local_devices()
+    assert resolve_serve_devices(0) == tuple(local)
+    assert resolve_serve_devices(2) == tuple(local[:2])
+    assert resolve_serve_devices([local[3], local[5]]) == (
+        local[3], local[5],
+    )
+    with pytest.raises(ValueError, match="devices"):
+        resolve_serve_devices(-1)
+    with pytest.raises(ValueError, match="exceeds"):
+        resolve_serve_devices(len(local) + 1)
+    with pytest.raises(ValueError, match="empty"):
+        resolve_serve_devices([])
+    # An explicit inflight=0 must error, not silently take the default.
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+
+    with pytest.raises(ValueError, match="inflight"):
+        CompiledPipeline(L2Normalizer(), max_batch=8, inflight=0)
+
+
+def test_replica_outputs_bit_identical_to_single_device(rng):
+    """The acceptance gate: on canonical fused chains, every request's
+    output from the pool equals the single-device engine's bit for bit —
+    including oversize batches that shard across replicas."""
+    from keystone_tpu.nodes.stats.hellinger import SignedHellingerMapper
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+
+    d = 8
+    chains = [
+        _head(d=d),
+        FusedTransformer([SignedHellingerMapper(), L2Normalizer()]),
+    ]
+    for chain in chains:
+        cp1 = CompiledPipeline(chain, max_batch=16, devices=1).warmup((d,))
+        cp4 = CompiledPipeline(chain, max_batch=16, devices=4).warmup((d,))
+        # 1..16 exercise every bucket; 37/64 shard across the pool.
+        for n in (1, 3, 9, 16, 37, 64):
+            X = rng.normal(size=(n, d)).astype(np.float32)
+            a, b = cp1(X), cp4(X)
+            assert a.dtype == b.dtype and a.shape == b.shape
+            assert np.array_equal(a, b), (type(chain).__name__, n)
+
+
+def test_oversize_batches_shard_across_replicas(rng):
+    """A batch beyond the top bucket spreads its chunks over the pool
+    instead of chunking serially through one device."""
+    d = 4
+    cp = CompiledPipeline(_head(d=d), max_batch=8, devices=4).warmup((d,))
+    X = rng.normal(size=(8 * 6, d)).astype(np.float32)
+    out = cp(X)
+    assert out.shape == (48, 3)
+    dispatches = cp.stats()["replica_dispatches"]
+    assert sum(dispatches.values()) == 6
+    assert sum(1 for v in dispatches.values() if v > 0) >= 2
+
+
+# ---------------------------------------------------------------------------
+# Dispatch: serial gate, balance, span overlap
+# ---------------------------------------------------------------------------
+
+
+def test_single_replica_window1_takes_serial_path(rng):
+    """devices=1 + inflight=1 = the pre-pipelining serial flush loop (the
+    enabled-but-silent discipline): no completion threads, same results."""
+    d = 4
+    cp = CompiledPipeline(_head(d=d), max_batch=8, devices=1).warmup((d,))
+    with PipelineService(cp, max_delay_ms=1.0, inflight=1) as svc:
+        assert svc._pipelined is False
+        assert svc._completers == []
+        x = rng.normal(size=(d,)).astype(np.float32)
+        out = svc.submit(x).result(timeout=30)
+        np.testing.assert_allclose(out, cp(x[None])[0], rtol=2e-6, atol=2e-6)
+        assert svc.stats()["replicas"]["count"] == 1
+    # Default devices (the whole local mesh) + default window pipelines.
+    cp_all = CompiledPipeline(_head(d=d), max_batch=8).warmup((d,))
+    with PipelineService(cp_all, max_delay_ms=1.0) as svc:
+        assert svc._pipelined is True
+        assert len(svc._completers) == len(cp_all.replicas)
+
+
+def test_service_dispatch_balance_uniform_trace(rng):
+    """The 160-request acceptance trace: every replica serves traffic and
+    the dispatch-balance counters stay within 3x, while every output
+    matches a single-device reference."""
+    d = 8
+    cp1 = CompiledPipeline(_head(d=d), max_batch=64, devices=1).warmup((d,))
+    cp = CompiledPipeline(_head(d=d), max_batch=64, devices=4).warmup((d,))
+    trace = [
+        rng.normal(size=(int(rng.integers(1, 65)), d)).astype(np.float32)
+        for _ in range(160)
+    ]
+    errs: list = []
+
+    def client(cid, svc):
+        try:
+            for i in range(cid, len(trace), 4):
+                out = svc.submit(trace[i]).result(timeout=60)
+                # Coalescing can serve the request inside a different
+                # bucket than a solo call — equal to gemm-shape (last
+                # ulp) tolerance, as for the single-device service.
+                np.testing.assert_allclose(
+                    out, cp1(trace[i]), rtol=2e-6, atol=2e-6
+                )
+        except Exception as e:  # pragma: no cover - surfaced below
+            errs.append(e)
+
+    with PipelineService(cp, max_delay_ms=0.5, inflight=2) as svc:
+        threads = [
+            threading.Thread(target=client, args=(k, svc)) for k in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stats = svc.stats()
+    assert not errs, errs[:2]
+    dispatches = stats["compiled"]["replica_dispatches"]
+    assert len(dispatches) == 4
+    assert min(dispatches.values()) > 0  # every replica served traffic
+    assert max(dispatches.values()) <= 3 * min(dispatches.values())
+    # The registry mirror carries the same balance, per-instance.
+    reg = metrics_registry.counters(
+        f"serve.dispatch[{cp.name}]"
+    ).snapshot()
+    assert reg == dispatches
+    assert stats["outcomes"]["ok"] == 160
+
+
+def test_overlapping_serve_device_spans_on_distinct_devices(rng, traced):
+    """The pipelining evidence: a traced multi-replica run must contain
+    >=2 serve.device spans on DISTINCT devices whose [start, end]
+    intervals overlap — replica B computing while replica A's results
+    materialize."""
+    tr = traced(True)
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+    from keystone_tpu.nodes.stats.random_features import CosineRandomFeatures
+
+    chain = FusedTransformer(
+        [CosineRandomFeatures.create(32, 512, seed=0), L2Normalizer()]
+    )
+    cp = CompiledPipeline(chain, max_batch=64, devices=4).warmup((32,))
+    trace = [
+        rng.normal(size=(int(rng.integers(16, 65)), 32)).astype(np.float32)
+        for _ in range(48)
+    ]
+    errs: list = []
+
+    def client(cid, svc):
+        try:
+            for i in range(cid, len(trace), 4):
+                svc.submit(trace[i]).result(timeout=60)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    with PipelineService(cp, max_delay_ms=0.5, inflight=2) as svc:
+        threads = [
+            threading.Thread(target=client, args=(k, svc)) for k in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errs
+    spans = [s for s in tr.spans() if s["name"] == "serve.device"]
+    assert {s["args"]["device"] for s in spans} >= {0, 1}
+    ivals = [
+        (s["start_ns"], s["start_ns"] + s["dur_ns"], s["args"]["device"])
+        for s in spans
+    ]
+    overlapping = any(
+        a[2] != b[2] and a[0] < b[1] and b[0] < a[1]
+        for i, a in enumerate(ivals)
+        for b in ivals[i + 1:]
+    )
+    assert overlapping, "no temporally overlapping spans across devices"
+
+
+# ---------------------------------------------------------------------------
+# Chaos: replica death, pool revival, dispatcher death
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_replica_death_redispatches_zero_stranded(rng, faults):
+    """KEYSTONE_FAULTS replica_death with >=2 replicas: the dead
+    replica's in-flight groups re-queue and the survivors serve them —
+    every future resolves with the right value."""
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+
+    faults("replica_death:1")
+    cp = CompiledPipeline(
+        L2Normalizer(), max_batch=16, devices=4
+    ).warmup((8,))
+    ref = CompiledPipeline(
+        L2Normalizer(), max_batch=16, devices=1
+    ).warmup((8,))
+    xs = [rng.normal(size=(3, 8)).astype(np.float32) for _ in range(60)]
+    svc = PipelineService(cp, max_delay_ms=0.5, inflight=2)
+    try:
+        futs = [svc.submit(x) for x in xs]
+        for x, f in zip(xs, futs):
+            np.testing.assert_allclose(
+                f.result(timeout=30), ref(x), rtol=2e-6, atol=2e-6
+            )
+        stats = svc.stats()
+        assert stats["replicas"]["deaths"] == 1
+        # The dead replica either still shows dead (death after the last
+        # submit) or has already been revived by a later submit — both
+        # are healthy; what may NOT happen is a stranded future.
+        assert (
+            sum(stats["replicas"]["dead"]) == 1
+            or stats["replicas"]["revivals"] >= 1
+        )
+        assert reliability_counters.get("replica_deaths") == 1
+        assert all(f.done() for f in futs)  # zero stranded
+        # ...and zero leaked slots: the dead replica's abandoned launches
+        # released their engine-level outstanding counts, so direct-call
+        # least-outstanding dispatch isn't biased away from it forever.
+        assert all(
+            v == 0 for v in cp.stats()["replica_outstanding"].values()
+        )
+    finally:
+        svc.close()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_whole_pool_death_revives(rng, faults):
+    """A single-replica pipelined pool whose one replica dies revives
+    itself: service keeps serving, nothing stranded."""
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+
+    faults("replica_death:1")
+    cp = CompiledPipeline(
+        L2Normalizer(), max_batch=16, devices=1
+    ).warmup((8,))
+    xs = [rng.normal(size=(2, 8)).astype(np.float32) for _ in range(20)]
+    svc = PipelineService(cp, max_delay_ms=0.5, inflight=2)
+    try:
+        futs = [svc.submit(x) for x in xs]
+        outs = [f.result(timeout=30) for f in futs]
+        assert len(outs) == 20
+        stats = svc.stats()
+        assert stats["replicas"]["deaths"] == 1
+        assert stats["replicas"]["revivals"] == 1
+        assert reliability_counters.get("replica_revivals") == 1
+    finally:
+        svc.close()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_dead_replica_heals_on_next_submit(rng, faults):
+    """A partially dead pool must not serve at reduced capacity forever:
+    the next submit revives dead replicas (the worker-death detection
+    point), restoring full width."""
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+
+    faults("replica_death:1")
+    cp = CompiledPipeline(
+        L2Normalizer(), max_batch=16, devices=2
+    ).warmup((8,))
+    svc = PipelineService(cp, max_delay_ms=0.5, inflight=2)
+    try:
+        x = rng.normal(size=(2, 8)).astype(np.float32)
+        futs = [svc.submit(x) for _ in range(30)]
+        for f in futs:
+            f.result(timeout=30)
+        assert svc.replica_deaths == 1
+        # Post-drain submit: detects and revives whatever is still dead.
+        svc.submit(x).result(timeout=30)
+        stats = svc.stats()
+        assert sum(stats["replicas"]["dead"]) == 0
+        assert stats["replicas"]["revivals"] >= 1
+        assert reliability_counters.get("replica_revivals") >= 1
+    finally:
+        svc.close()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_worker_death_pipelined_restart(rng, faults):
+    """The worker_death site under the PIPELINED dispatcher: submit
+    detects the corpse, restarts it, queued work drains, launched groups
+    (owned by the completion threads) are unaffected."""
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+
+    faults("worker_death:1")
+    cp = CompiledPipeline(
+        L2Normalizer(), max_batch=16, devices=2
+    ).warmup((8,))
+    svc = PipelineService(cp, max_delay_ms=0.5, inflight=2)
+    try:
+        x = rng.normal(size=(2, 8)).astype(np.float32)
+        first = svc.submit(x)  # wakes the dispatcher into the death
+        import time
+
+        deadline = time.monotonic() + 5
+        while svc._worker.is_alive() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not svc._worker.is_alive()
+        second = svc.submit(x)  # detects the corpse, restarts
+        assert svc.worker_restarts == 1
+        np.testing.assert_allclose(
+            first.result(timeout=30), cp(x), rtol=2e-6, atol=2e-6
+        )
+        np.testing.assert_allclose(
+            second.result(timeout=30), cp(x), rtol=2e-6, atol=2e-6
+        )
+    finally:
+        svc.close()
+
+
+def test_deadline_expires_during_slot_wait(rng):
+    """A request whose deadline lapses while the dispatcher waits for an
+    in-flight slot must fail with DeadlineExceeded BEFORE the device call
+    (the PR-3 contract), not get served late."""
+    import time
+
+    from keystone_tpu.utils.reliability import DeadlineExceeded
+
+    d = 4
+    cp = CompiledPipeline(_head(d=d), max_batch=8, devices=1).warmup((d,))
+
+    class SlowAsyncEngine:
+        """Delays result materialization so the one replica's in-flight
+        window stays full long enough for a queued group to expire."""
+
+        def __init__(self, inner, delay):
+            self._inner, self._delay = inner, delay
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def call_async(self, X, **kw):
+            handle = self._inner.call_async(X, **kw)
+            delay = self._delay
+
+            class _H:
+                def wait(self):
+                    time.sleep(delay)
+                    return handle.wait()
+
+                def abandon(self):
+                    handle.abandon()
+
+            return _H()
+
+    svc = PipelineService(
+        SlowAsyncEngine(cp, 0.25), max_delay_ms=0.5, max_rows=2,
+        inflight=2,
+    )
+    try:
+        assert svc._pipelined
+        x = np.ones((2, d), np.float32)
+        a = svc.submit(x)  # fills slot 1
+        b = svc.submit(x)  # fills slot 2: window full for ~0.25s
+        time.sleep(0.02)
+        doomed = svc.submit(x, deadline_ms=50.0)
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=10)
+        np.testing.assert_allclose(
+            a.result(timeout=10), cp(x), rtol=2e-6, atol=2e-6
+        )
+        np.testing.assert_allclose(
+            b.result(timeout=10), cp(x), rtol=2e-6, atol=2e-6
+        )
+        assert svc.expired == 1
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Offline data parallelism
+# ---------------------------------------------------------------------------
+
+
+def test_engine_apply_batches_order_and_equivalence(rng):
+    """The data-parallel offline apply: batches round-robin over the pool
+    with a bounded async window, results come back in source order and
+    bit-equal to per-batch serving; labels pass through."""
+    d = 8
+    cp = CompiledPipeline(_head(d=d), max_batch=32, devices=4).warmup((d,))
+    batches = [
+        (
+            rng.normal(size=(int(rng.integers(1, 33)), d)).astype(np.float32),
+            np.full(1, i),
+        )
+        for i in range(17)
+    ]
+    got = list(cp.apply_batches(iter(batches), prefetch_depth=2))
+    assert len(got) == 17
+    for i, ((X, y), (out, y_out)) in enumerate(zip(batches, got)):
+        assert y_out is y  # label passthrough, source order
+        assert np.array_equal(out, cp(X)), i
+    # Bare batches (no labels) work too.
+    bare = list(cp.apply_batches([b[0] for b in batches[:3]]))
+    assert all(y is None for _, y in bare)
+    dispatches = cp.stats()["replica_dispatches"]
+    assert sum(1 for v in dispatches.values() if v > 0) >= 2
+
+
+def test_pipeline_apply_batches_engine_path(rng):
+    """Pipeline.apply_batches(engine=...) routes the stream through the
+    replica pool; outputs match graph execution to float tolerance (the
+    padded-bucket executables can differ in the last ulp)."""
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+    from keystone_tpu.nodes.stats.scalers import StandardScaler
+
+    d = 6
+    Xtrain = rng.normal(size=(32, d)).astype(np.float32)
+    pipe = StandardScaler().with_data(Xtrain).and_then(L2Normalizer())
+    fitted = pipe.fit()
+    engine = fitted.compiled(max_batch=16, devices=2).warmup((d,))
+    batches = [
+        (rng.normal(size=(5, d)).astype(np.float32), None) for _ in range(4)
+    ]
+    via_engine = list(fitted.apply_batches(iter(batches), engine=engine))
+    via_graph = list(fitted.apply_batches(iter(batches)))
+    assert len(via_engine) == len(via_graph) == 4
+    for (a, _), (b, _) in zip(via_engine, via_graph):
+        np.testing.assert_allclose(
+            a, np.asarray(b), rtol=2e-6, atol=2e-6
+        )
+
+
+# ---------------------------------------------------------------------------
+# Per-instance metrics + outcome counters (satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_per_service_metric_namespacing(rng):
+    """Two services in one process own DISTINCT registry gauges — no more
+    get-or-create collisions overwriting each other's queue depth."""
+    d = 4
+    cp = CompiledPipeline(_head(d=d), max_batch=8, devices=1).warmup((d,))
+    svc_a = PipelineService(cp, max_delay_ms=1.0, inflight=1)
+    svc_b = PipelineService(cp, max_delay_ms=1.0, inflight=1)
+    try:
+        assert svc_a.name != svc_b.name
+        names = metrics_registry.names()
+        for svc in (svc_a, svc_b):
+            assert f"serve.queue_depth[{svc.name}]" in names
+            assert f"serve.inflight[{svc.name}]" in names
+            assert f"serve.requests[{svc.name}]" in names
+        ga = metrics_registry.gauge(f"serve.queue_depth[{svc_a.name}]")
+        gb = metrics_registry.gauge(f"serve.queue_depth[{svc_b.name}]")
+        assert ga is not gb
+        # Engine-level per-replica metrics are namespaced too.
+        dev0 = cp.devices[0]
+        assert f"serve.outstanding[{cp.name}:d{dev0.id}]" in names
+        assert f"serve.dispatch[{cp.name}]" in names
+    finally:
+        svc_a.close()
+        svc_b.close()
+
+
+def test_outcome_counters_count_rejected_and_expired(rng):
+    """The satellite fix: rejected/expired requests land in the
+    outcome-tagged registry counter, so overload analyses see failed
+    work, not just the successes."""
+    import time
+
+    d = 4
+    cp = CompiledPipeline(_head(d=d), max_batch=8, devices=1).warmup((d,))
+
+    class Slowed:
+        def __init__(self, inner, delay):
+            self._inner, self._delay = inner, delay
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def __call__(self, X):
+            time.sleep(self._delay)
+            return self._inner(X)
+
+    from keystone_tpu.utils.reliability import QueueFullError
+
+    svc = PipelineService(
+        Slowed(cp, 0.15), max_delay_ms=1.0, inflight=1, max_pending=2
+    )
+    try:
+        x = np.ones(d, np.float32)
+        first = svc.submit(x)  # occupies the worker
+        time.sleep(0.05)
+        doomed = svc.submit(x, deadline_ms=20.0)  # expires in queue
+        held = svc.submit(x)
+        with pytest.raises(QueueFullError):
+            svc.submit(x)  # queue full: rejected
+        first.result(timeout=5)
+        held.result(timeout=5)
+        with pytest.raises(Exception):
+            doomed.result(timeout=5)
+        outcomes = metrics_registry.counters(
+            f"serve.requests[{svc.name}]"
+        ).snapshot()
+        assert outcomes["rejected"] == 1
+        assert outcomes["expired"] == 1
+        assert outcomes["ok"] == 2
+    finally:
+        svc.close()
+
+
+def test_error_path_span_carries_rows(rng, traced):
+    """The satellite fix: serve.request error spans carry the same `rows`
+    attr the ok spans do."""
+    tr = traced(True)
+    d = 4
+    cp = CompiledPipeline(_head(d=d), max_batch=8, devices=1).warmup((d,))
+
+    class Exploding:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def __call__(self, X):
+            raise RuntimeError("injected flush failure")
+
+    svc = PipelineService(Exploding(cp), max_delay_ms=1.0, inflight=1)
+    try:
+        fut = svc.submit(np.ones((3, d), np.float32))
+        with pytest.raises(RuntimeError, match="injected"):
+            fut.result(timeout=10)
+    finally:
+        svc.close()
+    spans = [
+        s for s in tr.spans()
+        if s["name"] == "serve.request"
+        and s["args"].get("outcome") == "RuntimeError"
+    ]
+    assert spans and all(s["args"]["rows"] == 3 for s in spans)
+    outcomes = metrics_registry.counters(
+        f"serve.requests[{svc.name}]"
+    ).snapshot()
+    assert outcomes["error"] == 1
+
+
+# ---------------------------------------------------------------------------
+# bench-serve-replicas (the `make` flow, in-process fast variant)
+# ---------------------------------------------------------------------------
+
+
+def test_replica_bench_inprocess():
+    """The tier-1 stand-in for `make bench-serve-replicas`: small trace,
+    full gate surface. Timing-dependent throughput is recorded but only
+    the structural gates (bit-identity, balance, coverage) are asserted —
+    the >=1.3x scaling gate binds on >=2-core hosts per the fingerprint."""
+    import argparse
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_serve", os.path.join(REPO, "tools", "bench_serve.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    args = argparse.Namespace(
+        devices=4, requests=24, max_batch=16, d=8, features=64, classes=4,
+        seed=0, service_clients=4, inflight=2,
+    )
+    row = bench.run_replica_bench(args)
+    assert row["metric"] == "serve_replica_scaling"
+    assert row["devices_swept"] == [1, 4]
+    assert row["pass"]["outputs_bit_identical"] is True
+    assert row["pass"]["every_replica_served"] is True
+    assert row["pass"]["balance_max_min_le_3x"] is True
+    assert isinstance(row["speedup_vs_single"], float)
+    assert row["env"]["cpu_count"] == os.cpu_count()
+    assert row["pass"]["throughput_gate_is_hard"] == (os.cpu_count() >= 2)
