@@ -1,6 +1,6 @@
 # Developer entry points. Pipelines launch via bin/run-pipeline.sh.
 
-.PHONY: test t1 chaos native bench bench-serve bench-serve-overload bench-serve-replicas trace-demo dryrun clean tpu-checkride sentinel northstar acceptance
+.PHONY: test t1 chaos native bench bench-serve bench-serve-overload bench-serve-replicas trace-demo lint dryrun clean tpu-checkride sentinel northstar acceptance
 
 # The canonical tier-1 verify (ROADMAP.md), verbatim at the defaults —
 # builders and CI invoke this one entry point instead of hand-copying the
@@ -80,6 +80,18 @@ bench-serve-replicas:
 trace-demo:
 	KEYSTONE_TRACE=1 JAX_PLATFORMS=cpu python tools/trace_demo.py --out /tmp/keystone_trace.json
 	JAX_PLATFORMS=cpu python tools/trace_report.py /tmp/keystone_trace.json --top 12
+
+# Static analysis, both layers, against the checked-in expectations:
+# keystone_lint.py (stdlib-ast invariant checker: lock discipline,
+# env-read-once, resolve-once, perf_counter timing, broad handlers,
+# dispatch host syncs) is nonzero on any finding NOT in
+# tools/lint_baseline.json; lint_report.py (graph layer) must lint the
+# canonical serving chains clean AND refuse the row-coupled control
+# chain. Tier-1 runs both in-process (tests/test_keystone_lint.py,
+# tests/test_analysis.py) so this gate can never silently rot.
+lint:
+	python tools/keystone_lint.py
+	JAX_PLATFORMS=cpu python tools/lint_report.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

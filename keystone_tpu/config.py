@@ -293,6 +293,29 @@ class Config:
     trace_buffer: int = field(
         default_factory=lambda: _env_int("KEYSTONE_TRACE_BUFFER", 65536)
     )
+    # Pipeline-graph lint gate (workflow/analysis.py): run the static
+    # graph linter before every fit()/compiled(). "off" (default) = never;
+    # "warn" = log findings at their severity; "error" = additionally
+    # raise LintError on error-severity findings (serveability violations
+    # on the pre-compiled() path), so a pipeline the serving engine would
+    # refuse at trace time is refused BEFORE any device work.
+    # Env: KEYSTONE_LINT.
+    lint: str = field(
+        default_factory=lambda: _env_choice(
+            "KEYSTONE_LINT", ("warn", "error", "off"), "off"
+        )
+    )
 
 
 config = Config()
+
+
+def resolved_cache_dir() -> str | None:
+    """The cross-process fit-cache directory: env presence (not
+    truthiness) takes precedence over ``config.cache_dir``, so an
+    exported empty KEYSTONE_CACHE_DIR explicitly disables the store.
+    Lives here so the env read stays inside config.py (keystone-lint
+    KL003: hot paths must not consult os.environ directly)."""
+    if "KEYSTONE_CACHE_DIR" in os.environ:
+        return os.environ["KEYSTONE_CACHE_DIR"]
+    return config.cache_dir

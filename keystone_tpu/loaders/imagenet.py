@@ -245,7 +245,7 @@ class ImageNetLoader:
                             return
                     if bufs:
                         flush()
-            except BaseException as e:  # surface in the consumer thread
+            except BaseException as e:  # lint: broad-ok producer-thread error of any kind re-raises in the consumer
                 put(e)
             finally:
                 put(DONE)  # stop-aware: never blocks an abandoned stream

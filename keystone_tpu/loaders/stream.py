@@ -199,7 +199,7 @@ class PrefetchIterator:
                 if depth_now > self.max_queued:
                     self.max_queued = depth_now
                 self._depth_gauge.set(depth_now)
-        except BaseException as exc:  # surfaced in the consumer
+        except BaseException as exc:  # lint: broad-ok producer error of any kind re-raises in the consumer
             self._put((self._ERROR, exc))
         else:
             self._put((self._DONE, None))
@@ -311,7 +311,7 @@ class PrefetchIterator:
             if close_upstream is not None:
                 try:
                     close_upstream()
-                except Exception:
+                except Exception:  # lint: broad-ok upstream close is courtesy cleanup; a failing finalizer must not mask the stream result
                     pass
         # Wake any consumer still parked in queue.get() (cross-thread
         # close): the sentinel turns its wait into StopIteration.
@@ -329,7 +329,7 @@ class PrefetchIterator:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # lint: broad-ok GC/teardown finalizer: anything may be half-torn-down
             pass
 
 

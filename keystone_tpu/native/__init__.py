@@ -79,7 +79,7 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.c_int,
                 f32p,
             ]
-    except Exception as e:
+    except Exception as e:  # lint: broad-ok ctypes probe: any load/signature failure means 'no native backend'
         _build_error = f"native binding failed: {e}"
         return None
     _lib = lib

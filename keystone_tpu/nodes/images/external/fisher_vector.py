@@ -183,7 +183,7 @@ def fit_fisher_featurizer(
                     )
                 )
             )
-        except Exception as e:
+        except Exception as e:  # lint: broad-ok cache-key construction is best-effort; fits proceed uncached
             import logging
 
             logging.getLogger("keystone_tpu").warning(

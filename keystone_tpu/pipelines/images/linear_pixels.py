@@ -36,14 +36,14 @@ def run(conf: LinearPixelsConfig) -> dict:
     else:
         train, test = CifarLoader.synthetic(n=conf.synthetic_n)
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     featurizer = GrayScaler().and_then(ImageVectorizer())
     targets = ClassLabelIndicators(conf.num_classes)(train.labels)
     pipeline = featurizer.and_then(
         LinearMapEstimator(lam=conf.lam), train.data, targets
     ).and_then(MaxClassifier())
     predictions = pipeline(test.data).get()
-    elapsed = time.time() - t0
+    elapsed = time.perf_counter() - t0
 
     metrics = MulticlassClassifierEvaluator(conf.num_classes).evaluate(
         predictions, test.labels

@@ -53,7 +53,7 @@ def build_pipeline(conf: MnistRandomFFTConfig, train, train_labels) -> Pipeline:
 
 
 def run(conf: MnistRandomFFTConfig) -> dict:
-    t0 = time.time()
+    t0 = time.perf_counter()
     if conf.train_path:
         if not conf.test_path:
             raise ValueError(
@@ -64,12 +64,12 @@ def run(conf: MnistRandomFFTConfig) -> dict:
         test = MnistLoader.load(conf.test_path)
     else:
         train, test = MnistLoader.synthetic(n=conf.synthetic_n, seed=conf.seed)
-    t_load = time.time() - t0
+    t_load = time.perf_counter() - t0
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     pipeline = build_pipeline(conf, train.data, train.labels)
     predictions = pipeline(test.data).get()  # fits lazily, then predicts
-    t_fit = time.time() - t0
+    t_fit = time.perf_counter() - t0
 
     metrics = MulticlassClassifierEvaluator(conf.num_classes).evaluate(
         predictions, test.labels

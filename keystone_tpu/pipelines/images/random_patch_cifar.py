@@ -98,7 +98,7 @@ def run(conf: RandomPatchCifarConfig) -> dict:
     else:
         train, test = CifarLoader.synthetic(n=conf.synthetic_n)
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     featurizer = build_featurizer(conf, train.data)
     targets = ClassLabelIndicators(conf.num_classes)(train.labels)
     pipeline = featurizer.and_then(
@@ -111,7 +111,7 @@ def run(conf: RandomPatchCifarConfig) -> dict:
         targets,
     ).and_then(MaxClassifier())
     predictions = pipeline(test.data).get()
-    elapsed = time.time() - t0
+    elapsed = time.perf_counter() - t0
 
     metrics = MulticlassClassifierEvaluator(conf.num_classes).evaluate(
         predictions, test.labels

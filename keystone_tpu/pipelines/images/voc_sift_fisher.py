@@ -87,7 +87,7 @@ def run(conf: VOCSIFTFisherConfig) -> dict:
         )
         num_classes = conf.synthetic_classes
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     featurizer = build_featurizer(conf, train.data)
     targets = (2.0 * train.labels - 1.0).astype(np.float32)
     pipeline = featurizer.and_then(
@@ -98,7 +98,7 @@ def run(conf: VOCSIFTFisherConfig) -> dict:
         targets,
     )
     scores = np.asarray(pipeline(test.data).get())
-    elapsed = time.time() - t0
+    elapsed = time.perf_counter() - t0
 
     result = MeanAveragePrecisionEvaluator(num_classes).evaluate(
         scores, test.labels

@@ -57,7 +57,7 @@ def run(conf: TimitConfig) -> dict:
         )
         num_phones = conf.num_phones
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     featurizer = StandardScaler().with_data(train.data).and_then(
         CosineRandomFeatures.create(
             input_dim=train.data.shape[1],
@@ -78,7 +78,7 @@ def run(conf: TimitConfig) -> dict:
         targets,
     ).and_then(MaxClassifier())
     predictions = pipeline(test.data).get()
-    elapsed = time.time() - t0
+    elapsed = time.perf_counter() - t0
 
     metrics = MulticlassClassifierEvaluator(num_phones).evaluate(
         predictions, test.labels

@@ -46,7 +46,7 @@ def run(conf: AmazonReviewsConfig) -> dict:
     else:
         train, test = AmazonReviewsDataLoader.synthetic(n=conf.synthetic_n)
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     featurizer = (
         Trim()
         .and_then(LowerCase())
@@ -61,7 +61,7 @@ def run(conf: AmazonReviewsConfig) -> dict:
         train.labels,
     )
     scores = np.asarray(pipeline(test.data).get())
-    elapsed = time.time() - t0
+    elapsed = time.perf_counter() - t0
 
     predictions = scores.argmax(axis=1)
     margin = scores[:, 1] - scores[:, 0]

@@ -56,7 +56,7 @@ def run(conf: NewsgroupsConfig) -> dict:
         )
         num_classes = len(classes)
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     featurizer = (
         Trim()
         .and_then(LowerCase())
@@ -77,7 +77,7 @@ def run(conf: NewsgroupsConfig) -> dict:
         raise ValueError(f"unknown classifier {conf.classifier!r}")
     pipeline = pipeline.and_then(MaxClassifier())
     predictions = pipeline(test.data).get()
-    elapsed = time.time() - t0
+    elapsed = time.perf_counter() - t0
 
     metrics = MulticlassClassifierEvaluator(num_classes).evaluate(
         predictions, test.labels

@@ -548,7 +548,7 @@ def environment_fingerprint(devices: bool = True) -> Dict[str, Any]:
         import numpy as _np
 
         fp["numpy"] = _np.__version__
-    except Exception:
+    except ImportError:  # fingerprint stays useful without numpy
         pass
     if not devices:
         return fp
@@ -557,7 +557,7 @@ def environment_fingerprint(devices: bool = True) -> Dict[str, Any]:
         fp["backend"] = jax.default_backend()
         fp["device_kind"] = devs[0].device_kind if devs else None
         fp["device_count"] = jax.device_count()
-    except Exception as e:  # deviceless / dead backend: record, don't die
+    except Exception as e:  # lint: broad-ok deviceless/dead backend raises backend-specific types: record, don't die
         fp["backend_error"] = str(e)[:200]
     return fp
 
@@ -573,7 +573,7 @@ def device_hbm_bytes(default: int | None = None) -> int:
         limit = stats.get("bytes_limit")
         if limit:
             return int(limit)
-    except Exception:
+    except Exception:  # lint: broad-ok backend-specific probe failures all mean 'no reported limit'
         pass
     return default if default is not None else config.hbm_budget_bytes
 
@@ -587,7 +587,7 @@ def peak_hbm_bytes() -> int | None:
     whatever a different runtime names the peak."""
     try:
         stats = jax.local_devices()[0].memory_stats() or {}
-    except Exception:
+    except Exception:  # lint: broad-ok backend-specific probe failures all mean 'no reported peak'
         return None
     peak = stats.get("peak_bytes_in_use")
     return int(peak) if peak is not None else None

@@ -7,6 +7,12 @@ from keystone_tpu.workflow.pipeline import (
     PipelineDataset,
     Transformer,
 )
+from keystone_tpu.workflow.analysis import (
+    Diagnostic,
+    LintError,
+    LintReport,
+    lint_graph,
+)
 from keystone_tpu.workflow.executor import GraphExecutor, PipelineEnv
 from keystone_tpu.workflow.functional import fitted_forward
 from keystone_tpu.workflow.optimizer import (
@@ -48,6 +54,10 @@ __all__ = [
     "default_optimizer",
     "save_pipeline",
     "load_pipeline",
+    "Diagnostic",
+    "LintError",
+    "LintReport",
+    "lint_graph",
     "CompiledPipeline",
     "PipelineService",
     "RowDependenceError",

@@ -1,0 +1,479 @@
+"""Static pipeline-graph linter (Layer 1 of keystone-lint).
+
+KeystoneML's core move is whole-pipeline optimization over a statically
+analyzable operator DAG; this module adds the *checking* half of that
+bargain. An abstract-interpretation pass propagates symbolic shape/dtype
+specs through the graph (``jax.eval_shape`` on the transformers' batch
+functions — no device compute, no data) and a small rule catalog turns
+what the pass sees into structured diagnostics, so serveability, shape,
+and recompile hazards surface BEFORE a trace ever reaches a device
+(arXiv:2206.14148's pre-execution resource checking; arXiv:2008.01040's
+pre-execution graph analysis).
+
+Rule catalog (KG = Keystone Graph):
+
+- ``KG001 serve-unjittable`` — a non-jittable (host) transformer on the
+  would-be serving chain. ``compiled()`` would refuse it at call time;
+  the linter says so up front.
+- ``KG002 serve-row-coupled`` — a ``row_independent=False`` stage on the
+  chain: bucket padding would change real outputs
+  (``RowDependenceError`` at serve time).
+- ``KG003 serve-nonlinear`` — a gather join / multi-input node on the
+  chain: the bucketed engine compiles ONE linear program per bucket.
+- ``KG101 recompile-hazard`` — a shape-polymorphic input feeding jit
+  consumers with no bucket ladder configured: every distinct row count
+  recompiles the whole fused chain.
+- ``KG102 dtype-seam`` — a silent upcast across a node boundary (output
+  dtype wider than input), or mixed dtypes meeting at a gather join:
+  the upcast doubles bytes/HBM mid-chain without anyone asking for it.
+- ``KG201 dead-node`` — a node in the graph unreachable from the sink
+  (composition orphans the pruner should have dropped).
+- ``KG202 cache-advice`` — a non-trivial subchain re-used by >= 2
+  consumers with no cache node: each consumer recomputes the prefix.
+
+Severity model: serveability rules (KG00x) are *errors* when linting
+with ``serve=True`` (the pre-``compiled()`` gate) and *warnings*
+otherwise; KG101/KG102 are warnings; KG201/KG202 are info.
+
+Wire-up: ``Pipeline.lint()`` runs this directly; the opt-in env gate
+``KEYSTONE_LINT=warn|error|off`` (default off) runs it before every
+``fit()`` / ``compiled()`` via ``enforce_lint`` — ``warn`` logs,
+``error`` raises ``LintError`` on error-severity findings. CLI/CI
+rendering goes through ``tools/lint_report.py.format_findings`` over
+``LintReport.as_dicts()`` — the same table the AST layer prints;
+``LintReport.render()`` is only the inline (no-tools-import)
+convenience for interactive use.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from keystone_tpu.workflow.graph import Graph, GraphId, NodeId, SourceId
+from keystone_tpu.workflow.operators import (
+    DatasetOperator,
+    DatumOperator,
+    DelegatingOperator,
+    EstimatorOperator,
+    GatherOperator,
+    TransformerOperator,
+)
+
+logger = logging.getLogger("keystone_tpu")
+
+#: Rule ids -> one-line descriptions (the catalog tools/lint_report.py
+#: and the README render; tests assert it stays in sync with the rules).
+GRAPH_RULES: Dict[str, str] = {
+    "KG001": "non-jittable (host) transformer on the serving chain",
+    "KG002": "row-coupled stage on the serving chain (padding unsound)",
+    "KG003": "gather/multi-input node on the serving chain (not linear)",
+    "KG101": "shape-polymorphic input feeds jit consumers without buckets",
+    "KG102": "silent dtype upcast / mixed-dtype seam across nodes",
+    "KG201": "dead node unreachable from the pipeline sink",
+    "KG202": "re-used subchain with no cache node",
+}
+
+
+class LintError(ValueError):
+    """Raised by the KEYSTONE_LINT=error gate on error-severity findings."""
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One structured finding: rule id, severity, where, what, and how to
+    fix it — the graph-layer analog of a compiler diagnostic."""
+
+    rule: str
+    severity: str  # "error" | "warning" | "info"
+    node: str      # "n12:RandomPatcher" or "-" for graph-wide findings
+    message: str
+    hint: str = ""
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "node": self.node,
+            "message": self.message,
+            "hint": self.hint,
+        }
+
+
+@dataclass
+class LintReport:
+    """The diagnostics of one lint pass, with severity accessors.
+    ``as_dicts()`` is the interchange shape ``tools/lint_report.py``'s
+    shared formatter consumes; ``render()`` is a dependency-free inline
+    rendering for interactive use."""
+
+    diagnostics: List[Diagnostic] = field(default_factory=list)
+
+    def __iter__(self):
+        return iter(self.diagnostics)
+
+    def __len__(self) -> int:
+        return len(self.diagnostics)
+
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "error"]
+
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "warning"]
+
+    def by_rule(self, rule: str) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.rule == rule]
+
+    def as_dicts(self) -> List[dict]:
+        return [d.as_dict() for d in self.diagnostics]
+
+    def render(self) -> str:
+        """Human-readable table (one line per finding)."""
+        if not self.diagnostics:
+            return "pipeline lint: clean"
+        lines = []
+        for d in sorted(
+            self.diagnostics,
+            key=lambda d: ({"error": 0, "warning": 1, "info": 2}[d.severity],
+                           d.rule),
+        ):
+            loc = f" @ {d.node}" if d.node != "-" else ""
+            hint = f" [{d.hint}]" if d.hint else ""
+            lines.append(f"{d.severity:<7} {d.rule}{loc}: {d.message}{hint}")
+        return "\n".join(lines)
+
+
+def _node_label(graph: Graph, nid: NodeId) -> str:
+    return f"{nid!r}:{graph.operators[nid].label()}"
+
+
+# ---------------------------------------------------------------------------
+# Abstract shape/dtype propagation
+# ---------------------------------------------------------------------------
+
+
+def _spec_of_value(data: Any):
+    """A ShapeDtypeStruct for a concrete batch, or None for host objects
+    without array shape/dtype (token lists, strings)."""
+    import numpy as np
+
+    import jax
+
+    shape = getattr(data, "shape", None)
+    dtype = getattr(data, "dtype", None)
+    if shape is None or dtype is None:
+        return None
+    try:
+        return jax.ShapeDtypeStruct(tuple(int(s) for s in shape),
+                                    np.dtype(dtype))
+    except (TypeError, ValueError):
+        return None
+
+
+def _input_spec(example: Any) -> Tuple[Any, bool]:
+    """Resolve the lint input: (spec-or-None, polymorphic_batch).
+
+    ``example`` may be a sample batch (array), a ``jax.ShapeDtypeStruct``,
+    a per-row feature-shape tuple (batch dim unknown -> polymorphic, a
+    nominal batch stands in for propagation), or None (no input spec —
+    dataset-rooted subgraphs still propagate; the batch is treated as
+    polymorphic, which is what serving traffic is).
+    """
+    import numpy as np
+
+    import jax
+
+    if example is None:
+        return None, True
+    if isinstance(example, jax.ShapeDtypeStruct):
+        return example, False
+    if isinstance(example, tuple) and all(isinstance(d, int) for d in example):
+        from keystone_tpu.config import config
+
+        return (
+            jax.ShapeDtypeStruct((8,) + example, np.dtype(config.default_dtype)),
+            True,
+        )
+    spec = _spec_of_value(np.asarray(example))
+    return spec, False
+
+
+def propagate_specs(
+    graph: Graph, sink: GraphId, source_spec: Any = None
+) -> Dict[GraphId, Any]:
+    """Abstract interpretation of the DAG: walk in topological order and
+    compute each node's output ``ShapeDtypeStruct`` via ``jax.eval_shape``
+    on the transformer's batch function — symbolic execution, no device
+    work, no data. Unknown stays unknown (None) and poisons downstream
+    specs rather than guessing: estimator fits (the fitted transformer is
+    a runtime value), host transformers, and anything eval_shape refuses.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    specs: Dict[GraphId, Any] = {}
+    for nid in graph.reachable([sink]):
+        op = graph.operators[nid]
+        deps = graph.dependencies[nid]
+        dep_specs = [
+            specs.get(d) if isinstance(d, NodeId) else source_spec
+            for d in deps
+        ]
+        out = None
+        if isinstance(op, DatasetOperator):
+            out = _spec_of_value(op.data)
+        elif isinstance(op, DatumOperator):
+            out = None
+        elif isinstance(op, TransformerOperator):
+            t = op.transformer
+            if getattr(t, "jittable", False) and dep_specs and dep_specs[0] is not None:
+                try:
+                    out = jax.eval_shape(t.apply_batch, dep_specs[0])
+                except Exception:  # lint: broad-ok abstract eval is best-effort; unknown, not fatal
+                    out = None
+        elif isinstance(op, GatherOperator):
+            if dep_specs and all(s is not None for s in dep_specs):
+                try:
+                    out = jax.eval_shape(
+                        lambda *xs: jnp.concatenate(
+                            [jnp.asarray(x) for x in xs], axis=-1
+                        ),
+                        *dep_specs,
+                    )
+                except Exception:  # lint: broad-ok mismatched branches reported by KG102, not a crash
+                    out = None
+        elif getattr(op, "persist", False):  # identity cache node
+            out = dep_specs[0] if dep_specs else None
+        # Estimator / Delegating / unknown operators: runtime values.
+        specs[nid] = out
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# The serve-chain walk (the non-throwing twin of executor.serving_chain)
+# ---------------------------------------------------------------------------
+
+
+def _walk_serve_chain(graph: Graph, source: SourceId, sink: GraphId):
+    """Walk sink -> source the way ``GraphExecutor.serving_chain`` would
+    after fit: see through cache nodes, follow a DelegatingOperator's
+    input edge (its estimator resolves at fit time). Returns
+    (chain_nodes sink-first, first_nonlinear_node_or_None)."""
+    chain: List[NodeId] = []
+    gid: GraphId = sink
+    while gid != source:
+        if isinstance(gid, SourceId):
+            return chain, None  # foreign source; composition artifact
+        op = graph.operators[gid]
+        deps = graph.dependencies[gid]
+        if getattr(op, "persist", False):
+            gid = deps[0]
+            continue
+        if isinstance(op, DelegatingOperator):
+            chain.append(gid)
+            gid = deps[1]  # [estimator, input]
+            continue
+        if isinstance(op, GatherOperator) or len(deps) != 1:
+            return chain, gid
+        chain.append(gid)
+        gid = deps[0]
+    return chain, None
+
+
+# ---------------------------------------------------------------------------
+# The lint pass
+# ---------------------------------------------------------------------------
+
+
+def lint_graph(
+    graph: Graph,
+    source: SourceId,
+    sink: GraphId,
+    example: Any = None,
+    serve: bool = False,
+    have_ladder: Optional[bool] = None,
+) -> LintReport:
+    """Run every graph rule over ``graph`` and return a ``LintReport``.
+
+    ``serve=True`` escalates the serveability rules (KG00x) to errors —
+    the pre-``compiled()`` contract. ``example`` feeds the shape/dtype
+    propagation (see ``_input_spec``); ``have_ladder`` overrides the
+    bucket-ladder detection for KG101 (None = read
+    ``config.serve_buckets``).
+    """
+    from keystone_tpu.config import config
+
+    report = LintReport()
+    emit = report.diagnostics.append
+    serve_sev = "error" if serve else "warning"
+
+    order = graph.reachable([sink])
+    live = set(order)
+
+    # -- KG201: dead nodes -------------------------------------------------
+    for nid in graph.operators:
+        if nid not in live:
+            emit(Diagnostic(
+                "KG201", "info", _node_label(graph, nid),
+                "node is unreachable from the pipeline sink",
+                hint="prune with graph.pruned([sink])",
+            ))
+
+    # -- serveability: KG001 / KG002 / KG003 -------------------------------
+    chain, nonlinear = _walk_serve_chain(graph, source, sink)
+    if nonlinear is not None:
+        emit(Diagnostic(
+            "KG003", serve_sev, _node_label(graph, nonlinear),
+            f"{graph.operators[nonlinear].label()} joins multiple inputs; "
+            "the bucketed serving engine compiles one linear program per "
+            "bucket and cannot host a join",
+            hint="serve the branches separately, or apply the gathered "
+                 "pipeline un-compiled (per-shape jit)",
+        ))
+    for nid in chain:
+        op = graph.operators[nid]
+        if not isinstance(op, TransformerOperator):
+            continue
+        t = op.transformer
+        if not getattr(t, "jittable", True):
+            emit(Diagnostic(
+                "KG001", serve_sev, _node_label(graph, nid),
+                f"{type(t).__name__} is not jittable; the AOT serving path "
+                "compiles the whole chain as one XLA program",
+                hint="keep host transformers off the serve path, or serve "
+                     "per-shape via Pipeline.apply",
+            ))
+        if not getattr(t, "row_independent", True):
+            emit(Diagnostic(
+                "KG002", serve_sev, _node_label(graph, nid),
+                f"{type(t).__name__} couples output rows to other input "
+                "rows (row_independent=False); bucket padding would change "
+                "real outputs",
+                hint="serve it per-shape (unset KEYSTONE_SERVE_BUCKETS) or "
+                     "keep the row-coupled stage off the bucketed path",
+            ))
+
+    # -- shape/dtype propagation: KG101 / KG102 ----------------------------
+    source_spec, polymorphic = _input_spec(example)
+    specs = propagate_specs(graph, sink, source_spec)
+
+    if have_ladder is None:
+        have_ladder = bool(config.serve_buckets)
+    jit_consumers = [
+        nid for nid in order
+        if isinstance(graph.operators[nid], TransformerOperator)
+        and getattr(graph.operators[nid].transformer, "jittable", False)
+    ]
+    if polymorphic and jit_consumers and not have_ladder:
+        emit(Diagnostic(
+            "KG101", "warning", _node_label(graph, jit_consumers[0]),
+            f"shape-polymorphic input feeds {len(jit_consumers)} jit "
+            "node(s) with no bucket ladder: every distinct batch size "
+            "recompiles the fused chain",
+            hint="set KEYSTONE_SERVE_BUCKETS (or serve via "
+                 "Pipeline.compiled(), which pads onto a pow-2 ladder)",
+        ))
+
+    for nid in order:
+        op = graph.operators[nid]
+        out = specs.get(nid)
+        if out is None:
+            continue
+        deps = graph.dependencies[nid]
+        dep_specs = [
+            specs.get(d) if isinstance(d, NodeId) else source_spec
+            for d in deps
+        ]
+        if isinstance(op, GatherOperator):
+            dts = {str(s.dtype) for s in dep_specs if s is not None}
+            if len(dts) > 1:
+                emit(Diagnostic(
+                    "KG102", "warning", _node_label(graph, nid),
+                    f"gather joins mixed dtypes {sorted(dts)}; XLA silently "
+                    f"upcasts the concatenation to {out.dtype}",
+                    hint="cast the narrower branch explicitly where the "
+                         "width is intended",
+                ))
+            continue
+        if isinstance(op, TransformerOperator):
+            d0 = dep_specs[0] if dep_specs else None
+            if (
+                d0 is not None
+                and out.dtype != d0.dtype
+                and out.dtype.itemsize > d0.dtype.itemsize
+            ):
+                emit(Diagnostic(
+                    "KG102", "warning", _node_label(graph, nid),
+                    f"silent upcast {d0.dtype} -> {out.dtype} across "
+                    f"{op.label()}: doubles bytes/HBM for everything "
+                    "downstream",
+                    hint="cast explicitly if intended, or compute at the "
+                         "input dtype",
+                ))
+
+    # -- KG202: cache placement advice -------------------------------------
+    consumers = graph.consumers([sink])
+    for gid, users in consumers.items():
+        if not isinstance(gid, NodeId):
+            continue
+        op = graph.operators[gid]
+        if isinstance(op, (DatasetOperator, DatumOperator)):
+            continue  # constants are free to "recompute"
+        if getattr(op, "persist", False):
+            continue
+        node_users = [u for u in users if isinstance(u, NodeId)]
+        if len(node_users) < 2:
+            continue
+        if any(
+            getattr(graph.operators[u], "persist", False) for u in node_users
+        ):
+            continue  # one consumer is already a cache node
+        emit(Diagnostic(
+            "KG202", "info", _node_label(graph, gid),
+            f"subchain output is consumed by {len(node_users)} nodes with "
+            "no cache node; each consumer recomputes the prefix",
+            hint="insert .cache() after the shared prefix (or enable "
+                 "config.auto_cache)",
+        ))
+
+    return report
+
+
+# ---------------------------------------------------------------------------
+# The opt-in pre-fit / pre-compiled gate
+# ---------------------------------------------------------------------------
+
+
+def enforce_lint(pipeline, stage: str, serve: bool = False,
+                 have_ladder: Optional[bool] = None) -> Optional[LintReport]:
+    """Run the graph lint as a gate when ``KEYSTONE_LINT`` asks for it.
+
+    ``off`` (default): no-op, zero cost beyond one config read.
+    ``warn``: log each finding at its severity, never block.
+    ``error``: additionally raise ``LintError`` when any error-severity
+    finding exists — the pre-execution refusal the rule catalog promises.
+    """
+    from keystone_tpu.config import config
+
+    mode = config.lint
+    if mode == "off":
+        return None
+    report = lint_graph(
+        pipeline.graph, pipeline.source, pipeline.sink,
+        serve=serve, have_ladder=have_ladder,
+    )
+    for d in report:
+        log = logger.error if d.severity == "error" else (
+            logger.warning if d.severity == "warning" else logger.info
+        )
+        log("lint[%s] %s %s: %s", stage, d.rule, d.node, d.message)
+    errors = report.errors()
+    if mode == "error" and errors:
+        raise LintError(
+            f"KEYSTONE_LINT=error: {len(errors)} error-severity finding(s) "
+            f"before {stage}:\n" + "\n".join(
+                f"  {d.rule} {d.node}: {d.message}" for d in errors
+            )
+        )
+    return report

@@ -144,7 +144,7 @@ class Profiler:
                     _flops_ratio_memo.pop(next(iter(_flops_ratio_memo)))
                 _flops_ratio_memo[key] = ratio
             return ratio
-        except Exception:
+        except Exception:  # lint: broad-ok cost-model probe: any lowering failure means 'no FLOPs correction'
             return None
 
     @staticmethod
@@ -159,7 +159,7 @@ class Profiler:
 
             try:
                 probe = copy.deepcopy(op.estimator)
-            except Exception:  # unpicklable estimator state: shallow guard
+            except Exception:  # lint: broad-ok deepcopy of arbitrary estimator state can raise anything: shallow guard
                 probe = copy.copy(op.estimator)
             return EstimatorOperator(probe).execute(dep_vals)
         return op.execute(dep_vals)

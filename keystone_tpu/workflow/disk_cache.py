@@ -209,7 +209,7 @@ class DiskCache:
             names = os.listdir(self.root)
         except OSError:
             return
-        now = time.time()
+        now = time.time()  # lint: ok(KL005) compared against st_mtime, which is wall-clock
         for name in names:
             if not self._owns(name, extra=".tmp"):
                 continue
@@ -282,7 +282,7 @@ class DiskCache:
                 fitted = _load_entry(f)
         except FileNotFoundError:
             return None
-        except Exception as e:  # corrupt/unpicklable entry: miss, don't die
+        except Exception as e:  # lint: broad-ok corrupt/unpicklable entry (any unpickling error): miss, don't die
             logger.warning("disk fit cache: dropping unreadable %s (%s)", path, e)
             try:
                 os.remove(path)
@@ -329,7 +329,7 @@ class DiskCache:
                 except OSError:
                     pass
                 raise
-        except Exception as e:  # persistence is best-effort
+        except Exception as e:  # lint: broad-ok persistence is best-effort; a failed put must never fail the fit
             logger.warning("disk fit cache: could not persist %s (%s)", key, e)
 
 

@@ -321,9 +321,7 @@ class PipelineEnv:
     _instance: Optional["PipelineEnv"] = None
 
     def __init__(self):
-        import os
-
-        from keystone_tpu.config import config
+        from keystone_tpu.config import resolved_cache_dir
         from keystone_tpu.workflow.optimizer import default_optimizer
 
         self.optimizer = default_optimizer()
@@ -332,13 +330,10 @@ class PipelineEnv:
         self.fit_cache: Dict[int, Any] = {}
         # structural hash -> persisted value (auto-cache rule / Cacher nodes)
         self.node_cache: Dict[int, Any] = {}
-        # Cross-process fitted-prefix store, keyed by content digest.
-        # Env presence (not truthiness) decides precedence: an exported
-        # empty var explicitly disables the store.
-        if "KEYSTONE_CACHE_DIR" in os.environ:
-            cache_dir = os.environ["KEYSTONE_CACHE_DIR"]
-        else:
-            cache_dir = config.cache_dir
+        # Cross-process fitted-prefix store, keyed by content digest; the
+        # env-presence-over-config precedence lives in config.py so the
+        # os.environ read stays out of this module (keystone-lint KL003).
+        cache_dir = resolved_cache_dir()
         self.disk_cache = None
         if cache_dir:
             from keystone_tpu.workflow.disk_cache import DiskFitCache

@@ -484,8 +484,12 @@ class Pipeline:
         from contextlib import nullcontext
 
         from keystone_tpu.utils.metrics import active_tracer
+        from keystone_tpu.workflow.analysis import enforce_lint
         from keystone_tpu.workflow.executor import PipelineEnv
 
+        # Opt-in static gate (KEYSTONE_LINT=warn|error, default off):
+        # graph hazards surface before any estimator runs.
+        enforce_lint(self, "fit")
         # Cold path (once per fit): nullcontext keeps one call body; the
         # hot loops (solvers, prefetch, serving) branch explicitly instead.
         tracer = active_tracer()
@@ -511,14 +515,35 @@ class Pipeline:
         recompiles. Requires the serve path to be a linear chain of
         jittable, row-independent transformers.
         """
+        from keystone_tpu.workflow.analysis import enforce_lint
         from keystone_tpu.workflow.serving import CompiledPipeline
 
+        # Opt-in static gate: with KEYSTONE_LINT=error a chain the engine
+        # would refuse (host/row-coupled/gather nodes) fails HERE, with a
+        # rule id and fix hint, before fit or warmup spend any compute.
+        # The engine always has a bucket ladder, so KG101 is moot.
+        enforce_lint(self, "compiled", serve=True, have_ladder=True)
         return CompiledPipeline(
             self, buckets=buckets, max_batch=max_batch, donate=donate,
             devices=devices, inflight=inflight,
         )
 
     # -- introspection -----------------------------------------------------
+
+    def lint(self, example=None, serve: bool = False,
+             have_ladder=None) -> "LintReport":
+        """Statically lint the pipeline DAG (workflow/analysis.py): the
+        abstract shape/dtype pass plus the KG rule catalog. ``example``
+        (sample batch, ShapeDtypeStruct, or per-row feature-shape tuple)
+        feeds shape propagation; ``serve=True`` escalates serveability
+        findings to errors — the would-be ``compiled()`` contract.
+        Returns a ``LintReport``; never executes the graph."""
+        from keystone_tpu.workflow.analysis import lint_graph
+
+        return lint_graph(
+            self.graph, self.source, self.sink,
+            example=example, serve=serve, have_ladder=have_ladder,
+        )
 
     def transformers(self) -> List[Transformer]:
         """Transformer chain in topological order (fitted pipelines only)."""

@@ -146,7 +146,7 @@ class NodeOptimizationRule(Rule):
                         try:
                             sampled = self._sample_prefixes(graph, targets)
                             sample_ok = True
-                        except Exception:
+                        except Exception:  # lint: broad-ok sample-run probe over arbitrary user operators
                             # A prefix that can't run on a 64-row sample
                             # must not crash optimization: affected
                             # estimators keep their fit-time dispatch.

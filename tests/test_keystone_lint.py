@@ -1,0 +1,504 @@
+"""AST invariant-checker tests (tools/keystone_lint.py, Layer 2).
+
+Every shipped KL rule is pinned by one synthetic violating snippet and
+one clean one, the PR-5 lost-wakeup serving bug is reproduced as a
+regression fixture (and its per-waiter-condition FIX must lint clean),
+the live workflow/serving.py must carry zero concurrency findings, and
+the repo-wide gate (`make lint`'s AST half) runs in-process against the
+checked-in baseline so it can never silently rot.
+"""
+
+import importlib
+import os
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO_ROOT, "tools")
+sys.path.insert(0, TOOLS)
+
+keystone_lint = importlib.import_module("keystone_lint")
+
+
+def lint_snippet(tmp_path, source, name="snippet.py"):
+    """Scan one synthetic module; returns the findings."""
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    findings, _keys = keystone_lint.scan([str(p)], root=str(tmp_path))
+    return findings
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# KL001 lock discipline
+# ---------------------------------------------------------------------------
+
+SERVICE_SHAPE = """
+    import threading
+
+    class PipelineService:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition(self._lock)
+            self._pending = []
+            self.batches_run = 0
+            self._worker = threading.Thread(target=self._loop, daemon=True)
+
+        def submit(self, x):
+            with self._cv:
+                self._pending.append(x)
+                self.batches_run += 1
+                self._cv.notify_all()
+
+        def _loop(self):
+            while True:
+                with self._cv:
+                    x = self._pending.pop()
+                {loop_tail}
+
+        def close(self):
+            with self._cv:
+                self._pending.clear()
+"""
+
+
+def test_kl001_catches_service_shared_attr_mutated_outside_lock(tmp_path):
+    # The acceptance fixture: a PipelineService-shaped class whose worker
+    # bumps a shared counter OUTSIDE self._lock while submit bumps it
+    # under the lock.
+    bad = SERVICE_SHAPE.format(loop_tail="self.batches_run += 1")
+    findings = [f for f in lint_snippet(tmp_path, bad) if f.rule == "KL001"]
+    assert len(findings) == 1
+    f = findings[0]
+    assert "batches_run" in f.message and "_loop" in f.message
+    assert f.severity == "error"
+
+
+def test_kl001_clean_when_every_write_is_locked(tmp_path):
+    good = SERVICE_SHAPE.format(
+        loop_tail="with self._lock:\n                    self.batches_run += 1"
+    )
+    assert "KL001" not in rules_of(lint_snippet(tmp_path, good))
+
+
+def test_kl001_locked_suffix_convention_and_single_owner_attrs(tmp_path):
+    src = """
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.compiles = 0
+            self.private = 0
+
+        def warmup(self):
+            with self._lock:
+                self._compile_locked()
+
+        def serve(self):
+            with self._lock:
+                self._compile_locked()
+
+        def _compile_locked(self):
+            self.compiles += 1  # caller holds the lock: the convention
+
+        def stats_only(self):
+            self.private += 1  # single entry point: not shared state
+    """
+    assert "KL001" not in rules_of(lint_snippet(tmp_path, src))
+
+
+def test_kl001_mutator_calls_count_as_writes(tmp_path):
+    bad = SERVICE_SHAPE.format(loop_tail="self._pending.append(x)")
+    findings = [f for f in lint_snippet(tmp_path, bad) if f.rule == "KL001"]
+    assert findings and "mutates self._pending" in findings[0].message
+
+
+def test_kl001_suppression_tag(tmp_path):
+    bad = SERVICE_SHAPE.format(
+        loop_tail="self.batches_run += 1  # lint: ok(KL001) benign stats race"
+    )
+    assert "KL001" not in rules_of(lint_snippet(tmp_path, bad))
+
+
+# ---------------------------------------------------------------------------
+# KL002 lock ordering
+# ---------------------------------------------------------------------------
+
+TWO_LOCKS = """
+    import threading
+
+    class Two:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def m1(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def m2(self):
+            with {second}:
+                with {inner}:
+                    pass
+"""
+
+
+def test_kl002_opposite_order_cycle_flagged(tmp_path):
+    bad = TWO_LOCKS.format(second="self._b", inner="self._a")
+    findings = [f for f in lint_snippet(tmp_path, bad) if f.rule == "KL002"]
+    assert findings and "cycle" in findings[0].message
+
+
+def test_kl002_consistent_order_clean(tmp_path):
+    good = TWO_LOCKS.format(second="self._a", inner="self._b")
+    assert "KL002" not in rules_of(lint_snippet(tmp_path, good))
+
+
+def test_kl002_nested_nonreentrant_lock_flagged(tmp_path):
+    src = """
+    import threading
+
+    class SelfDeadlock:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def m(self):
+            with self._lock:
+                with self._lock:
+                    pass
+    """
+    findings = [f for f in lint_snippet(tmp_path, src) if f.rule == "KL002"]
+    assert findings and "non-reentrant" in findings[0].message
+
+
+def test_kl002_condition_aliases_to_its_shared_lock(tmp_path):
+    # with self._cv: with self._lock: -- same underlying lock.
+    src = """
+    import threading
+
+    class Aliased:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition(self._lock)
+
+        def m(self):
+            with self._cv:
+                with self._lock:
+                    pass
+    """
+    findings = [f for f in lint_snippet(tmp_path, src) if f.rule == "KL002"]
+    assert findings and "non-reentrant" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# KL008 lost wakeup (the PR-5 serving bug, pinned)
+# ---------------------------------------------------------------------------
+
+PR5_SHAPE = """
+    import threading
+
+    class Service:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition(self._lock)
+            {extra_init}
+            self._pending = []
+            self._worker = threading.Thread(target=self._loop)
+            self._completer = threading.Thread(target=self._complete_loop)
+
+        def submit(self, x):
+            with self._cv:
+                self._pending.append(x)
+                self._cv.notify()
+
+        def _loop(self):
+            with self._cv:
+                while not self._pending:
+                    self._cv.wait()
+
+        def _complete_loop(self):
+            with {completer_cv}:
+                {completer_cv}.wait()
+    """
+
+
+def test_kl008_pr5_lost_wakeup_shape_is_flagged(tmp_path):
+    # Pre-fix PR-5: dispatcher AND completer wait on ONE condition; a
+    # submit notify() meant for the dispatcher can wake the completer
+    # instead -> stranded request.
+    bad = PR5_SHAPE.format(extra_init="pass", completer_cv="self._cv")
+    findings = [f for f in lint_snippet(tmp_path, bad) if f.rule == "KL008"]
+    assert findings
+    assert "lost wakeup" in findings[0].message
+    assert "_complete_loop" in findings[0].message
+    assert "_loop" in findings[0].message
+
+
+def test_kl008_per_waiter_conditions_fix_is_clean(tmp_path):
+    # The PR-5 FIX: each waiter class gets its own Condition over the
+    # shared lock. Distinct wait-sets -> notify() is safe again.
+    good = PR5_SHAPE.format(
+        extra_init="self._ccv = threading.Condition(self._lock)",
+        completer_cv="self._ccv",
+    )
+    assert "KL008" not in rules_of(lint_snippet(tmp_path, good))
+
+
+def test_kl008_notify_all_is_clean(tmp_path):
+    bad = PR5_SHAPE.format(extra_init="pass", completer_cv="self._cv")
+    good = bad.replace("self._cv.notify()", "self._cv.notify_all()")
+    assert "KL008" not in rules_of(lint_snippet(tmp_path, good))
+
+
+# ---------------------------------------------------------------------------
+# KL003 env reads / KL004 resolve-once / KL005 wall-clock
+# ---------------------------------------------------------------------------
+
+
+def test_kl003_env_read_flagged_and_tag_suppresses(tmp_path):
+    bad = """
+    import os
+
+    MODE = os.environ.get("SOME_KNOB", "x")
+    OTHER = os.getenv("OTHER_KNOB")
+    """
+    assert rules_of(lint_snippet(tmp_path, bad)) == ["KL003"]
+    tagged = bad.replace(
+        'MODE = os.environ.get("SOME_KNOB", "x")',
+        'MODE = os.environ.get("SOME_KNOB", "x")  # lint: ok(KL003) why',
+    ).replace(
+        'OTHER = os.getenv("OTHER_KNOB")',
+        'OTHER = os.getenv("OTHER_KNOB")  # lint: ok(KL003) why',
+    )
+    assert "KL003" not in rules_of(lint_snippet(tmp_path, tagged))
+
+
+def test_kl003_config_py_is_exempt():
+    findings, _ = keystone_lint.scan(
+        ["keystone_tpu/config.py"], root=REPO_ROOT
+    )
+    assert "KL003" not in rules_of(findings)
+
+
+def test_kl004_resolve_in_loop_flagged_hoisted_clean(tmp_path):
+    bad = """
+    from keystone_tpu.utils.reliability import active_plan
+
+    def stream(records):
+        for r in records:
+            plan = active_plan()
+    """
+    findings = [f for f in lint_snippet(tmp_path, bad) if f.rule == "KL004"]
+    assert findings and "active_plan" in findings[0].message
+    good = """
+    from keystone_tpu.utils.metrics import active_tracer
+
+    def stream(records):
+        tracer = active_tracer()
+        for r in records:
+            pass
+    """
+    assert "KL004" not in rules_of(lint_snippet(tmp_path, good))
+
+
+def test_kl004_nested_function_resets_loop_context(tmp_path):
+    src = """
+    from keystone_tpu.utils.reliability import active_plan
+
+    def outer(items):
+        for i in items:
+            pass
+
+        def helper():
+            return active_plan()  # not in a loop at runtime
+        return helper
+    """
+    # the def sits lexically after a loop but not inside one
+    assert "KL004" not in rules_of(lint_snippet(tmp_path, src))
+
+
+def test_kl005_time_time_flagged_perf_counter_clean(tmp_path):
+    bad = """
+    import time
+
+    def timed():
+        t0 = time.time()
+        return time.time() - t0
+    """
+    assert len(
+        [f for f in lint_snippet(tmp_path, bad) if f.rule == "KL005"]
+    ) == 2
+    good = bad.replace("time.time()", "time.perf_counter()")
+    assert "KL005" not in rules_of(lint_snippet(tmp_path, good))
+
+
+# ---------------------------------------------------------------------------
+# KL006 broad except
+# ---------------------------------------------------------------------------
+
+
+def test_kl006_bare_broad_handler_flagged(tmp_path):
+    bad = """
+    def f():
+        try:
+            work()
+        except Exception:
+            return None
+    """
+    findings = [f for f in lint_snippet(tmp_path, bad) if f.rule == "KL006"]
+    assert findings
+
+
+@pytest.mark.parametrize(
+    "body",
+    [
+        "raise",                                   # re-raise
+        "raise RuntimeError('translated') from e", # translate + raise
+        "if is_oom(e):\n                return None\n            raise",
+        "return is_transient(e)",                  # reliability routing
+    ],
+)
+def test_kl006_reraise_or_classification_passes(tmp_path, body):
+    src = f"""
+    from keystone_tpu.utils.reliability import is_oom, is_transient
+
+    def f():
+        try:
+            work()
+        except Exception as e:
+            {body}
+    """
+    assert "KL006" not in rules_of(lint_snippet(tmp_path, src))
+
+
+def test_kl006_broad_ok_tag_passes_and_base_exception_covered(tmp_path):
+    src = """
+    def f():
+        try:
+            work()
+        except BaseException:  # lint: broad-ok surfaced on the consumer side
+            return None
+    """
+    assert "KL006" not in rules_of(lint_snippet(tmp_path, src))
+    untagged = src.replace("  # lint: broad-ok surfaced on the consumer side", "")
+    assert "KL006" in rules_of(lint_snippet(tmp_path, untagged))
+
+
+# ---------------------------------------------------------------------------
+# KL007 dispatch-path host syncs
+# ---------------------------------------------------------------------------
+
+
+def test_kl007_host_sync_in_dispatch_flagged_completion_side_clean(tmp_path):
+    bad = """
+    import numpy as np
+
+    class Service:
+        def _dispatch(self, group):
+            out = self.handle.block_until_ready()
+            return np.asarray(out)
+
+        def _complete_chunk(self, lc):
+            return np.asarray(lc.out)  # completion side: allowed
+    """
+    findings = [f for f in lint_snippet(tmp_path, bad) if f.rule == "KL007"]
+    assert len(findings) == 2  # both syncs in _dispatch, none in completion
+    assert all("_dispatch" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# The live serving module + the repo gate
+# ---------------------------------------------------------------------------
+
+
+def test_live_serving_module_has_zero_concurrency_findings():
+    """workflow/serving.py is the module these rules were written FOR:
+    after the PR's fixes it must carry no lock-discipline, lock-order,
+    lost-wakeup, or dispatch-sync findings at all."""
+    findings, _ = keystone_lint.scan(
+        ["keystone_tpu/workflow/serving.py"], root=REPO_ROOT
+    )
+    concurrency = [
+        f for f in findings if f.rule in ("KL001", "KL002", "KL007", "KL008")
+    ]
+    assert not concurrency, [(f.rule, f.line, f.message) for f in concurrency]
+
+
+def test_repo_gate_is_green_against_checked_in_baseline(capsys):
+    """`make lint`'s AST half, in-process (the trace-demo idiom): the
+    shipped tree + shipped baseline must produce zero NEW findings."""
+    rc = keystone_lint.main([])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "0 new" in out
+
+
+def test_baseline_entries_all_carry_a_reason():
+    import json
+
+    with open(os.path.join(TOOLS, "lint_baseline.json")) as f:
+        doc = json.load(f)
+    assert doc["entries"], "baseline exists to demonstrate the workflow"
+    for e in doc["entries"]:
+        assert e.get("why") and "TODO" not in e["why"], e
+
+
+def test_new_violation_fails_the_gate(tmp_path):
+    """Zero tolerance on NEW findings: a fresh violation in a scanned file
+    is not absorbed by the baseline."""
+    pkg = tmp_path / "keystone_tpu"
+    pkg.mkdir()
+    (pkg / "fresh.py").write_text(
+        "import os\nKNOB = os.environ.get('NEW_KNOB')\n"
+    )
+    baseline_path = os.path.join(REPO_ROOT, "tools", "lint_baseline.json")
+    rc = keystone_lint.main(
+        ["keystone_tpu", "--root", str(tmp_path),
+         "--baseline", baseline_path]
+    )
+    assert rc == 1
+
+
+def test_baseline_matching_is_count_aware(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import os\nA = os.environ.get('K')\nA = os.environ.get('K')\n"
+    )
+    findings, keys = keystone_lint.scan([str(tmp_path / "m.py")],
+                                        root=str(tmp_path))
+    assert len(findings) == 2 and keys[0] == keys[1]
+    one = {"entries": [{"key": keys[0], "why": "x"}]}
+    fresh = keystone_lint.new_findings(findings, keys, one)
+    assert len(fresh) == 1  # one budgeted, one new
+    two = {"entries": [{"key": keys[0], "why": "x"}] * 2}
+    assert not keystone_lint.new_findings(findings, keys, two)
+
+
+def test_ast_rule_catalog_ids_match_severities():
+    assert set(keystone_lint.AST_RULES) == set(keystone_lint.SEVERITY)
+    assert len(keystone_lint.AST_RULES) >= 5  # the acceptance floor
+
+
+def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
+    (tmp_path / "broken.py").write_text("def f(:\n")
+    findings, keys = keystone_lint.scan([str(tmp_path / "broken.py")],
+                                        root=str(tmp_path))
+    assert rules_of(findings) == ["KL000"]
+    assert findings[0].severity == "error"  # must not KeyError
+    assert findings[0].as_dict()["severity"] == "error"
+
+
+def test_nonexistent_scan_path_fails_loudly(tmp_path, capsys):
+    """A misspelled path must not make the zero-tolerance gate pass
+    vacuously: scan() raises, the CLI exits 2."""
+    with pytest.raises(FileNotFoundError):
+        keystone_lint.scan(["no_such_dir"], root=str(tmp_path))
+    rc = keystone_lint.main(["no_such_dir", "--root", str(tmp_path)])
+    assert rc == 2
+    assert "does not exist" in capsys.readouterr().err

@@ -1,0 +1,780 @@
+#!/usr/bin/env python3
+"""keystone-lint: the codebase invariant checker (Layer 2, stdlib ast).
+
+Encodes the concurrency and hot-path disciplines this repo already bled
+for — lock-guarded serving state (PR 2/5), the resolve-once rule for
+``active_plan()``/``active_tracer()`` (PR 3/4), env-read-once via
+``config`` — as mechanical checks, so they are enforced by a tool
+instead of reviewer memory. Pure stdlib (``ast`` + ``json``): no jax, no
+keystone_tpu import, so it runs anywhere in milliseconds.
+
+Rule catalog (KL = Keystone Lint):
+
+- ``KL001 lock-discipline`` — in a thread-spawning or lock-holding
+  class, an instance attribute mutated from >= 2 thread entry points
+  must be written under ``with self._lock``/``self._cv``/... (or from a
+  ``*_locked`` method, the repo's caller-holds-the-lock convention).
+- ``KL002 lock-order`` — lock-acquisition-order cycles across
+  ``Lock``/``Condition`` sites (A under B in one method, B under A in
+  another), plus nested acquisition of one non-reentrant lock.
+  Conditions constructed over a shared Lock alias to it.
+- ``KL003 env-read`` — ``os.environ``/``os.getenv`` outside config.py:
+  env knobs are read once at config import, never on hot paths.
+- ``KL004 resolve-once`` — ``active_plan()``/``active_tracer()`` called
+  inside a loop body: resolve once per stream/solve/service.
+- ``KL005 wall-clock-timing`` — ``time.time()`` in library code: spans
+  and latencies use ``perf_counter``; wall-clock survivors carry a tag.
+- ``KL006 broad-except`` — an ``except Exception/BaseException`` must
+  re-raise, route through ``utils/reliability`` classification
+  (``is_transient``/``is_oom``), or carry a ``# lint: broad-ok`` tag
+  with a reason.
+- ``KL007 dispatch-host-sync`` — blocking host syncs
+  (``block_until_ready``, ``device_get``, ``np.asarray``) inside the
+  serving dispatch path (``submit``/``_loop``/``_dispatch``/...): the
+  dispatcher must never wait on a device.
+- ``KL008 lost-wakeup`` — ``notify()`` (not ``notify_all``) on a
+  condition that >= 2 distinct thread-target methods wait on: one
+  waiter can consume a wakeup meant for another (the PR-5 serving bug).
+
+Suppression: ``# lint: ok(KLnnn) reason`` on the flagged line (or the
+line above); ``# lint: broad-ok reason`` is the KL006 spelling. Findings
+neither fixed nor tagged live in the checked-in baseline
+(tools/lint_baseline.json, each entry with a justification) — the gate
+is zero-tolerance on findings NOT in the baseline, so the shipped tree
+lints clean and new violations can never ride in silently.
+
+Usage:
+    python tools/keystone_lint.py [paths...] [--baseline FILE]
+        [--write-baseline] [--json] [--no-baseline]
+
+Exit status: 0 = no new findings, 1 = new findings (listed), 2 = usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_PATHS = ["keystone_tpu"]
+DEFAULT_BASELINE = os.path.join("tools", "lint_baseline.json")
+
+#: Files whose env reads ARE the config layer (KL003 exempt).
+ENV_ALLOWED = {"keystone_tpu/config.py"}
+
+#: Method names that form the serving dispatch path (KL007): nothing in
+#: them may block on a device transfer.
+DISPATCH_METHODS = {"submit", "_loop", "_dispatch", "_pick_slot_locked",
+                    "_ensure_worker_locked"}
+HOST_SYNC_CALLS = {"block_until_ready", "device_get", "asarray", "array"}
+
+#: Mutating method names treated as writes for KL001 (deque/list/set/dict
+#: mutation on a self attribute).
+MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+            "pop", "popleft", "remove", "clear", "add", "discard",
+            "update", "setdefault"}
+
+AST_RULES: Dict[str, str] = {
+    "KL000": "file does not parse (syntax error)",
+    "KL001": "shared attribute written outside the instance lock",
+    "KL002": "lock-acquisition-order cycle / nested non-reentrant lock",
+    "KL003": "os.environ read outside config.py",
+    "KL004": "active_plan()/active_tracer() resolved inside a loop",
+    "KL005": "time.time() used in library code (use perf_counter)",
+    "KL006": "broad except without re-raise/classification/broad-ok tag",
+    "KL007": "blocking host sync on the serving dispatch path",
+    "KL008": "notify() on a condition waited on by >= 2 threads",
+}
+
+SEVERITY = {
+    "KL000": "error",
+    "KL001": "error", "KL002": "error", "KL003": "warning",
+    "KL004": "warning", "KL005": "warning", "KL006": "warning",
+    "KL007": "error", "KL008": "error",
+}
+
+
+class Finding:
+    __slots__ = ("rule", "path", "line", "message", "hint")
+
+    def __init__(self, rule: str, path: str, line: int, message: str,
+                 hint: str = ""):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.message = message
+        self.hint = hint
+
+    @property
+    def severity(self) -> str:
+        return SEVERITY[self.rule]
+
+    def key(self, source_lines: List[str]) -> str:
+        """Line-number-independent identity: rule | path | stripped source
+        text of the flagged line — stable across unrelated edits above."""
+        text = ""
+        if 1 <= self.line <= len(source_lines):
+            text = source_lines[self.line - 1].strip()
+        return f"{self.rule}|{self.path}|{text}"
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule, "severity": self.severity, "path": self.path,
+            "line": self.line, "message": self.message, "hint": self.hint,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers
+# ---------------------------------------------------------------------------
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'self._lock' / 'os.environ' textual form of a Name/Attribute chain
+    (None for anything fancier)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _lock_key(node: ast.AST) -> Optional[str]:
+    """Normalized lock identity of a with-item context expression:
+    'self._lock', or 'self._ccvs[]' for a subscripted lock pool."""
+    if isinstance(node, ast.Subscript):
+        base = _dotted(node.value)
+        return f"{base}[]" if base else None
+    return _dotted(node)
+
+
+def _suppressed(lines: List[str], lineno: int, rule: str) -> bool:
+    """True when the flagged line (or the one above it) carries a
+    ``# lint: ok(RULE)`` tag — or ``# lint: broad-ok`` for KL006."""
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(lines):
+            text = lines[ln - 1]
+            if f"lint: ok({rule})" in text:
+                return True
+            if rule == "KL006" and "lint: broad-ok" in text:
+                return True
+    return False
+
+
+def _self_attr_target(node: ast.AST) -> Optional[str]:
+    """Attribute name when ``node`` is a store on self: ``self.x``,
+    ``self.x[i]`` — the instance state KL001 guards."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    if isinstance(node, ast.Subscript):
+        return _self_attr_target(node.value)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return None  # handled element-wise by the caller
+    return None
+
+
+# ---------------------------------------------------------------------------
+# KL001 / KL002 / KL008 — the concurrency rules (per class)
+# ---------------------------------------------------------------------------
+
+
+class _MethodFacts:
+    """Everything the concurrency rules need from one method body."""
+
+    def __init__(self, name: str):
+        self.name = name
+        # (attr, locked, lineno, kind) — kind 'assign' | 'mutate'
+        self.writes: List[Tuple[str, bool, int, str]] = []
+        self.calls: Set[str] = set()          # self-method names called
+        self.thread_targets: Set[str] = set() # methods passed to Thread()
+        self.wait_locks: Set[str] = set()     # lock keys .wait()ed on
+        # (lock key, lineno) .notify() sites (notify_all is always safe)
+        self.notify_sites: List[Tuple[str, int]] = []
+        # (outer_key, inner_key, lineno) nested with-acquisitions
+        self.nestings: List[Tuple[str, str, int]] = []
+        self.spawns_thread = False
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    """Does this expression construct a Lock/RLock/Condition (directly or
+    inside a comprehension/list)?"""
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call):
+            callee = _dotted(sub.func) or ""
+            if callee.split(".")[-1] in ("Lock", "RLock", "Condition",
+                                         "Semaphore", "BoundedSemaphore"):
+                return True
+    return False
+
+
+def _condition_alias(expr: ast.AST) -> Optional[str]:
+    """For ``threading.Condition(self._lock)`` (possibly inside a list
+    comprehension), the dotted name of the shared underlying lock."""
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call):
+            callee = _dotted(sub.func) or ""
+            if callee.split(".")[-1] == "Condition" and sub.args:
+                return _dotted(sub.args[0])
+    return None
+
+
+def _collect_method(fn: ast.FunctionDef, lock_attrs: Set[str]) -> _MethodFacts:
+    facts = _MethodFacts(fn.name)
+
+    def lock_of(expr: ast.AST) -> Optional[str]:
+        key = _lock_key(expr)
+        if key is None or not key.startswith("self."):
+            return None
+        attr = key[len("self."):].rstrip("[]")
+        return key if attr in lock_attrs else None
+
+    def visit(node: ast.AST, held: Tuple[str, ...]) -> None:
+        if isinstance(node, ast.With):
+            acquired = []
+            for item in node.items:
+                key = lock_of(item.context_expr)
+                if key is not None:
+                    for h in held + tuple(acquired):
+                        facts.nestings.append((h, key, node.lineno))
+                    acquired.append(key)
+            inner = held + tuple(acquired)
+            for child in node.body:
+                visit(child, inner)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            flat = []
+            for t in targets:
+                flat.extend(t.elts if isinstance(t, (ast.Tuple, ast.List))
+                            else [t])
+            for t in flat:
+                attr = _self_attr_target(t)
+                if attr is not None:
+                    facts.writes.append(
+                        (attr, bool(held), node.lineno, "assign")
+                    )
+        if isinstance(node, ast.Call):
+            callee = _dotted(node.func) or ""
+            if callee.split(".")[-1] == "Thread":
+                facts.spawns_thread = True
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        tgt = _dotted(kw.value) or ""
+                        if tgt.startswith("self."):
+                            facts.thread_targets.add(tgt[len("self."):])
+            if isinstance(node.func, ast.Attribute):
+                recv = node.func.value
+                meth = node.func.attr
+                recv_txt = _dotted(recv)
+                if recv_txt == "self":
+                    facts.calls.add(meth)
+                # deque/list/dict mutation on a self attribute
+                attr = _self_attr_target(recv)
+                if attr is not None and meth in MUTATORS \
+                        and attr not in lock_attrs:
+                    facts.writes.append(
+                        (attr, bool(held), node.lineno, "mutate")
+                    )
+                # condition wait/notify sites (self._cv.wait(), incl.
+                # subscripted pools self._ccvs[r].wait())
+                lk = lock_of(recv)
+                if lk is not None:
+                    if meth == "wait":
+                        facts.wait_locks.add(lk)
+                    elif meth == "notify":
+                        facts.notify_sites.append((lk, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                # Nested defs/lambdas: their bodies run later, in unknown
+                # lock context — analyze conservatively as unlocked.
+                for sub in (child.body if isinstance(child.body, list)
+                            else [child.body]):
+                    visit(sub, ())
+                continue
+            visit(child, held)
+
+    for stmt in fn.body:
+        visit(stmt, ())
+    return facts
+
+
+def _class_lock_attrs(cls: ast.ClassDef) -> Tuple[Set[str], Dict[str, str]]:
+    """Lock-ish instance attributes assigned in __init__ (or class body),
+    plus condition -> underlying-lock aliases."""
+    locks: Set[str] = set()
+    aliases: Dict[str, str] = {}
+    for fn in cls.body:
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                attr = _self_attr_target(t)
+                if attr is None:
+                    continue
+                if _is_lockish(node.value):
+                    locks.add(attr)
+                    shared = _condition_alias(node.value)
+                    if shared and shared.startswith("self."):
+                        aliases[f"self.{attr}"] = shared
+    return locks, aliases
+
+
+def _check_class(cls: ast.ClassDef, path: str, lines: List[str],
+                 findings: List[Finding]) -> None:
+    lock_attrs, aliases = _class_lock_attrs(cls)
+    methods = {
+        fn.name: _collect_method(fn, lock_attrs)
+        for fn in cls.body if isinstance(fn, ast.FunctionDef)
+    }
+    if not methods:
+        return
+    spawns = any(m.spawns_thread for m in methods.values())
+    thread_targets = set().union(
+        *(m.thread_targets for m in methods.values())
+    ) & set(methods)
+    if not lock_attrs and not spawns:
+        return  # plain class: no concurrency contract to check
+
+    def is_public(name: str) -> bool:
+        return not name.startswith("_") or (
+            name.startswith("__") and name.endswith("__")
+            and name != "__init__"
+        )
+
+    # Entry roots: each thread-target method is its own root. For a
+    # thread-spawning class the public surface is ONE client root (the
+    # single-consumer pattern: __next__/close belong to one caller); for
+    # a lock-holding class with no threads of its own (CompiledPipeline:
+    # shared BY other threads), every public method is a separate root.
+    roots: Dict[str, Set[str]] = {}
+    if spawns:
+        client = {n for n in methods if is_public(n) and n != "__init__"
+                  and n not in thread_targets}
+        if client:
+            roots["<client>"] = client
+        for t in thread_targets:
+            roots[t] = {t}
+    else:
+        for n in methods:
+            if is_public(n) and n != "__init__" and n not in thread_targets:
+                roots[n] = {n}
+        for t in thread_targets:
+            roots[t] = {t}
+
+    # Reachability over the self-call graph.
+    reach: Dict[str, Set[str]] = {}
+    for root, seeds in roots.items():
+        seen: Set[str] = set()
+        stack = list(seeds)
+        while stack:
+            m = stack.pop()
+            if m in seen or m not in methods:
+                continue
+            seen.add(m)
+            stack.extend(methods[m].calls)
+        reach[root] = seen
+
+    # attr -> set of roots whose reachable methods write it.
+    attr_roots: Dict[str, Set[str]] = {}
+    for root, rset in reach.items():
+        for mname in rset:
+            if mname == "__init__":
+                continue
+            for attr, _locked, _ln, _k in methods[mname].writes:
+                attr_roots.setdefault(attr, set()).add(root)
+
+    # -- KL001 -------------------------------------------------------------
+    for mname, facts in methods.items():
+        if mname == "__init__" or mname.endswith("_locked"):
+            continue  # setup / caller-holds-the-lock convention
+        for attr, locked, lineno, kind in facts.writes:
+            if locked or attr in lock_attrs:
+                continue
+            sharers = attr_roots.get(attr, set())
+            if len(sharers) < 2:
+                continue
+            if _suppressed(lines, lineno, "KL001"):
+                continue
+            verb = "mutates" if kind == "mutate" else "writes"
+            findings.append(Finding(
+                "KL001", path, lineno,
+                f"{cls.name}.{mname} {verb} self.{attr} outside the lock; "
+                f"the attribute is written from entry points "
+                f"{sorted(sharers)}",
+                hint="wrap in `with self._lock:` (or move into a *_locked "
+                     "helper whose callers hold it)",
+            ))
+
+    # -- KL002 -------------------------------------------------------------
+    def norm(key: str) -> str:
+        return aliases.get(key.rstrip("[]"), key)
+
+    edges: Dict[Tuple[str, str], int] = {}
+    for facts in methods.values():
+        for outer, inner, lineno in facts.nestings:
+            o, i = norm(outer), norm(inner)
+            if o == i:
+                if not _suppressed(lines, lineno, "KL002"):
+                    findings.append(Finding(
+                        "KL002", path, lineno,
+                        f"{cls.name}: nested acquisition of non-reentrant "
+                        f"{outer} (Condition/Lock share one underlying "
+                        "lock) — self-deadlock",
+                        hint="release before re-acquiring, or restructure "
+                             "so one method owns the lock",
+                    ))
+                continue
+            edges.setdefault((o, i), lineno)
+    # Cycle detection over the acquisition-order digraph.
+    graph: Dict[str, Set[str]] = {}
+    for (o, i) in edges:
+        graph.setdefault(o, set()).add(i)
+    state: Dict[str, int] = {}
+
+    def dfs(n: str, trail: List[str]) -> Optional[List[str]]:
+        state[n] = 1
+        for nxt in graph.get(n, ()):
+            if state.get(nxt) == 1:
+                return trail + [n, nxt]
+            if state.get(nxt, 0) == 0:
+                cyc = dfs(nxt, trail + [n])
+                if cyc:
+                    return cyc
+        state[n] = 2
+        return None
+
+    for n in list(graph):
+        if state.get(n, 0) == 0:
+            cyc = dfs(n, [])
+            if cyc:
+                a, b = cyc[-2], cyc[-1]
+                lineno = edges.get((a, b)) or next(iter(edges.values()))
+                if not _suppressed(lines, lineno, "KL002"):
+                    findings.append(Finding(
+                        "KL002", path, lineno,
+                        f"{cls.name}: lock-acquisition-order cycle "
+                        f"{' -> '.join(cyc[cyc.index(b):] + [b])} — two "
+                        "threads taking the locks in opposite orders "
+                        "deadlock",
+                        hint="impose one global acquisition order",
+                    ))
+                break
+
+    # -- KL008 -------------------------------------------------------------
+    # Deliberately keyed on CONDITION identity, not the norm()-aliased
+    # underlying lock: distinct Conditions sharing one Lock have separate
+    # wait-sets — per-waiter conditions over a shared lock are the FIX
+    # for lost wakeups, and must lint clean.
+    if thread_targets:
+        waiters: Dict[str, Set[str]] = {}
+        for root in thread_targets:
+            for mname in reach.get(root, ()):
+                for lk in methods[mname].wait_locks:
+                    waiters.setdefault(lk, set()).add(root)
+        for facts in methods.values():
+            for lk, lineno in facts.notify_sites:
+                key = lk
+                if len(waiters.get(key, ())) >= 2:
+                    if not _suppressed(lines, lineno, "KL008"):
+                        findings.append(Finding(
+                            "KL008", path, lineno,
+                            f"{cls.name}.{facts.name} calls {lk}.notify() "
+                            f"but threads {sorted(waiters[key])} both wait "
+                            "on it: one waiter can consume a wakeup meant "
+                            "for the other (lost wakeup, the PR-5 serving "
+                            "bug)",
+                            hint="use notify_all(), or give each waiter "
+                                 "class its own Condition over the shared "
+                                 "lock",
+                        ))
+
+
+# ---------------------------------------------------------------------------
+# File-scope rules (KL003-KL007)
+# ---------------------------------------------------------------------------
+
+
+def _check_file_rules(tree: ast.Module, path: str, lines: List[str],
+                      findings: List[Finding]) -> None:
+    env_exempt = path in ENV_ALLOWED
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.loop_depth = 0
+            self.func_stack: List[str] = []
+
+        # -- loops (KL004 scope) ------------------------------------------
+        def visit_For(self, node):
+            self.loop_depth += 1
+            self.generic_visit(node)
+            self.loop_depth -= 1
+
+        visit_While = visit_For
+        visit_AsyncFor = visit_For
+
+        def visit_FunctionDef(self, node):
+            self.func_stack.append(node.name)
+            # A nested def inside a loop runs later: reset loop context.
+            saved, self.loop_depth = self.loop_depth, 0
+            self.generic_visit(node)
+            self.loop_depth = saved
+            self.func_stack.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        # -- KL003 / KL004 / KL005 / KL007 ---------------------------------
+        def visit_Attribute(self, node):
+            if not env_exempt and _dotted(node) == "os.environ":
+                if not _suppressed(lines, node.lineno, "KL003"):
+                    findings.append(Finding(
+                        "KL003", path, node.lineno,
+                        "os.environ read outside config.py: env knobs are "
+                        "resolved once at config import, not on demand",
+                        hint="add a config field / helper in config.py and "
+                             "read that",
+                    ))
+            self.generic_visit(node)
+
+        def visit_Call(self, node):
+            callee = _dotted(node.func) or ""
+            leaf = callee.split(".")[-1]
+            if not env_exempt and callee in ("os.getenv",):
+                if not _suppressed(lines, node.lineno, "KL003"):
+                    findings.append(Finding(
+                        "KL003", path, node.lineno,
+                        "os.getenv outside config.py",
+                        hint="route through config.py",
+                    ))
+            if leaf in ("active_plan", "active_tracer") and self.loop_depth:
+                if not _suppressed(lines, node.lineno, "KL004"):
+                    findings.append(Finding(
+                        "KL004", path, node.lineno,
+                        f"{leaf}() resolved inside a loop body: the "
+                        "resolve-once discipline keeps the disabled "
+                        "harness at one None check per stream",
+                        hint="hoist the call above the loop (once per "
+                             "stream/solve/service)",
+                    ))
+            if callee == "time.time":
+                if not _suppressed(lines, node.lineno, "KL005"):
+                    findings.append(Finding(
+                        "KL005", path, node.lineno,
+                        "time.time() in library code: span/latency timing "
+                        "must use a monotonic clock",
+                        hint="time.perf_counter() for durations; tag "
+                             "`# lint: ok(KL005) <why>` for real "
+                             "wall-clock uses (file mtimes)",
+                    ))
+            if (
+                self.func_stack
+                and self.func_stack[-1] in DISPATCH_METHODS
+                and leaf in HOST_SYNC_CALLS
+            ):
+                if not _suppressed(lines, node.lineno, "KL007"):
+                    findings.append(Finding(
+                        "KL007", path, node.lineno,
+                        f"{callee or leaf}() inside dispatch-path method "
+                        f"{self.func_stack[-1]}: a blocking host sync "
+                        "stalls every queued request behind this one",
+                        hint="materialize on the completion side "
+                             "(completer threads / _AsyncResult.wait)",
+                    ))
+            self.generic_visit(node)
+
+    V().visit(tree)
+
+    # -- KL006: broad except handlers --------------------------------------
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler) or node.type is None:
+            continue
+        names = []
+        types = (node.type.elts if isinstance(node.type, ast.Tuple)
+                 else [node.type])
+        for t in types:
+            d = _dotted(t)
+            if d:
+                names.append(d.split(".")[-1])
+        if not ({"Exception", "BaseException"} & set(names)):
+            continue
+        body_calls = {
+            (_dotted(c.func) or "").split(".")[-1]
+            for c in ast.walk(node) if isinstance(c, ast.Call)
+        }
+        reraises = any(isinstance(s, ast.Raise) for s in ast.walk(node))
+        classifies = bool(body_calls & {"is_transient", "is_oom"})
+        if reraises or classifies:
+            continue
+        if _suppressed(lines, node.lineno, "KL006"):
+            continue
+        findings.append(Finding(
+            "KL006", path, node.lineno,
+            "broad `except Exception` neither re-raises, classifies via "
+            "utils/reliability (is_transient/is_oom), nor carries a "
+            "`# lint: broad-ok` tag",
+            hint="narrow to the known failure type, or tag with the "
+                 "reason the catch-all is deliberate",
+        ))
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+
+def scan_source(source: str, relpath: str) -> List[Finding]:
+    lines = source.splitlines()
+    try:
+        tree = ast.parse(source, filename=relpath)
+    except SyntaxError as e:
+        return [Finding("KL000", relpath, e.lineno or 1,
+                        f"syntax error: {e.msg}")]
+    findings: List[Finding] = []
+    _check_file_rules(tree, relpath, lines, findings)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            _check_class(node, relpath, lines, findings)
+    return findings
+
+
+def iter_py_files(paths: List[str], root: str) -> List[Tuple[str, str]]:
+    out = []
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if not os.path.exists(ap):
+            # A misspelled/renamed path must FAIL, not pass vacuously —
+            # a zero-tolerance gate that scans nothing gates nothing.
+            raise FileNotFoundError(f"lint path does not exist: {ap}")
+        if os.path.isfile(ap):
+            out.append((ap, os.path.relpath(ap, root)))
+            continue
+        for dirpath, dirnames, filenames in os.walk(ap):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    fp = os.path.join(dirpath, fn)
+                    out.append((fp, os.path.relpath(fp, root)))
+    return sorted(out)
+
+
+def scan(paths: List[str], root: str = REPO_ROOT):
+    """Scan ``paths`` (files or directories, relative to ``root``).
+    Returns (findings, keys) where keys[i] is findings[i]'s baseline
+    identity."""
+    findings: List[Finding] = []
+    keys: List[str] = []
+    for abspath, relpath in iter_py_files(paths, root):
+        with open(abspath, "r", encoding="utf-8") as f:
+            source = f.read()
+        lines = source.splitlines()
+        for fd in scan_source(source, relpath):
+            findings.append(fd)
+            keys.append(fd.key(lines))
+    return findings, keys
+
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    """Baseline entries keyed by finding identity (count-aware callers
+    use a multiset; identical keys may repeat in `entries`)."""
+    with open(path) as f:
+        doc = json.load(f)
+    return doc
+
+
+def new_findings(findings: List[Finding], keys: List[str],
+                 baseline: Optional[dict]):
+    """Findings whose identity is not covered by the baseline multiset."""
+    budget: Dict[str, int] = {}
+    for e in (baseline or {}).get("entries", []):
+        budget[e["key"]] = budget.get(e["key"], 0) + 1
+    fresh = []
+    for fd, key in zip(findings, keys):
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+        else:
+            fresh.append(fd)
+    return fresh
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="keystone-lint: codebase invariant checker (AST layer)"
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to scan (default: {DEFAULT_PATHS})")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON of accepted pre-existing findings")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings as the new baseline")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--root", default=REPO_ROOT, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    paths = args.paths or DEFAULT_PATHS
+    try:
+        findings, keys = scan(paths, args.root)
+    except FileNotFoundError as e:
+        print(f"keystone-lint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        doc = {
+            "version": 1,
+            "comment": "Accepted pre-existing keystone-lint findings. "
+                       "Every entry needs a `why`; the gate fails on any "
+                       "finding NOT in this file.",
+            "entries": [
+                {"key": k, "rule": f.rule, "why": "TODO: justify"}
+                for f, k in zip(findings, keys)
+            ],
+        }
+        bl_path = os.path.join(args.root, args.baseline)
+        with open(bl_path, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=False)
+            f.write("\n")
+        print(f"wrote {len(findings)} baseline entries to {args.baseline}")
+        return 0
+
+    baseline = None
+    if not args.no_baseline:
+        bl_path = os.path.join(args.root, args.baseline)
+        if os.path.exists(bl_path):
+            baseline = load_baseline(bl_path)
+    fresh = new_findings(findings, keys, baseline)
+
+    shown = findings if args.no_baseline else fresh
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in shown],
+            "total": len(findings),
+            "baselined": len(findings) - len(fresh),
+            "new": len(fresh),
+        }))
+    else:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from lint_report import format_findings  # shared formatter
+
+        print(format_findings(
+            [f.as_dict() for f in shown],
+            title="keystone-lint (AST layer)",
+        ))
+        print(f"{len(findings)} finding(s), "
+              f"{len(findings) - len(fresh)} baselined, {len(fresh)} new")
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

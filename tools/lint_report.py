@@ -1,0 +1,158 @@
+"""Render keystone-lint findings — THE formatter both layers share.
+
+CI and humans read one table shape whether the findings come from the
+graph linter (``Pipeline.lint()`` / workflow/analysis.py) or the AST
+invariant checker (tools/keystone_lint.py): severity, rule id, location
+(node path or file:line), message, fix hint — the trace_report.py
+aggregate-table idiom applied to diagnostics.
+
+As a CLI this runs the GRAPH layer against the canonical serving
+pipelines (the same fused chains tools/bench_serve.py and the serving
+tests exercise) plus a deliberately-unserveable control chain, prints
+the findings table, and exits 1 when any error-severity finding shows
+up where none is expected — the demo half of ``make lint``
+(tools/keystone_lint.py is the codebase half).
+
+Usage:
+    python tools/lint_report.py [--json]
+    python tools/lint_report.py --findings FILE.json   # render any dump
+
+Exit status: 0 = canonical pipelines lint clean (and the control chain
+is correctly refused), 1 = unexpected findings / missed refusal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_SEV_ORDER = {"error": 0, "warning": 1, "info": 2}
+
+
+def format_findings(findings: List[dict], title: Optional[str] = None) -> str:
+    """One table for both layers. Each finding dict carries rule /
+    severity / message, plus either node (graph layer) or path+line
+    (AST layer); hint optional."""
+    lines: List[str] = []
+    if title:
+        lines.append(title)
+    if not findings:
+        lines.append("  clean — no findings")
+        return "\n".join(lines)
+    rows = []
+    for f in sorted(
+        findings,
+        key=lambda f: (_SEV_ORDER.get(f.get("severity", "info"), 3),
+                       f.get("rule", ""), f.get("path", ""),
+                       f.get("line", 0)),
+    ):
+        where = f.get("node")
+        if not where or where == "-":
+            where = f"{f.get('path', '?')}:{f.get('line', '?')}"
+        rows.append((f.get("severity", "?"), f.get("rule", "?"), where,
+                     f.get("message", ""), f.get("hint", "")))
+    w_sev = max(len(r[0]) for r in rows)
+    w_rule = max(len(r[1]) for r in rows)
+    w_where = min(44, max(len(r[2]) for r in rows))
+    for sev, rule, where, msg, hint in rows:
+        lines.append(f"  {sev:<{w_sev}}  {rule:<{w_rule}}  "
+                     f"{where:<{w_where}}  {msg}")
+        if hint:
+            lines.append(f"  {'':<{w_sev}}  {'':<{w_rule}}  "
+                         f"{'':<{w_where}}  -> {hint}")
+    return "\n".join(lines)
+
+
+def run_graph_demo() -> dict:
+    """Lint the canonical serving chains (must be clean) and a row-coupled
+    control chain (must be refused). Returns the machine-readable verdict
+    ``make lint`` gates on."""
+    import numpy as np
+
+    from keystone_tpu.nodes.images.patches import RandomPatcher
+    from keystone_tpu.nodes.learning.linear_mapper import LinearMapper
+    from keystone_tpu.nodes.stats.hellinger import SignedHellingerMapper
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+    from keystone_tpu.nodes.stats.random_features import CosineRandomFeatures
+    from keystone_tpu.nodes.stats.scalers import StandardScalerModel
+    from keystone_tpu.workflow import Pipeline
+
+    rng = np.random.default_rng(0)
+    d, D, k = 8, 16, 3
+    fused_head = (
+        StandardScalerModel(
+            rng.normal(size=d).astype(np.float32),
+            (1.0 + rng.uniform(size=d)).astype(np.float32),
+        ).to_pipeline()
+        .and_then(CosineRandomFeatures.create(d, D, seed=0))
+        .and_then(SignedHellingerMapper())
+        .and_then(L2Normalizer())
+        .and_then(LinearMapper(rng.normal(size=(D, k)).astype(np.float32)))
+    )
+    canonical = {
+        "fused-serving-head": fused_head,
+        "normalize-map": L2Normalizer().and_then(
+            LinearMapper(rng.normal(size=(d, k)).astype(np.float32))
+        ),
+    }
+    control = RandomPatcher(4, 3).and_then(L2Normalizer())
+
+    all_findings: List[dict] = []
+    clean = True
+    for name, p in canonical.items():
+        report = p.lint(example=(d,), serve=True, have_ladder=True)
+        for diag in report:
+            f = diag.as_dict()
+            f["pipeline"] = name
+            all_findings.append(f)
+        if report.errors() or report.warnings():
+            clean = False
+    control_report = control.lint(serve=True, have_ladder=True)
+    control_rules = sorted({d.rule for d in control_report.errors()})
+    refused = "KG002" in control_rules  # the row-coupled serveability rule
+    return {
+        "canonical_clean": clean,
+        "control_refused": refused,
+        "control_rules": control_rules,
+        "findings": all_findings,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Render lint findings / run the graph-lint demo"
+    )
+    ap.add_argument("--findings", default=None,
+                    help="JSON file of findings to render (skips the demo)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    if args.findings:
+        with open(args.findings) as f:
+            doc = json.load(f)
+        findings = doc.get("findings", doc) if isinstance(doc, dict) else doc
+        print(format_findings(findings, title="lint findings"))
+        return 1 if any(
+            f.get("severity") == "error" for f in findings
+        ) else 0
+
+    verdict = run_graph_demo()
+    if args.as_json:
+        print(json.dumps(verdict))
+    else:
+        print(format_findings(verdict["findings"],
+                              title="graph lint (canonical pipelines)"))
+        print(f"canonical_clean={verdict['canonical_clean']} "
+              f"control_refused={verdict['control_refused']} "
+              f"(control flagged: {', '.join(verdict['control_rules'])})")
+    ok = verdict["canonical_clean"] and verdict["control_refused"]
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
