@@ -1,6 +1,6 @@
 # Developer entry points. Pipelines launch via bin/run-pipeline.sh.
 
-.PHONY: test t1 chaos native bench bench-serve bench-serve-overload bench-serve-replicas trace-demo lint dryrun clean tpu-checkride sentinel northstar acceptance
+.PHONY: test t1 chaos native bench bench-serve bench-serve-overload bench-serve-replicas trace-demo obs-serve lint dryrun clean tpu-checkride sentinel northstar acceptance
 
 # The canonical tier-1 verify (ROADMAP.md), verbatim at the defaults —
 # builders and CI invoke this one entry point instead of hand-copying the
@@ -80,6 +80,16 @@ bench-serve-replicas:
 trace-demo:
 	KEYSTONE_TRACE=1 JAX_PLATFORMS=cpu python tools/trace_demo.py --out /tmp/keystone_trace.json
 	JAX_PLATFORMS=cpu python tools/trace_report.py /tmp/keystone_trace.json --top 12
+
+# Observability export smoke: stand up a live warmed PipelineService +
+# the stdlib metrics server, fetch /metrics and /healthz over a real
+# socket, validate the Prometheus text exposition (shared
+# validate_prometheus_text oracle), cross-check scraped counts against
+# metrics_registry.snapshot(), and assert /healthz flips to 503 after
+# close(). Tier-1 runs the same smoke in-process
+# (tests/test_flight_recorder.py).
+obs-serve:
+	JAX_PLATFORMS=cpu python tools/metrics_server.py
 
 # Static analysis, both layers, against the checked-in expectations:
 # keystone_lint.py (stdlib-ast invariant checker: lock discipline,

@@ -293,6 +293,52 @@ class Config:
     trace_buffer: int = field(
         default_factory=lambda: _env_int("KEYSTONE_TRACE_BUFFER", 65536)
     )
+    # Tail-sampling threshold for request-scoped tracing, in milliseconds:
+    # when tracing is on, a request whose end-to-end latency breaches this
+    # keeps its FULL span tree in the tracer's retained store (survives
+    # ring churn; exported under "tailSampled"). 0 = auto: the running p99
+    # of the service's always-on e2e histogram (so ~the slowest 1% are
+    # retained once enough samples exist); negative disables tail
+    # sampling entirely. Env: KEYSTONE_TRACE_TAIL_MS.
+    trace_tail_ms: float = field(
+        default_factory=lambda: _env_float("KEYSTONE_TRACE_TAIL_MS", 0.0)
+    )
+    # Serving stall watchdog (workflow/serving.py): a background thread
+    # per service that fires when the pending queue is non-empty but no
+    # dispatch progress (group pop / completion) has happened for this
+    # many milliseconds — bumping the serve.stalls counter and dumping the
+    # flight recorder instead of hanging silently. 0 disables the thread.
+    # Env: KEYSTONE_WATCHDOG_MS.
+    serve_watchdog_ms: float = field(
+        default_factory=lambda: _env_float("KEYSTONE_WATCHDOG_MS", 10000.0)
+    )
+    # Deadline-storm dump trigger: this many DeadlineExceeded failures
+    # inside one second auto-dumps the flight recorder (the post-mortem
+    # for "why did everything suddenly expire"). 0 disables the trigger.
+    # Env: KEYSTONE_STORM_EXPIRED.
+    serve_storm_expired: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_STORM_EXPIRED", 8)
+    )
+    # Flight-recorder ring capacity: the most recent N per-request journey
+    # records each PipelineService keeps for post-mortem dumps (always on;
+    # one record per accepted request). 0 disables the journey ring
+    # (error events and dump triggers keep working).
+    # Env: KEYSTONE_FLIGHT_RECORDS.
+    flight_records: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_FLIGHT_RECORDS", 2048)
+    )
+    # Where flight-recorder dumps land ('' = the platform tempdir). Each
+    # dump is one JSON file named for the service, trigger reason, pid,
+    # and sequence number. Env: KEYSTONE_FLIGHT_DIR.
+    flight_dir: str = field(
+        default_factory=lambda: os.environ.get("KEYSTONE_FLIGHT_DIR", "")
+    )
+    # TCP port for tools/metrics_server.py (the /metrics + /healthz pull
+    # surface). 0 = bind an ephemeral port (the smoke-test default; the
+    # chosen port is printed/returned). Env: KEYSTONE_METRICS_PORT.
+    metrics_port: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_METRICS_PORT", 0)
+    )
     # Pipeline-graph lint gate (workflow/analysis.py): run the static
     # graph linter before every fit()/compiled(). "off" (default) = never;
     # "warn" = log findings at their severity; "error" = additionally

@@ -1,0 +1,294 @@
+"""Black-box flight recorder: always-on per-request journey forensics.
+
+The PR-4 tracer answers "where does time go" — but it must be armed
+before the incident, and its spans are anonymous aggregates once a
+request has been coalesced into a flush group. This module is the other
+half of production observability: an ALWAYS-ON, lock-light bounded ring
+of per-request journey records (id, rows, bucket, replica(s), per-phase
+timestamps, final outcome) plus a last-N ring of error events, cheap
+enough to leave running under full traffic and dumped to JSON when
+something goes wrong — so the first deadline storm or replica death on a
+box nobody was watching still leaves a post-mortem artifact behind.
+
+Concurrency model (the "lock-light" part): the recorder's lock guards
+only ring membership and dump bookkeeping. ``FlightRecord`` fields are
+written WITHOUT the recorder lock by whichever thread currently owns the
+request — ownership hands off through the serving locks (submit ->
+dispatcher -> completer), which gives the stamps happens-before ordering;
+a dump reads records without quiescing writers, so a record mid-flight
+serializes exactly as far as its journey has progressed. That is a
+feature: the dump taken at the moment of a stall shows WHERE each
+request was stuck.
+
+Dump triggers (``PipelineService`` wires these):
+
+- ``worker_death`` / ``replica_death`` — the reliability events;
+- ``deadline_storm`` — >= ``config.serve_storm_expired`` requests expired
+  within one second;
+- ``stall`` — the service's watchdog thread saw a non-empty pending
+  queue make no dispatch progress for ``KEYSTONE_WATCHDOG_MS``;
+- ``debug`` — an explicit ``PipelineService.debug_dump()``.
+
+Triggers fired under a serving lock only mark the dump pending
+(``note_dump``); the actual file write happens at the next ``poll()``
+from a safe (unlocked) point — submit exit, a completer's group
+boundary, or the watchdog tick — so forensics never add file I/O to a
+critical section. Repeat dumps for one reason are rate-limited
+(``MIN_DUMP_INTERVAL_S``); ``debug_dump`` bypasses the limit.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("keystone_tpu")
+
+#: One process-wide monotonic request-id sequence: ids minted at
+#: ``PipelineService.submit`` and ``CompiledPipeline.call_async`` share
+#: it, so an id is unique across every engine/service in the process and
+#: orders submissions.
+_req_seq = itertools.count(1)
+
+
+def next_request_id() -> int:
+    """Mint the next process-wide monotonic request id."""
+    return next(_req_seq)
+
+
+class FlightRecord:
+    """One request's journey: phase stamps appended in flight, serialized
+    whole at dump time. Single-writer by ownership handoff (see module
+    docstring) — no lock of its own."""
+
+    __slots__ = ("rid", "rows", "bucket", "replicas", "phases", "outcome")
+
+    def __init__(self, rid: int, rows: int):
+        self.rid = rid
+        self.rows = rows
+        self.bucket: Optional[int] = None
+        self.replicas: List[int] = []
+        self.phases: List[Tuple[str, int]] = [
+            ("submitted", time.perf_counter_ns())
+        ]
+        self.outcome: Optional[str] = None
+
+    def stamp(self, phase: str) -> None:
+        """Append a (phase, perf_counter_ns) stamp. Phases repeat when a
+        journey loops (a re-dispatched request is flushed twice)."""
+        self.phases.append((phase, time.perf_counter_ns()))
+
+    def dispatched(self, replica: int, bucket: Optional[int]) -> None:
+        """Stamp the launch onto a replica; re-dispatches append, so the
+        record names EVERY replica that ever held this request."""
+        self.replicas.append(int(replica))
+        if bucket is not None:
+            self.bucket = int(bucket)
+        self.stamp("dispatched")
+
+    def finish(self, outcome: str) -> None:
+        self.outcome = outcome
+        self.stamp("resolved")
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "id": self.rid,
+            "rows": self.rows,
+            "bucket": self.bucket,
+            "replicas": list(self.replicas),
+            "phases": [
+                {"phase": p, "t_ns": t} for p, t in list(self.phases)
+            ],
+            "outcome": self.outcome,
+        }
+
+
+class FlightRecorder:
+    """The bounded journey ring + error-event ring + dump machinery for
+    one service instance."""
+
+    #: Floor between two auto-dumps for the SAME reason: a storm must
+    #: leave one artifact, not a thousand.
+    MIN_DUMP_INTERVAL_S = 5.0
+
+    #: Last-N error events kept alongside the journey ring.
+    ERROR_CAPACITY = 256
+
+    #: Most recent dump paths remembered (the rings are bounded; the
+    #: dump history must be too — a service degraded for days would
+    #: otherwise grow this into every stats()/healthz payload).
+    DUMP_HISTORY = 64
+
+    def __init__(
+        self,
+        name: str,
+        capacity: Optional[int] = None,
+        directory: Optional[str] = None,
+        context: Optional[Callable[[], dict]] = None,
+    ):
+        from keystone_tpu.config import config
+
+        self.name = name
+        self.capacity = int(
+            config.flight_records if capacity is None else capacity
+        )
+        if self.capacity < 0:
+            raise ValueError(f"capacity must be >= 0, got {self.capacity}")
+        # capacity 0 = the journey ring is off (the repo-wide 0=disabled
+        # env convention for KEYSTONE_FLIGHT_RECORDS): deque(maxlen=0)
+        # makes every append a no-op while error events and dumps keep
+        # working.
+        self.directory = (
+            directory if directory is not None
+            else (config.flight_dir or tempfile.gettempdir())
+        )
+        self._context = context
+        self._lock = threading.Lock()
+        self._records: deque = deque(maxlen=self.capacity)
+        self._errors: deque = deque(maxlen=self.ERROR_CAPACITY)
+        self._pending_reason: Optional[str] = None
+        self._last_dump: Dict[str, float] = {}
+        self._dump_seq = itertools.count()
+        self._dumps: deque = deque(maxlen=self.DUMP_HISTORY)
+        self.dumps_total = 0
+        self.records_started = 0
+
+    @property
+    def dumps(self) -> List[str]:
+        """The most recent ``DUMP_HISTORY`` dump paths, oldest first."""
+        with self._lock:
+            return list(self._dumps)
+
+    # -- recording (the hot path) ------------------------------------------
+
+    def start(self, rid: int, rows: int) -> FlightRecord:
+        """Open one request's journey record and enter it in the ring.
+        The record is mutated in place as the request progresses; the
+        ring holds the reference, so in-flight requests are visible to a
+        dump exactly as far as they got."""
+        rec = FlightRecord(rid, rows)
+        with self._lock:
+            self._records.append(rec)
+            self.records_started += 1
+        return rec
+
+    def error(self, kind: str, message: str,
+              rid: Optional[int] = None) -> None:
+        """Append one error event to the last-N ring."""
+        with self._lock:
+            self._errors.append({
+                "kind": kind,
+                "message": str(message)[:500],
+                "req_id": rid,
+                "t_ns": time.perf_counter_ns(),
+            })
+
+    # -- dumping -----------------------------------------------------------
+
+    def note_dump(self, reason: str) -> None:
+        """Mark a dump pending. Safe under any serving lock — the file
+        write happens at the next ``poll()`` from an unlocked point.
+        First reason wins until it is flushed."""
+        with self._lock:
+            if self._pending_reason is None:
+                self._pending_reason = reason
+
+    def poll(self) -> Optional[str]:
+        """Flush a pending dump, if any (call from UNLOCKED points only:
+        submit exit, completer group boundary, watchdog tick). Returns
+        the path written, or None."""
+        # Lock-free fast path: poll sits on the client-facing submit
+        # path, and a pending dump is vanishingly rare. The racy read is
+        # benign — a flag set concurrently is caught by the next poll
+        # point (the watchdog tick guarantees one).
+        if self._pending_reason is None:
+            return None
+        with self._lock:
+            reason = self._pending_reason
+            self._pending_reason = None
+        if reason is None:
+            return None
+        return self.dump(reason)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The rings as plain data (journeys serialized as far as they
+        got — see the module docstring on torn reads)."""
+        with self._lock:
+            records = list(self._records)
+            errors = list(self._errors)
+        return {
+            "service": self.name,
+            "capacity": self.capacity,
+            "records_started": self.records_started,
+            "records": [r.as_dict() for r in records],
+            "errors": errors,
+        }
+
+    def dump(self, reason: str, path: Optional[str] = None,
+             force: bool = False) -> Optional[str]:
+        """Write the black box to JSON. Rate-limited per reason unless
+        ``force``; returns the path written (None when rate-limited).
+        Never raises: a forensics path that throws during the incident it
+        exists to record would destroy the evidence AND the service."""
+        now = time.perf_counter()
+        with self._lock:
+            if not force:
+                last = self._last_dump.get(reason)
+                if last is not None and now - last < self.MIN_DUMP_INTERVAL_S:
+                    return None
+            seq = next(self._dump_seq)
+        doc = self.snapshot()
+        doc["reason"] = reason
+        # lint: ok(KL005) forensic artifact carries a real wall-clock timestamp
+        doc["unix_time"] = time.time()
+        try:
+            if self._context is not None:
+                doc["stats"] = self._context()
+        except Exception as e:  # lint: broad-ok a half-closed service's stats must not kill the dump
+            doc["stats_error"] = str(e)[:200]
+        if path is None:
+            fname = (
+                f"keystone_flight_{self.name}_{reason}_"
+                f"{os.getpid()}_{seq}.json"
+            )
+            path = os.path.join(self.directory, fname)
+        try:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, path)
+        except OSError as e:
+            # The rate-limit slot is NOT consumed on a failed write: a
+            # transient disk error must not suppress the retry that would
+            # have captured the incident.
+            logger.warning("flight recorder dump to %s failed: %s", path, e)
+            return None
+        with self._lock:
+            self._last_dump[reason] = now
+            self._dumps.append(path)
+            self.dumps_total += 1
+        logger.warning(
+            "flight recorder %s: dumped %d record(s) / %d error event(s) "
+            "to %s (reason=%s)",
+            self.name, len(doc["records"]), len(doc["errors"]), path, reason,
+        )
+        return path
+
+    def stats(self) -> Dict[str, Any]:
+        """Small health-surface summary (NOT the rings themselves)."""
+        with self._lock:
+            return {
+                "records_held": len(self._records),
+                "records_started": self.records_started,
+                "errors_held": len(self._errors),
+                "dumps": list(self._dumps),
+                "dumps_total": self.dumps_total,
+                "pending_dump": self._pending_reason,
+            }

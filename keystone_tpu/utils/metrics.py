@@ -30,11 +30,12 @@ import json
 import logging
 import math
 import os
+import re
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from contextlib import contextmanager
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 
@@ -110,13 +111,28 @@ class Tracer:
 
     Thread-safe; the ring (``deque(maxlen=...)``) keeps the most recent
     ``capacity`` spans so a long traced run holds bounded memory.
+
+    Request-scoped spans carry a ``req_id`` (single-request spans:
+    ``serve.queued``, ``serve.request``) or ``req_ids`` (group spans:
+    ``serve.flush``, ``serve.device``) attr — the serving layer mints one
+    monotonic id per request and threads it across the dispatcher,
+    replica, and completion threads, so ``spans_for_request()`` can
+    reconstruct one request's full cross-thread journey from the ring.
+    ``retain_request()`` is the tail-sampling hook: it copies a slow
+    request's span tree into a small bounded store that survives ring
+    churn (the ring keeps the most recent spans of ALL traffic; the
+    retained store keeps the interesting outliers).
     """
+
+    #: How many tail-sampled requests keep their full span trees.
+    RETAIN_CAPACITY = 64
 
     def __init__(self, capacity: int = 65536):
         self.capacity = int(capacity)
         self.epoch_ns = time.perf_counter_ns()
         self._lock = threading.Lock()
         self._spans: deque = deque(maxlen=self.capacity)
+        self._retained: "OrderedDict[int, List[dict]]" = OrderedDict()
         self._tls = threading.local()
         self.dropped = 0  # spans evicted by the ring bound
 
@@ -185,30 +201,106 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self._retained.clear()
             self.dropped = 0
+
+    @staticmethod
+    def _mentions(span: dict, rid: int) -> bool:
+        """Does this span belong to request ``rid`` (``req_id`` attr, or
+        membership in a group span's ``req_ids`` list)?"""
+        args = span["args"]
+        return args.get("req_id") == rid or rid in args.get("req_ids", ())
+
+    def spans_for_request(self, rid: int) -> List[dict]:
+        """Every span in the ring OR the retained store that mentions
+        request ``rid`` — the cross-thread journey of one request."""
+        with self._lock:
+            found = [s for s in self._spans if self._mentions(s, rid)]
+            kept = self._retained.get(rid)
+        if kept:
+            seen = {(s["name"], s["start_ns"]) for s in found}
+            found.extend(
+                s for s in kept if (s["name"], s["start_ns"]) not in seen
+            )
+        found.sort(key=lambda s: s["start_ns"])
+        return found
+
+    #: Slack (ns) on the ``since_ns`` early-exit of ``retain_request``:
+    #: the ring is ordered by record() call, which can trail a span's end
+    #: timestamp by scheduler jitter across threads.
+    RETAIN_SCAN_SLACK_NS = 5_000_000
+
+    def retain_request(self, rid: int,
+                       since_ns: Optional[int] = None) -> int:
+        """Tail-sampling: copy request ``rid``'s spans from the ring into
+        the bounded retained store (oldest retained request evicted past
+        ``RETAIN_CAPACITY``), so a slow request's full span tree survives
+        ring churn. Returns how many spans were retained.
+
+        ``since_ns`` (the request's submit timestamp) bounds the scan: a
+        span that ENDED before the request existed cannot mention it, and
+        the ring is ordered by record time, so the newest-first walk
+        stops at the first span ending more than a slack margin before
+        ``since_ns`` — an expiry storm with tracing armed then scans one
+        request's lifetime of spans, not the whole 65536-entry ring,
+        while this may run under the serving lock. Without ``since_ns``
+        the full ring is scanned (O(ring))."""
+        cutoff = (
+            since_ns - self.RETAIN_SCAN_SLACK_NS
+            if since_ns is not None else None
+        )
+        with self._lock:
+            matched = []
+            for s in reversed(self._spans):
+                if (
+                    cutoff is not None
+                    and s["start_ns"] + s["dur_ns"] < cutoff
+                ):
+                    break
+                if self._mentions(s, rid):
+                    matched.append(dict(s))
+            matched.reverse()
+            if not matched:
+                return 0
+            self._retained[rid] = matched
+            self._retained.move_to_end(rid)
+            while len(self._retained) > self.RETAIN_CAPACITY:
+                self._retained.popitem(last=False)
+            return len(matched)
+
+    def retained(self) -> Dict[int, List[dict]]:
+        """Snapshot of the tail-sampled store: req id -> its span tree."""
+        with self._lock:
+            return {rid: list(spans) for rid, spans in self._retained.items()}
+
+    def _as_event(self, s: dict, pid: int) -> dict:
+        """One ring span as a Chrome-trace X event (µs timestamps)."""
+        return {
+            "name": s["name"],
+            "cat": s["cat"],
+            "ph": "X",
+            "ts": (s["start_ns"] - self.epoch_ns) / 1e3,
+            "dur": s["dur_ns"] / 1e3,
+            "pid": pid,
+            "tid": s["tid"],
+            "args": s["args"],
+        }
 
     def export(self, path: Optional[str] = None) -> dict:
         """The ring as a Chrome-trace document (``{"traceEvents": [...]}``,
         timestamps/durations in microseconds) — loadable by Perfetto /
         chrome://tracing alongside ``maybe_trace``'s jax profiler capture.
-        With ``path``, also written as JSON to that file."""
+        Tail-sampled span trees ride along under a ``tailSampled`` key
+        (req id -> events) so ``tools/trace_report.py --request`` can
+        reconstruct a slow request even after the ring churned past it;
+        Chrome-trace consumers ignore unknown top-level keys. With
+        ``path``, also written as JSON to that file."""
         pid = os.getpid()
         events = []
         threads: Dict[int, str] = {}
         for s in self.spans():
             threads.setdefault(s["tid"], s["thread"])
-            events.append(
-                {
-                    "name": s["name"],
-                    "cat": s["cat"],
-                    "ph": "X",
-                    "ts": (s["start_ns"] - self.epoch_ns) / 1e3,
-                    "dur": s["dur_ns"] / 1e3,
-                    "pid": pid,
-                    "tid": s["tid"],
-                    "args": s["args"],
-                }
-            )
+            events.append(self._as_event(s, pid))
         for tid, tname in threads.items():
             events.append(
                 {
@@ -220,6 +312,12 @@ class Tracer:
                 }
             )
         doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+        tail = self.retained()
+        if tail:
+            doc["tailSampled"] = {
+                str(rid): [self._as_event(s, pid) for s in spans]
+                for rid, spans in tail.items()
+            }
         if path is not None:
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
@@ -330,6 +428,7 @@ class LatencyHistogram:
             self._sum = 0.0
             self._min = math.inf
             self._max = 0.0
+            self._nonpositive = 0
 
     def _index(self, seconds: float) -> int:
         if seconds <= self._lo:
@@ -344,13 +443,21 @@ class LatencyHistogram:
         return self._lo * 2.0 ** ((index - 0.5) / self._sub)
 
     def record(self, seconds: float) -> None:
-        if seconds < 0:
-            seconds = 0.0
+        # Non-positive samples (clock skew, double-resolution races) are
+        # clamped to the minimum bucket AND counted separately: log-bucket
+        # math must never see them, and the snapshot's
+        # ``dropped_nonpositive`` names how often the clock misbehaved
+        # instead of silently polluting the distribution's low tail.
+        nonpos = seconds <= 0.0
+        if nonpos:
+            seconds = self._lo
         i = self._index(seconds)
         with self._lock:
             self._counts[i] += 1
             self._n += 1
             self._sum += seconds
+            if nonpos:
+                self._nonpositive += 1
             if seconds < self._min:
                 self._min = seconds
             if seconds > self._max:
@@ -387,7 +494,7 @@ class LatencyHistogram:
             if self._n == 0:
                 return {"count": 0}
             to_ms = lambda s: round(s * 1e3, 4)  # noqa: E731
-            return {
+            snap = {
                 "count": self._n,
                 "mean_ms": to_ms(self._sum / self._n),
                 "min_ms": to_ms(self._min),
@@ -395,6 +502,30 @@ class LatencyHistogram:
                 "p95_ms": to_ms(self._percentile_locked(95)),
                 "p99_ms": to_ms(self._percentile_locked(99)),
                 "max_ms": to_ms(self._max),
+            }
+            if self._nonpositive:
+                snap["dropped_nonpositive"] = self._nonpositive
+            return snap
+
+    def buckets(self) -> Dict[str, Any]:
+        """The raw distribution for exposition formats: occupied buckets
+        as ``(upper_bound_seconds, cumulative_count)`` pairs (sparse —
+        empty buckets are omitted; cumulative counts stay valid), plus
+        the exact count/sum. This is what ``MetricsRegistry.prometheus()``
+        renders as ``_bucket{le=...}`` lines."""
+        with self._lock:
+            pairs: List[Tuple[float, int]] = []
+            cum = 0
+            for i, c in enumerate(self._counts):
+                cum += c
+                if c:
+                    le = self._lo * 2.0 ** (i / self._sub) if i else self._lo
+                    pairs.append((le, cum))
+            return {
+                "buckets": pairs,
+                "count": self._n,
+                "sum": self._sum,
+                "dropped_nonpositive": self._nonpositive,
             }
 
 
@@ -519,8 +650,302 @@ class MetricsRegistry:
         for part in parts:
             part.reset()
 
+    def prometheus(self) -> str:
+        """The whole registry as Prometheus text exposition (format 0.0.4)
+        — what ``tools/metrics_server.py`` serves at ``/metrics``.
+
+        Naming: every family is prefixed ``keystone_``, dots become
+        underscores, and the PR-5 per-instance namespacing
+        (``serve.queue_depth[svc0]``) becomes an ``instance`` label
+        instead of a distinct family, so one scrape config covers every
+        engine/service in the process. Per component type:
+
+        - ``LatencyHistogram`` -> a ``<name>_seconds`` histogram family
+          (sparse ``_bucket{le=...}`` lines over the occupied log buckets,
+          exact ``_sum``/``_count``), a ``<name>_quantile_seconds`` gauge
+          family (p50/p95/p99, the same nearest-rank numbers
+          ``snapshot()`` reports), and a ``_dropped_nonpositive_total``
+          counter;
+        - ``Gauge`` -> ``<name>`` and ``<name>_max`` gauges;
+        - ``CounterSet`` -> ``<name>_total`` counters, keys as a ``key``
+          label;
+        - anything else (e.g. the serving compile counters) -> its
+          ``snapshot()`` dict flattened to gauges, one level of nested
+          dict becoming a ``key`` label.
+
+        The output always parses under ``validate_prometheus_text`` and
+        agrees with ``snapshot()`` — both are pinned by tier-1.
+        """
+        with self._lock:
+            parts = dict(self._parts)
+        fams: "OrderedDict[str, dict]" = OrderedDict()
+
+        def fam(name: str, typ: str) -> List[tuple]:
+            entry = fams.setdefault(name, {"type": typ, "samples": []})
+            return entry["samples"]
+
+        for name, part in sorted(parts.items()):
+            base, instance = _split_instance(name)
+            mname = _prom_name(base)
+            labels = {"instance": instance} if instance else {}
+            if isinstance(part, LatencyHistogram):
+                dist = part.buckets()
+                hname = f"{mname}_seconds"
+                samples = fam(hname, "histogram")
+                for le, cum in dist["buckets"]:
+                    samples.append((
+                        f"{hname}_bucket",
+                        {**labels, "le": _format_value(le)},
+                        cum,
+                    ))
+                samples.append((
+                    f"{hname}_bucket", {**labels, "le": "+Inf"},
+                    dist["count"],
+                ))
+                samples.append((f"{hname}_sum", labels, dist["sum"]))
+                samples.append((f"{hname}_count", labels, dist["count"]))
+                qsamples = fam(f"{mname}_quantile_seconds", "gauge")
+                snap = part.snapshot()
+                for q, key in ((0.5, "p50_ms"), (0.95, "p95_ms"),
+                               (0.99, "p99_ms")):
+                    if key in snap:
+                        qsamples.append((
+                            f"{mname}_quantile_seconds",
+                            {**labels, "quantile": str(q)},
+                            snap[key] / 1e3,
+                        ))
+                dname = f"{mname}_dropped_nonpositive_total"
+                fam(dname, "counter").append(
+                    (dname, labels, dist["dropped_nonpositive"])
+                )
+            elif isinstance(part, Gauge):
+                snap = part.snapshot()
+                fam(mname, "gauge").append((mname, labels, snap["value"]))
+                fam(f"{mname}_max", "gauge").append(
+                    (f"{mname}_max", labels, snap["max"])
+                )
+            elif isinstance(part, CounterSet):
+                cname = f"{mname}_total"
+                samples = fam(cname, "counter")
+                for key, count in part.snapshot().items():
+                    samples.append((cname, {**labels, "key": key}, count))
+            else:
+                for key, val in part.snapshot().items():
+                    sub = f"{mname}_{_PROM_BAD.sub('_', str(key))}"
+                    if isinstance(val, bool) or val is None:
+                        continue
+                    if isinstance(val, (int, float)):
+                        fam(sub, "gauge").append((sub, labels, val))
+                    elif isinstance(val, dict):
+                        samples = fam(sub, "gauge")
+                        for k2, v2 in val.items():
+                            if isinstance(v2, (int, float)) and not isinstance(
+                                v2, bool
+                            ):
+                                samples.append(
+                                    (sub, {**labels, "key": str(k2)}, v2)
+                                )
+        lines: List[str] = []
+        for fname, entry in fams.items():
+            if not entry["samples"]:
+                continue
+            lines.append(f"# TYPE {fname} {entry['type']}")
+            for sname, labels, value in entry["samples"]:
+                lines.append(
+                    f"{sname}{_prom_labels(labels)} {_format_value(value)}"
+                )
+        return "\n".join(lines) + "\n"
+
 
 metrics_registry = MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition helpers (stdlib only — the export surface)
+# ---------------------------------------------------------------------------
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+_INSTANCE_RE = re.compile(r"^(?P<base>.+?)\[(?P<instance>[^\]]+)\]$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r" (?P<value>\S+)$"
+)
+_LABEL_RE = re.compile(
+    r'(?P<key>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<value>(?:[^"\\]|\\.)*)"'
+)
+_PROM_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+
+def _split_instance(name: str) -> Tuple[str, Optional[str]]:
+    """Split the registry's ``base[instance]`` namespacing into a family
+    base and an instance label value."""
+    m = _INSTANCE_RE.match(name)
+    if m:
+        return m.group("base"), m.group("instance")
+    return name, None
+
+
+def _prom_name(base: str) -> str:
+    name = _PROM_BAD.sub("_", base)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return f"keystone_{name}"
+
+
+def _prom_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        '{}="{}"'.format(
+            k,
+            str(v).replace("\\", r"\\").replace('"', r"\"").replace(
+                "\n", r"\n"
+            ),
+        )
+        for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def _format_value(v) -> str:
+    """A float/int as Prometheus spells it (no trailing .0 on ints, repr
+    precision on floats so the scrape agrees with ``snapshot()``)."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    if f == math.inf:
+        return "+Inf"
+    if f == -math.inf:
+        return "-Inf"
+    return repr(f)
+
+
+_LABEL_ESCAPES = {"n": "\n", "\\": "\\", '"': '"'}
+
+
+def _unescape_label(value: str) -> str:
+    """Decode label-value escapes in ONE pass: sequential str.replace
+    would let the tail of an escaped backslash re-match as the head of
+    another escape (``dir\\\\name`` -> ``dir\\<newline>ame``)."""
+    return re.sub(
+        r"\\(.)", lambda m: _LABEL_ESCAPES.get(m.group(1), m.group(1)),
+        value,
+    )
+
+
+def _parse_prom_value(raw: str) -> float:
+    if raw == "+Inf":
+        return math.inf
+    if raw == "-Inf":
+        return -math.inf
+    return float(raw)  # raises ValueError on garbage; NaN parses
+
+
+def parse_prometheus_text(text: str) -> List[Dict[str, Any]]:
+    """Parse text exposition into sample dicts (``name``, ``labels``,
+    ``value``). Raises ValueError naming the first malformed line —
+    ``validate_prometheus_text`` is the error-list wrapper."""
+    samples: List[Dict[str, Any]] = []
+    for i, line in enumerate(text.splitlines(), 1):
+        line = line.rstrip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 2 and parts[1] == "TYPE":
+                if len(parts) != 4 or parts[3] not in _PROM_TYPES:
+                    raise ValueError(f"line {i}: malformed TYPE: {line!r}")
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {i}: malformed sample: {line!r}")
+        raw_labels = m.group("labels") or ""
+        labels: Dict[str, str] = {}
+        if raw_labels:
+            for lm in _LABEL_RE.finditer(raw_labels):
+                labels[lm.group("key")] = _unescape_label(lm.group("value"))
+            leftovers = _LABEL_RE.sub("", raw_labels).strip(", \t")
+            if leftovers:
+                raise ValueError(
+                    f"line {i}: malformed labels: {raw_labels!r}"
+                )
+        try:
+            value = _parse_prom_value(m.group("value"))
+        except ValueError:
+            raise ValueError(
+                f"line {i}: bad sample value {m.group('value')!r}"
+            ) from None
+        samples.append({"name": m.group("name"), "labels": labels,
+                        "value": value})
+    return samples
+
+
+def validate_prometheus_text(text: str) -> List[str]:
+    """Schema check of a Prometheus text exposition; returns the list of
+    problems (empty = valid). Shared by ``tools/metrics_server.py``'s
+    smoke mode and the tier-1 export tests so the renderer and its
+    validator can't drift. Beyond line syntax, histogram families are
+    checked for cumulative, ``+Inf``-terminated buckets that agree with
+    ``_count``."""
+    errors: List[str] = []
+    try:
+        samples = parse_prometheus_text(text)
+    except ValueError as e:
+        return [str(e)]
+    types: Dict[str, str] = {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) == 4:
+                if parts[2] in types:
+                    errors.append(f"duplicate TYPE for {parts[2]}")
+                types[parts[2]] = parts[3]
+    by_name: Dict[str, List[dict]] = {}
+    for s in samples:
+        by_name.setdefault(s["name"], []).append(s)
+    for fname, typ in types.items():
+        if typ != "histogram":
+            continue
+        series: Dict[tuple, List[tuple]] = {}
+        for s in by_name.get(f"{fname}_bucket", []):
+            key = tuple(sorted(
+                (k, v) for k, v in s["labels"].items() if k != "le"
+            ))
+            le = s["labels"].get("le")
+            if le is None:
+                errors.append(f"{fname}_bucket sample missing le label")
+                continue
+            try:
+                le_val = _parse_prom_value(le)
+            except ValueError:
+                # A validator must report, never raise: that is its
+                # whole contract against untrusted exposition text.
+                errors.append(f"{fname}_bucket: non-numeric le {le!r}")
+                continue
+            series.setdefault(key, []).append((le_val, s["value"]))
+        counts = {
+            tuple(sorted(s["labels"].items())): s["value"]
+            for s in by_name.get(f"{fname}_count", [])
+        }
+        for key, pairs in series.items():
+            les = [p[0] for p in pairs]
+            cums = [p[1] for p in pairs]
+            if les != sorted(les):
+                errors.append(f"{fname}{dict(key)}: le bounds not sorted")
+            if any(b < a for a, b in zip(cums, cums[1:])):
+                errors.append(
+                    f"{fname}{dict(key)}: bucket counts not cumulative"
+                )
+            if not les or les[-1] != math.inf:
+                errors.append(f"{fname}{dict(key)}: no le=\"+Inf\" bucket")
+            elif counts and counts.get(key) != cums[-1]:
+                errors.append(
+                    f"{fname}{dict(key)}: _count disagrees with +Inf bucket"
+                )
+    return errors
 
 
 def environment_fingerprint(devices: bool = True) -> Dict[str, Any]:

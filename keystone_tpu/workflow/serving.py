@@ -71,6 +71,7 @@ import jax
 import numpy as np
 
 from keystone_tpu.config import config, pow2_ladder
+from keystone_tpu.utils.flight_recorder import FlightRecorder, next_request_id
 from keystone_tpu.utils.metrics import (
     LatencyHistogram,
     active_tracer,
@@ -100,6 +101,13 @@ logger = logging.getLogger("keystone_tpu")
 # overwrite each other's readings.
 request_latency = metrics_registry.histogram("serve.request_latency")
 e2e_latency = metrics_registry.histogram("serve.e2e_latency")
+#: Stall-watchdog firings, keyed by service name: a non-empty pending
+#: queue that made no dispatch progress past KEYSTONE_WATCHDOG_MS.
+stall_counters = metrics_registry.counters("serve.stalls")
+
+#: Samples the always-on e2e histogram needs before the auto (running
+#: p99) tail-sampling threshold engages — below this, "p99" is noise.
+TAIL_MIN_COUNT = 32
 
 #: Process-wide instance sequencers behind the per-instance metric names.
 _engine_seq = itertools.count()
@@ -356,16 +364,19 @@ class _Replica:
 
 class _Launched:
     """A chunk in flight on one replica: the un-materialized device output
-    and everything the completion side needs to slice and attribute it."""
+    and everything the completion side needs to slice and attribute it
+    (including the request ids riding in the chunk, so the cross-thread
+    ``serve.device`` span links back to each request's journey)."""
 
-    __slots__ = ("replica", "out", "m", "b", "t0")
+    __slots__ = ("replica", "out", "m", "b", "t0", "req_ids")
 
-    def __init__(self, replica, out, m, b, t0):
+    def __init__(self, replica, out, m, b, t0, req_ids):
         self.replica = replica
         self.out = out
         self.m = m
         self.b = b
         self.t0 = t0
+        self.req_ids = req_ids
 
 
 class _AsyncResult:
@@ -375,14 +386,17 @@ class _AsyncResult:
     and window 1 this is exactly the serial launch→materialize loop."""
 
     __slots__ = ("_cp", "_X", "_pin", "_window", "_starts", "_next",
-                 "_launched", "_outs", "_result", "_done", "_exc", "_t0")
+                 "_launched", "_outs", "_result", "_done", "_exc", "_t0",
+                 "_req_ids")
 
     def __init__(self, cp: "CompiledPipeline", X: np.ndarray,
-                 pin: Optional[int], window: int, t0: float):
+                 pin: Optional[int], window: int, t0: float,
+                 req_ids: Optional[Sequence[int]] = None):
         self._cp = cp
         self._X = X
         self._pin = pin
         self._t0 = t0
+        self._req_ids = tuple(req_ids) if req_ids else None
         self._window = max(1, int(window))
         self._starts = list(range(0, X.shape[0], cp.max_batch))
         self._next = 0
@@ -400,7 +414,9 @@ class _AsyncResult:
         ):
             s = self._starts[self._next]
             chunk = self._X[s : s + self._cp.max_batch]
-            self._launched.append(self._cp._launch_chunk(chunk, self._pin))
+            self._launched.append(
+                self._cp._launch_chunk(chunk, self._pin, self._req_ids)
+            )
             self._next += 1
 
     def wait(self):
@@ -631,12 +647,13 @@ class CompiledPipeline:
         return self.replicas[idx]
 
     def _launch_chunk(
-        self, chunk: np.ndarray, pin: Optional[int] = None
+        self, chunk: np.ndarray, pin: Optional[int] = None,
+        req_ids: Optional[Sequence[int]] = None,
     ) -> _Launched:
         """Pad one ≤max_batch chunk onto its bucket and launch it on a
         replica (``pin`` overrides the least-outstanding pick). Returns
         without waiting: JAX async dispatch hands back un-materialized
-        device arrays."""
+        device arrays. ``req_ids`` rides along for span attribution."""
         m = chunk.shape[0]
         b = bucket_for(m, self.ladder)
         if m != b:
@@ -670,7 +687,7 @@ class CompiledPipeline:
                 self._out_gauges[r.index].set(r.outstanding)
             raise
         serving_counters.record_call(b, m)
-        return _Launched(r, out, m, b, t0)
+        return _Launched(r, out, m, b, t0, req_ids)
 
     def _release_slot(self, lc: _Launched) -> None:
         """Release one launched chunk's replica slot without touching its
@@ -698,10 +715,14 @@ class CompiledPipeline:
             self._out_gauges[lc.replica.index].set(lc.replica.outstanding)
         tr = self._tracer
         if tr is not None:
-            tr.record(
-                "serve.device", "serving", lc.t0, rows=lc.m, bucket=lc.b,
-                device=lc.replica.device.id, replica=lc.replica.index,
-            )
+            attrs = dict(rows=lc.m, bucket=lc.b,
+                         device=lc.replica.device.id,
+                         replica=lc.replica.index)
+            if lc.req_ids is not None:
+                # The cross-thread link: which requests' rows this device
+                # call carried — the journey reconstruction key.
+                attrs["req_ids"] = list(lc.req_ids)
+            tr.record("serve.device", "serving", lc.t0, **attrs)
         return out
 
     def call_async(
@@ -709,6 +730,7 @@ class CompiledPipeline:
         X,
         replica: Optional[int] = None,
         window: Optional[int] = None,
+        req_ids: Optional[Sequence[int]] = None,
     ) -> _AsyncResult:
         """Launch a batch without waiting for the device: returns an
         ``_AsyncResult`` whose ``wait()`` yields the numpy output.
@@ -718,7 +740,12 @@ class CompiledPipeline:
         replica — the micro-batcher's dispatcher uses this so its
         in-flight window is attributable per replica. ``window`` bounds
         how many chunks ride async dispatch at once (default: the
-        engine's per-replica in-flight window × the replicas in play)."""
+        engine's per-replica in-flight window × the replicas in play).
+
+        ``req_ids`` names the requests riding in this batch (the
+        micro-batcher passes its coalesced group's ids so ``serve.device``
+        spans link back to each request's journey); a direct engine call
+        mints one fresh monotonic id for the whole batch."""
         if self.feature_shape is None:
             # Lazy warmup off the first request's signature: correct, but
             # the first-traffic latency pays the whole ladder. Call
@@ -742,7 +769,11 @@ class CompiledPipeline:
             window = self.inflight * (
                 1 if replica is not None else len(self.replicas)
             )
-        return _AsyncResult(self, X, replica, window, t0)
+        if req_ids is None:
+            # Direct engine traffic gets an id too: one per batch — the
+            # monotonic mint point for CompiledPipeline.__call__.
+            req_ids = (next_request_id(),)
+        return _AsyncResult(self, X, replica, window, t0, req_ids)
 
     def __call__(self, X):
         """Serve one batch synchronously: returns numpy, sliced to the
@@ -816,6 +847,24 @@ class CompiledPipeline:
 # ---------------------------------------------------------------------------
 # PipelineService — request coalescing micro-batcher over the replica pool
 # ---------------------------------------------------------------------------
+
+
+class _Request:
+    """One accepted request in the micro-batcher: payload + future +
+    deadline, the monotonic request id minted at submit, and the
+    always-on flight-recorder journey record that follows it across the
+    dispatcher/replica/completion threads."""
+
+    __slots__ = ("x", "datum", "fut", "deadline", "t_sub", "rid", "rec")
+
+    def __init__(self, x, datum, fut, deadline, t_sub, rid, rec):
+        self.x = x
+        self.datum = datum
+        self.fut = fut
+        self.deadline = deadline
+        self.t_sub = t_sub
+        self.rid = rid
+        self.rec = rec
 
 
 class _FlightRec:
@@ -893,6 +942,8 @@ class PipelineService:
         deadline_ms: Optional[float] = None,
         inflight: Optional[int] = None,
         name: Optional[str] = None,
+        watchdog_ms: Optional[float] = None,
+        flight_dir: Optional[str] = None,
     ):
         if compiled.feature_shape is None:
             raise RuntimeError(
@@ -944,8 +995,29 @@ class PipelineService:
         self._outcomes = metrics_registry.counters(
             f"serve.requests[{self.name}]"
         )
+        # The black box: always-on journey ring + error events, dumped on
+        # worker/replica death, deadline storms, watchdog stalls, and
+        # debug_dump(). context=self.stats runs at dump time from an
+        # UNLOCKED point (poll discipline — see utils/flight_recorder.py).
+        self._flight = FlightRecorder(
+            self.name, directory=flight_dir, context=self.stats
+        )
+        # Deadline-storm trigger state: perf_counter stamps of the most
+        # recent serve_storm_expired expiries; full deque inside one
+        # second = storm. Written only via _fail_expired (one root).
+        self._storm_n = int(config.serve_storm_expired)
+        self._expired_times: deque = deque(maxlen=max(1, self._storm_n))
+        # Stall-watchdog state: last time the dispatch side made progress
+        # (group popped or completed). Written under self._lock from the
+        # dispatcher, completers, and the watchdog itself.
+        self._watchdog_s = (
+            config.serve_watchdog_ms if watchdog_ms is None else watchdog_ms
+        ) / 1e3
+        self._last_progress_ns = time.perf_counter_ns()
+        self._stalls = 0
+        self._wd_stop = threading.Event()
         self._pending: deque = deque()
-        self._inflight: list = []  # futures popped but not yet launched
+        self._inflight: list = []  # requests popped but not yet launched
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._closed = False
@@ -986,10 +1058,21 @@ class PipelineService:
             self._completers = [
                 self._spawn_completer(r) for r in range(self._n_replicas)
             ]
+        self._watchdog: Optional[threading.Thread] = None
+        if self._watchdog_s > 0:
+            self._watchdog = self._spawn_watchdog()
 
     def _spawn_worker(self) -> threading.Thread:
         t = threading.Thread(
             target=self._loop, name="keystone-serve", daemon=True
+        )
+        t.start()
+        return t
+
+    def _spawn_watchdog(self) -> threading.Thread:
+        t = threading.Thread(
+            target=self._watchdog_loop, name="keystone-serve-watchdog",
+            daemon=True,
         )
         t.start()
         return t
@@ -1029,6 +1112,10 @@ class PipelineService:
         )
         deadline = time.monotonic() + deadline_s if deadline_s > 0 else None
         fut: Future = Future()
+        # The request's identity for causal tracing and the flight
+        # recorder: minted HERE, before any queueing decision, so even a
+        # rejected request has an id in the error-event ring.
+        rid = next_request_id()
         # Lifecycle clock: queued → flushed → device → resolved spans and
         # the e2e histogram all measure from this submit timestamp.
         t_sub = time.perf_counter_ns()
@@ -1043,21 +1130,40 @@ class PipelineService:
                 self.rejected += 1
                 reliability_counters.bump("requests_rejected")
                 self._outcomes.bump("rejected")
+                self._flight.error(
+                    "rejected",
+                    f"queue at capacity ({self.max_pending} pending)",
+                    rid=rid,
+                )
                 if self._tracer is not None:
                     self._tracer.instant(
-                        "serve.rejected", "serving", rows=int(x.shape[0])
+                        "serve.rejected", "serving", rows=int(x.shape[0]),
+                        req_id=rid,
                     )
                 raise QueueFullError(
                     f"serving queue at capacity ({self.max_pending} "
                     "pending); request rejected fast"
                 )
-            self._pending.append((x, datum, fut, deadline, t_sub))
+            if not self._pending:
+                # Queue transitions empty -> non-empty: re-arm the stall
+                # watchdog. Without this, the first request after an idle
+                # stretch longer than the watchdog window would read as a
+                # "stall" (stale progress stamp + non-empty queue) and
+                # dump the black box over a perfectly healthy service.
+                self._last_progress_ns = time.perf_counter_ns()
+            rec = self._flight.start(rid, int(x.shape[0]))
+            self._pending.append(
+                _Request(x, datum, fut, deadline, t_sub, rid, rec)
+            )
             self.requests += 1
             depth = len(self._pending)
             self._queue_gauge.set(depth)
             if depth > self._depth_max:
                 self._depth_max = depth
             self._cv.notify()
+        # Safe (unlocked) point: flush any dump a death/storm detection
+        # marked pending while the lock was held.
+        self._flight.poll()
         return fut
 
     def _ensure_worker_locked(self) -> None:
@@ -1067,13 +1173,14 @@ class PipelineService:
         belong to the completion threads and survive the restart."""
         if self._worker.is_alive():
             return
-        dead = [f for f in self._inflight if not f.done()]
-        for f in dead:
-            self._resolve(
-                f, exc=WorkerDiedError(
+        dead = [rq for rq in self._inflight if not rq.fut.done()]
+        for rq in dead:
+            if self._resolve(
+                rq.fut, exc=WorkerDiedError(
                     "serving worker died while this request was in flight"
                 )
-            )
+            ):
+                rq.rec.finish("worker_death")
         if dead:
             reliability_counters.bump(
                 "futures_failed_on_worker_death", len(dead)
@@ -1081,6 +1188,11 @@ class PipelineService:
         self._inflight = []
         self.worker_restarts += 1
         reliability_counters.bump("worker_restarts")
+        self._flight.error(
+            "worker_death",
+            f"dispatcher died; {len(dead)} in-flight future(s) failed",
+        )
+        self._flight.note_dump("worker_death")
         logger.warning(
             "PipelineService worker died; restarting (restart #%d, %d "
             "in-flight futures failed)", self.worker_restarts, len(dead),
@@ -1090,36 +1202,61 @@ class PipelineService:
     # -- worker side -------------------------------------------------------
 
     @staticmethod
-    def _expired(entry) -> bool:
-        deadline = entry[3]
-        return deadline is not None and time.monotonic() > deadline
+    def _expired(rq: _Request) -> bool:
+        return rq.deadline is not None and time.monotonic() > rq.deadline
 
-    def _fail_expired(self, entry) -> None:
+    def _fail_expired(self, rq: _Request) -> None:
         if not self._resolve(
-            entry[2],
+            rq.fut,
             exc=DeadlineExceeded(
                 "request deadline passed before the device ran it"
             ),
         ):
             return  # another path got there first: don't double-count
+        rq.rec.finish("expired")
         self.expired += 1
         reliability_counters.bump("deadline_expired")
         self._outcomes.bump("expired")
+        # Deadline-storm trigger: a full window of expiries inside one
+        # second marks a flight-recorder dump pending (flushed at the
+        # next unlocked poll point — this method can run under the lock).
+        if self._storm_n > 0:
+            now = time.perf_counter()
+            self._expired_times.append(now)
+            if (
+                len(self._expired_times) == self._storm_n
+                and now - self._expired_times[0] <= 1.0
+            ):
+                # Window cleared on trigger: one sustained storm yields
+                # one error event per full window, not one per expiry —
+                # the last-N error ring must keep the OTHER events that
+                # explain the incident, not 256 copies of this one.
+                self._expired_times.clear()
+                self._flight.error(
+                    "deadline_storm",
+                    f"{self._storm_n} requests expired within 1s",
+                    rid=rq.rid,
+                )
+                self._flight.note_dump("deadline_storm")
         if self._tracer is not None:
             self._tracer.record(
-                "serve.request", "serving", entry[4], outcome="expired",
-                rows=int(entry[0].shape[0]),
+                "serve.request", "serving", rq.t_sub, outcome="expired",
+                rows=int(rq.x.shape[0]), req_id=rq.rid,
             )
+            # An expiry IS a latency breach: keep its span tree (scan
+            # bounded to the request's lifetime — this runs under the
+            # dispatch lock during exactly the storms it instruments).
+            self._tracer.retain_request(rq.rid, since_ns=rq.t_sub)
 
     def _filter_expired(self, group) -> list:
         """Deadlines re-checked at flush time: a request can expire while
         the group waits max_delay for company."""
         live = []
-        for entry in group:
-            if self._expired(entry):
-                self._fail_expired(entry)
+        for rq in group:
+            if self._expired(rq):
+                self._fail_expired(rq)
             else:
-                live.append(entry)
+                live.append(rq)
         return live
 
     def _loop(self):
@@ -1142,14 +1279,14 @@ class PipelineService:
                 flush_at: Optional[float] = None
                 while True:
                     if self._pending:
-                        entry = self._pending[0]
-                        if self._expired(entry):
+                        rq = self._pending[0]
+                        if self._expired(rq):
                             # Expired in queue: fail it before it costs a
                             # device call, keep coalescing.
                             self._pending.popleft()
-                            self._fail_expired(entry)
+                            self._fail_expired(rq)
                             continue
-                        nxt_rows = entry[0].shape[0]
+                        nxt_rows = rq.x.shape[0]
                         if group and rows + nxt_rows > self.max_rows:
                             break
                         group.append(self._pending.popleft())
@@ -1166,21 +1303,32 @@ class PipelineService:
                         break
                     self._cv.wait(remaining)
                 # Gauge updated even when everything popped had expired
-                # (group empty): the queue really did shrink.
+                # (group empty): the queue really did shrink. Either way
+                # the dispatcher made progress — re-arm the stall
+                # watchdog (we hold the lock).
                 self._queue_gauge.set(len(self._pending))
-                if not group:
-                    continue
-                self._inflight = [e[2] for e in group]
-                if not self._pipelined:
-                    self._inflight_gauge.set(len(group))
-                if self._tracer is not None:
-                    # Queue residency per request: submit → flush-group pop.
-                    now = self._tracer.now()
-                    for e in group:
-                        self._tracer.record(
-                            "serve.queued", "serving", e[4], now,
-                            rows=int(e[0].shape[0]),
-                        )
+                self._last_progress_ns = time.perf_counter_ns()
+                if group:
+                    self._inflight = list(group)
+                    if not self._pipelined:
+                        self._inflight_gauge.set(len(group))
+                    now_ns = time.perf_counter_ns()
+                    for rq in group:
+                        rq.rec.stamp("flushed")
+                    if self._tracer is not None:
+                        # Queue residency per request: submit →
+                        # flush-group pop.
+                        for rq in group:
+                            self._tracer.record(
+                                "serve.queued", "serving", rq.t_sub, now_ns,
+                                rows=int(rq.x.shape[0]), req_id=rq.rid,
+                            )
+            if not group:
+                # Everything popped had expired: still a safe unlocked
+                # point — an expiry storm detected just above must dump
+                # without waiting for the next group or watchdog tick.
+                self._flight.poll()
+                continue
             if self._pipelined:
                 self._dispatch(group)
             else:
@@ -1188,6 +1336,10 @@ class PipelineService:
                 with self._cv:
                     self._inflight = []
                     self._inflight_gauge.set(0)
+            # Between groups, lock released: flush any dump marked
+            # pending while this iteration held the lock (e.g. a
+            # deadline storm detected during coalescing).
+            self._flight.poll()
 
     @staticmethod
     def _resolve(fut: Future, value=None, exc=None) -> bool:
@@ -1210,20 +1362,41 @@ class PipelineService:
     @staticmethod
     def _concat(live):
         if len(live) == 1:
-            return live[0][0]
-        return np.concatenate([g[0] for g in live], axis=0)
+            return live[0].x
+        return np.concatenate([rq.x for rq in live], axis=0)
+
+    def _maybe_retain(self, tr, rq: _Request, seconds: float) -> None:
+        """Tail sampling: keep the full span tree of a request whose
+        end-to-end latency breached the threshold — an explicit
+        ``config.trace_tail_ms``, or (at 0 = auto) the running p99 of
+        this service's always-on e2e histogram once it has enough
+        samples. Negative disables. Only ever called with tracing armed;
+        the disabled tracer costs nothing here."""
+        thr_ms = config.trace_tail_ms
+        if thr_ms < 0:
+            return
+        if thr_ms == 0:
+            if self._e2e.count < TAIL_MIN_COUNT:
+                return
+            p99 = self._e2e.percentile(99)
+            if p99 is None:
+                return
+            thr_ms = p99 * 1e3
+        if seconds * 1e3 >= thr_ms:
+            tr.retain_request(rq.rid, since_ns=rq.t_sub)
 
     def _deliver(self, live, out, tr, t_flush, rows) -> None:
         """Slice one flush's output back per request and resolve the
         futures (the completion path, shared by the serial flush and the
         per-replica completion threads)."""
         off = 0
-        for x, datum, fut, _deadline, t_sub in live:
-            m = x.shape[0]
+        retains = []
+        for rq in live:
+            m = rq.x.shape[0]
             piece = jax.tree_util.tree_map(
                 lambda a, o=off, m=m: a[o : o + m], out
             )
-            if datum:
+            if rq.datum:
                 piece = jax.tree_util.tree_map(lambda a: a[0], piece)
             off += m
             # Latency captured BEFORE resolving (set_result runs client
@@ -1232,32 +1405,54 @@ class PipelineService:
             # the future — a request another path already failed (close,
             # worker death) must not double-count as 'ok'.
             now_ns = time.perf_counter_ns()
-            if not self._resolve(fut, value=piece):
+            if not self._resolve(rq.fut, value=piece):
                 continue
-            self._e2e.record((now_ns - t_sub) / 1e9)
-            e2e_latency.record((now_ns - t_sub) / 1e9)
+            rq.rec.finish("ok")
+            sec = (now_ns - rq.t_sub) / 1e9
+            self._e2e.record(sec)
+            e2e_latency.record(sec)
             self._outcomes.bump("ok")
             if tr is not None:
                 tr.record(
-                    "serve.request", "serving", t_sub, now_ns,
-                    outcome="ok", rows=m,
+                    "serve.request", "serving", rq.t_sub, now_ns,
+                    outcome="ok", rows=m, req_id=rq.rid,
                 )
+                retains.append((rq, sec))
         if tr is not None:
             tr.record(
                 "serve.flush", "serving", t_flush,
                 requests=len(live), rows=rows,
+                req_ids=[rq.rid for rq in live],
             )
+            # Tail-sample AFTER the group's serve.flush span is in the
+            # ring, or retained trees would permanently lack the flushed
+            # leg of the journey once the ring churns.
+            for rq, sec in retains:
+                self._maybe_retain(tr, rq, sec)
 
     def _fail_group(self, live, e, tr) -> None:
         """Fail every unresolved future in a flush group, keep serving."""
-        for x, _d, fut, _deadline, t_sub in live:
-            if not fut.done() and self._resolve(fut, exc=e):
+        failed = []
+        for rq in live:
+            if not rq.fut.done() and self._resolve(rq.fut, exc=e):
+                rq.rec.finish(type(e).__name__)
+                failed.append(rq.rid)
                 self._outcomes.bump("error")
                 if tr is not None:
                     tr.record(
-                        "serve.request", "serving", t_sub,
-                        outcome=type(e).__name__, rows=int(x.shape[0]),
+                        "serve.request", "serving", rq.t_sub,
+                        outcome=type(e).__name__, rows=int(rq.x.shape[0]),
+                        req_id=rq.rid,
                     )
+                    # Failures keep their span trees like latency
+                    # breaches do: the error IS the interesting tail.
+                    tr.retain_request(rq.rid, since_ns=rq.t_sub)
+        if failed:
+            self._flight.error(
+                type(e).__name__,
+                f"flush group failed ({len(failed)} request(s)): {e}",
+                rid=failed[0],
+            )
 
     def _flush(self, group):
         """Serial flush (one replica, window 1): launch AND materialize
@@ -1269,6 +1464,9 @@ class PipelineService:
         t_flush = tr.now() if tr is not None else 0
         try:
             X = self._concat(live)
+            b = bucket_for(X.shape[0], getattr(self.compiled, "ladder", ()))
+            for rq in live:
+                rq.rec.dispatched(0, b)
             out = self.compiled(X)
             # Under the lock even though the serial path has no completer
             # threads: these counters are ALSO bumped from _complete_loop
@@ -1346,9 +1544,15 @@ class PipelineService:
                 t_flush = tr.now() if tr is not None else 0
                 # The service's window also bounds the chunk-launch depth
                 # of a multi-chunk (oversize) group: one knob, one value.
+                # req_ids thread the coalesced requests' identities into
+                # the engine so serve.device spans link back to them.
                 handle = self.compiled.call_async(
-                    X, replica=r, window=self.inflight_limit
+                    X, replica=r, window=self.inflight_limit,
+                    req_ids=[rq.rid for rq in live],
                 )
+                b = bucket_for(rows, getattr(self.compiled, "ladder", ()))
+                for rq in live:
+                    rq.rec.dispatched(r, b)
         # lint: broad-ok concat/launch failure of any kind fails the group's futures; the dispatcher must survive
         except Exception as e:
             self._fail_group(live, e, tr)
@@ -1372,8 +1576,9 @@ class PipelineService:
                 abandon = getattr(handle, "abandon", None)
                 if abandon is not None:
                     abandon()
-                for e in reversed(live):
-                    self._pending.appendleft(e)
+                for rq in reversed(live):
+                    rq.rec.stamp("requeued")
+                    self._pending.appendleft(rq)
                 reliability_counters.bump("serve_groups_redispatched")
                 self._queue_gauge.set(len(self._pending))
                 self._inflight = []
@@ -1403,9 +1608,15 @@ class PipelineService:
                     "replica_death"
                 ):
                     self._kill_replica_locked(r)
-                    return
-                rec = self._cqueues[r].popleft()
-                self._cq_active[r] = rec
+                    rec = None
+                else:
+                    rec = self._cqueues[r].popleft()
+                    self._cq_active[r] = rec
+            if rec is None:
+                # Killed: the dump marked pending under the lock flushes
+                # here, from this dying thread's unlocked tail.
+                self._flight.poll()
+                return
             tr = self._tracer
             try:
                 out = rec.handle.wait()
@@ -1426,7 +1637,11 @@ class PipelineService:
                 # this group was still in flight.
                 self._outstanding[r] = max(0, self._outstanding[r] - 1)
                 self._inflight_gauge.set(sum(self._outstanding))
+                # A completion is dispatch progress: re-arm the watchdog.
+                self._last_progress_ns = time.perf_counter_ns()
                 self._cv.notify_all()
+            # Group boundary = a safe unlocked point for pending dumps.
+            self._flight.poll()
 
     def _kill_replica_locked(self, r: int) -> None:
         """Mark replica r dead and re-queue its in-flight groups at the
@@ -1437,7 +1652,7 @@ class PipelineService:
         self._dead[r] = True
         recs = list(self._cqueues[r])
         self._cqueues[r].clear()
-        entries = [e for rec in recs for e in rec.live]
+        entries = [rq for rec in recs for rq in rec.live]
         for rec in recs:
             # Release the engine-level replica slots of the abandoned
             # launches, or least-outstanding dispatch (direct calls,
@@ -1445,11 +1660,20 @@ class PipelineService:
             abandon = getattr(rec.handle, "abandon", None)
             if abandon is not None:
                 abandon()
-        for e in reversed(entries):
-            self._pending.appendleft(e)
+        for rq in reversed(entries):
+            # The journey shows the detour: dispatched onto the dead
+            # replica, re-queued, then dispatched again on a survivor.
+            rq.rec.stamp("requeued")
+            self._pending.appendleft(rq)
         self._outstanding[r] = 0
         self.replica_deaths += 1
         reliability_counters.bump("replica_deaths")
+        self._flight.error(
+            "replica_death",
+            f"replica {r} died; {len(entries)} request(s) re-queued",
+            rid=entries[0].rid if entries else None,
+        )
+        self._flight.note_dump("replica_death")
         if recs:
             reliability_counters.bump(
                 "serve_groups_redispatched", len(recs)
@@ -1488,6 +1712,54 @@ class PipelineService:
             return
         self._revive_dead_locked()
 
+    # -- stall watchdog + forensics ----------------------------------------
+
+    def _watchdog_loop(self):
+        """Stall watchdog: a non-empty pending queue that has made no
+        dispatch progress (no group popped, no completion) for
+        ``KEYSTONE_WATCHDOG_MS`` bumps the ``serve.stalls`` counter and
+        dumps the flight recorder — turning a silent hang into a counter
+        an operator can alert on plus a post-mortem artifact naming
+        exactly which requests were stuck where. Each tick is also a
+        guaranteed unlocked flush point for dumps other triggers marked
+        pending (so a death with no follow-up traffic still dumps)."""
+        interval = max(self._watchdog_s / 4.0, 0.05)
+        while True:
+            if self._wd_stop.wait(interval):
+                return
+            self._flight.poll()
+            with self._lock:
+                pending = len(self._pending)
+                stalled_s = (
+                    time.perf_counter_ns() - self._last_progress_ns
+                ) / 1e9
+                if not pending or stalled_s < self._watchdog_s:
+                    continue
+                # Re-arm before dumping: one stall = one dump per
+                # watchdog interval, not one per tick.
+                self._last_progress_ns = time.perf_counter_ns()
+                self._stalls += 1
+            stall_counters.bump(self.name)
+            reliability_counters.bump("serve_stalls")
+            self._flight.error(
+                "stall",
+                f"{pending} pending request(s), no dispatch progress for "
+                f"{stalled_s * 1e3:.0f}ms",
+            )
+            logger.warning(
+                "PipelineService %s: watchdog stall — %d pending, no "
+                "dispatch progress for %.0fms; dumping flight recorder",
+                self.name, pending, stalled_s * 1e3,
+            )
+            self._flight.dump("stall")
+
+    def debug_dump(self, path: Optional[str] = None) -> Optional[str]:
+        """Dump the flight recorder NOW (no rate limit): every journey
+        record still in the ring, the last-N error events, and this
+        service's ``stats()`` — the on-demand post-mortem. Returns the
+        path written."""
+        return self._flight.dump("debug", path=path, force=True)
+
     # -- lifecycle ---------------------------------------------------------
 
     def close(self, drain: bool = True):
@@ -1504,19 +1776,22 @@ class PipelineService:
         with self._cv:
             self._closed = True
             if not drain:
-                rejected = [e[2] for e in self._pending]
+                rejected = list(self._pending)
                 self._pending.clear()
             self._cv.notify_all()
             for c in self._ccvs:
                 c.notify_all()
+        self._wd_stop.set()
         self._worker.join(timeout=self._CLOSE_JOIN_S)
         for t in self._completers:
             t.join(timeout=self._CLOSE_JOIN_S)
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=self._CLOSE_JOIN_S)
         with self._cv:
-            leftovers = [e[2] for e in self._pending] + list(self._inflight)
+            leftovers = list(self._pending) + list(self._inflight)
             for q in self._cqueues:
                 for rec in q:
-                    leftovers.extend(e[2] for e in rec.live)
+                    leftovers.extend(rec.live)
                     # Queued (unowned) records release their slots; an
                     # ACTIVE record's handle belongs to its completer —
                     # abandoning it here would race a stuck wait().
@@ -1526,7 +1801,7 @@ class PipelineService:
                 q.clear()
             for i, rec in enumerate(self._cq_active):
                 if rec is not None:
-                    leftovers.extend(e[2] for e in rec.live)
+                    leftovers.extend(rec.live)
                 # In place: a late completer still holds this list.
                 self._cq_active[i] = None
             self._pending.clear()
@@ -1534,13 +1809,14 @@ class PipelineService:
             self._queue_gauge.set(0)
             self._inflight_gauge.set(0)
         failed = 0
-        for fut in rejected + leftovers:
-            if not fut.done() and self._resolve(
-                fut,
+        for rq in rejected + leftovers:
+            if not rq.fut.done() and self._resolve(
+                rq.fut,
                 exc=ServiceClosed(
                     "PipelineService closed before this request ran"
                 ),
             ):
+                rq.rec.finish("closed")
                 self._outcomes.bump("closed")
                 failed += 1
         if failed:
@@ -1576,6 +1852,9 @@ class PipelineService:
             "rejected": self.rejected,
             "expired": self.expired,
             "worker_restarts": self.worker_restarts,
+            "stalls": self._stalls,
+            "watchdog_ms": self._watchdog_s * 1e3,
+            "flight": self._flight.stats(),
             "coalesce_ratio": (
                 self.requests / self.batches_run if self.batches_run else None
             ),

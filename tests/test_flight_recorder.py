@@ -1,0 +1,694 @@
+"""Flight recorder, request-scoped tracing, and the export surface.
+
+What is pinned here, mirroring ISSUE 8's acceptance gates:
+
+1. every accepted request gets a monotonic id and an always-on journey
+   record whose phase chain (submitted → flushed → dispatched →
+   resolved) survives into ``debug_dump()`` with rows/bucket/replica and
+   the final outcome;
+2. the forensics round-trip: under an injected ``replica_death`` with 4
+   concurrent clients, the AUTO-dumped flight record names every
+   re-queued request (requeued phase), and the post-resolution dump
+   reconstructs each full journey — re-dispatched requests show BOTH
+   replicas;
+3. deadline storms and watchdog stalls auto-dump (and the stall bumps
+   the ``serve.stalls`` registry counter) instead of failing silently;
+4. request-scoped causal tracing: ``serve.queued``/``serve.request``
+   spans carry ``req_id``, ``serve.device``/``serve.flush`` carry
+   ``req_ids``, and the cross-thread journey reassembles per id; tail
+   sampling retains full span trees only for threshold-breaching
+   requests;
+5. the pull surface: ``MetricsRegistry.prometheus()`` parses under the
+   shared validator and agrees with ``snapshot()``; the stdlib metrics
+   server serves /metrics + /healthz over a real socket (the
+   ``make obs-serve`` smoke, in-process); ``tools/trace_report.py``
+   fails loudly on an empty trace and reports a per-request critical
+   path.
+"""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from keystone_tpu.config import config
+from keystone_tpu.utils import reliability
+from keystone_tpu.utils.flight_recorder import FlightRecorder, next_request_id
+from keystone_tpu.utils.metrics import (
+    active_tracer,
+    metrics_registry,
+    reliability_counters,
+    reset_tracer,
+)
+from keystone_tpu.workflow.pipeline import FusedTransformer
+from keystone_tpu.workflow.serving import CompiledPipeline, PipelineService
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def faults():
+    """Arm a fault plan for the test (test_reliability's idiom)."""
+    prior = (config.faults, config.faults_seed)
+    reliability_counters.reset()
+
+    def arm(spec: str, seed: int = 0):
+        config.faults, config.faults_seed = spec, seed
+        reliability.reset_fault_plan()
+        return reliability.active_plan()
+
+    arm("")
+    yield arm
+    config.faults, config.faults_seed = prior
+    reliability.reset_fault_plan()
+    reliability_counters.reset()
+
+
+@pytest.fixture
+def traced():
+    """Arm process-wide tracing for the test (test_observability's
+    idiom); also restores the tail-sampling knob."""
+    prior = (config.trace, config.trace_tail_ms)
+
+    def arm(on: bool = True, tail_ms: float = 0.0):
+        config.trace = on
+        config.trace_tail_ms = tail_ms
+        reset_tracer()
+        return active_tracer()
+
+    try:
+        yield arm
+    finally:
+        config.trace, config.trace_tail_ms = prior
+        reset_tracer()
+
+
+def _head(d=8, D=16, k=3, seed=0):
+    from keystone_tpu.nodes.learning.linear_mapper import LinearMapper
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+    from keystone_tpu.nodes.stats.random_features import CosineRandomFeatures
+
+    rng = np.random.default_rng(seed)
+    return FusedTransformer(
+        [
+            CosineRandomFeatures.create(d, D, seed=seed),
+            L2Normalizer(),
+            LinearMapper(rng.normal(size=(D, k)).astype(np.float32)),
+        ]
+    )
+
+
+def _phase_names(record):
+    return [p["phase"] for p in record["phases"]]
+
+
+# ---------------------------------------------------------------------------
+# FlightRecorder unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_ring_bound_and_errors(tmp_path):
+    fr = FlightRecorder("t0", capacity=4, directory=str(tmp_path))
+    for i in range(10):
+        rec = fr.start(i, rows=1)
+        rec.finish("ok")
+    snap = fr.snapshot()
+    assert len(snap["records"]) == 4  # bounded ring, most recent kept
+    assert [r["id"] for r in snap["records"]] == [6, 7, 8, 9]
+    assert snap["records_started"] == 10
+    for i in range(300):
+        fr.error("boom", f"event {i}", rid=i)
+    snap = fr.snapshot()
+    assert len(snap["errors"]) == FlightRecorder.ERROR_CAPACITY
+    assert snap["errors"][-1]["message"] == "event 299"
+    # 0 = the repo-wide disabled convention: journey ring off, error
+    # events and dumps intact; negative is a configuration error.
+    off = FlightRecorder("t1", capacity=0, directory=str(tmp_path))
+    off.start(1, rows=1).finish("ok")
+    off.error("x", "still recorded")
+    snap = off.snapshot()
+    assert snap["records"] == [] and snap["records_started"] == 1
+    assert len(snap["errors"]) == 1
+    with pytest.raises(ValueError, match="capacity"):
+        FlightRecorder("t1b", capacity=-1)
+
+
+def test_recorder_dump_rate_limit_and_force(tmp_path):
+    fr = FlightRecorder("t2", capacity=8, directory=str(tmp_path))
+    rec = fr.start(next_request_id(), rows=3)
+    rec.dispatched(1, 8)
+    rec.finish("ok")
+    p1 = fr.dump("stall")
+    assert p1 is not None and os.path.exists(p1)
+    assert fr.dump("stall") is None  # rate-limited per reason
+    p2 = fr.dump("stall", force=True)
+    assert p2 is not None and p2 != p1
+    with open(p1) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "stall"
+    assert doc["service"] == "t2"
+    assert doc["records"][0]["replicas"] == [1]
+    assert doc["records"][0]["bucket"] == 8
+    assert doc["records"][0]["outcome"] == "ok"
+    assert _phase_names(doc["records"][0]) == [
+        "submitted", "dispatched", "resolved",
+    ]
+    assert fr.dumps == [p1, p2]
+
+
+def test_failed_dump_write_does_not_consume_rate_limit(tmp_path):
+    """A transient write failure must not suppress the retry that would
+    have captured the incident: the per-reason slot is stamped only
+    after a successful write."""
+    fr = FlightRecorder(
+        "t4", capacity=4, directory=str(tmp_path / "does" / "not" / "exist")
+    )
+    assert fr.dump("replica_death") is None  # unwritable: fails, logged
+    fr.directory = str(tmp_path)  # "disk back": the retry must land
+    p = fr.dump("replica_death")
+    assert p is not None and os.path.exists(p)
+    assert fr.dumps == [p]
+    assert fr.stats()["dumps_total"] == 1
+
+
+def test_request_report_queue_wait_not_double_counted(tmp_path):
+    """Re-dispatched requests record one serve.queued span per flush-group
+    pop, all starting at submit: the critical-path view must take the
+    longest (true residency), not their overlapping sum."""
+    report = _load_tool("trace_report")
+    doc = {
+        "traceEvents": [
+            {"name": "serve.queued", "cat": "serving", "ph": "X",
+             "ts": 0.0, "dur": 1000.0, "pid": 1, "tid": 1,
+             "args": {"req_id": 5, "rows": 2}},
+            {"name": "serve.queued", "cat": "serving", "ph": "X",
+             "ts": 0.0, "dur": 3000.0, "pid": 1, "tid": 1,
+             "args": {"req_id": 5, "rows": 2}},
+            {"name": "serve.device", "cat": "serving", "ph": "X",
+             "ts": 3000.0, "dur": 500.0, "pid": 1, "tid": 2,
+             "args": {"req_ids": [5]}},
+            {"name": "serve.request", "cat": "serving", "ph": "X",
+             "ts": 0.0, "dur": 4000.0, "pid": 1, "tid": 1,
+             "args": {"req_id": 5, "outcome": "ok"}},
+        ]
+    }
+    rep = report.request_report(doc, 5)
+    assert rep["phases"]["queue_wait_ms"] == 3.0  # max, not 4.0 = sum
+    assert rep["phases"]["e2e_ms"] == 4.0
+    assert rep["phases"]["resolve_tail_ms"] == pytest.approx(0.5)
+
+
+def test_note_dump_flushes_at_poll_not_inline(tmp_path):
+    fr = FlightRecorder("t3", capacity=8, directory=str(tmp_path))
+    fr.note_dump("worker_death")
+    fr.note_dump("stall")  # first reason wins until flushed
+    assert fr.dumps == []
+    path = fr.poll()
+    assert path is not None and "worker_death" in path
+    assert fr.poll() is None  # pending cleared
+
+
+# ---------------------------------------------------------------------------
+# Journey records through the live service
+# ---------------------------------------------------------------------------
+
+
+def test_journey_records_full_phase_chain(rng, tmp_path):
+    d = 8
+    cp = CompiledPipeline(_head(d=d), max_batch=16, devices=2).warmup((d,))
+    svc = PipelineService(
+        cp, max_delay_ms=0.5, inflight=2, flight_dir=str(tmp_path)
+    )
+    try:
+        xs = [rng.normal(size=(3, d)).astype(np.float32) for _ in range(12)]
+        for x in xs:
+            svc.submit(x).result(timeout=30)
+        path = svc.debug_dump(str(tmp_path / "journeys.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["reason"] == "debug"
+        assert doc["stats"]["requests"] == 12  # context = service stats
+        records = doc["records"]
+        assert len(records) == 12
+        ids = [r["id"] for r in records]
+        assert ids == sorted(ids) and len(set(ids)) == 12  # monotonic mint
+        for r in records:
+            assert r["rows"] == 3
+            assert r["outcome"] == "ok"
+            assert r["bucket"] in cp.ladder
+            assert len(r["replicas"]) >= 1
+            names = _phase_names(r)
+            # The journey in order: queued -> flushed -> dispatched ->
+            # resolved, with monotone stamps.
+            assert names[0] == "submitted" and names[-1] == "resolved"
+            assert "flushed" in names and "dispatched" in names
+            stamps = [p["t_ns"] for p in r["phases"]]
+            assert stamps == sorted(stamps)
+    finally:
+        svc.close()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_replica_death_forensics_roundtrip(rng, faults, tmp_path):
+    """The acceptance gate: injected replica_death, 4 concurrent
+    clients — the auto-dumped flight record names the re-queued
+    requests, and the post-resolution dump reconstructs every journey
+    (re-dispatched requests show both replicas)."""
+    faults("replica_death:1")
+    d = 8
+    cp = CompiledPipeline(_head(d=d), max_batch=16, devices=4).warmup((d,))
+    ref = CompiledPipeline(_head(d=d), max_batch=16, devices=1).warmup((d,))
+    trace = [
+        rng.normal(size=(3, d)).astype(np.float32) for _ in range(60)
+    ]
+    errs: list = []
+    svc = PipelineService(
+        cp, max_delay_ms=0.5, inflight=2, flight_dir=str(tmp_path),
+        watchdog_ms=200.0,
+    )
+
+    def client(cid):
+        try:
+            for i in range(cid, len(trace), 4):
+                out = svc.submit(trace[i]).result(timeout=60)
+                np.testing.assert_allclose(
+                    out, ref(trace[i]), rtol=2e-6, atol=2e-6
+                )
+        except Exception as e:  # pragma: no cover - surfaced below
+            errs.append(e)
+
+    try:
+        threads = [
+            threading.Thread(target=client, args=(k,)) for k in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs[:2]
+        assert svc.replica_deaths == 1
+        # The AUTO dump fired (poll points / watchdog tick flush it).
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not any(
+            "replica_death" in p for p in svc._flight.dumps
+        ):
+            time.sleep(0.02)
+        auto = [p for p in svc._flight.dumps if "replica_death" in p]
+        assert auto, "replica death did not auto-dump the flight recorder"
+        with open(auto[0]) as f:
+            auto_doc = json.load(f)
+        assert auto_doc["reason"] == "replica_death"
+        assert any(
+            e["kind"] == "replica_death" for e in auto_doc["errors"]
+        )
+        requeued_auto = [
+            r for r in auto_doc["records"]
+            if "requeued" in _phase_names(r)
+        ]
+        assert requeued_auto, "auto dump lost the in-flight requests"
+        # Post-resolution dump: the full journeys, final outcomes.
+        final = svc.debug_dump(str(tmp_path / "final.json"))
+        with open(final) as f:
+            doc = json.load(f)
+        records = {r["id"]: r for r in doc["records"]}
+        assert len(records) == 60
+        assert all(r["outcome"] == "ok" for r in records.values())
+        redispatched = [
+            r for r in records.values() if "requeued" in _phase_names(r)
+        ]
+        assert redispatched
+        for r in redispatched:
+            # Both replicas on the record: the dead one it was launched
+            # on AND the survivor that actually served it.
+            assert len(r["replicas"]) >= 2
+            assert len(set(r["replicas"])) >= 2
+            names = _phase_names(r)
+            assert names.index("requeued") < len(names) - 1
+            stamps = [p["t_ns"] for p in r["phases"]]
+            assert stamps == sorted(stamps)
+        # Every in-flight id the auto dump saw is reconstructed fully.
+        for r in requeued_auto:
+            assert records[r["id"]]["outcome"] == "ok"
+    finally:
+        svc.close()
+
+
+def test_deadline_storm_auto_dump(rng, tmp_path):
+    """A burst of expiries within one second marks a deadline_storm dump
+    that flushes at the next unlocked point."""
+
+    class Slowed:
+        def __init__(self, inner, delay):
+            self._inner, self._delay = inner, delay
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def __call__(self, X):
+            time.sleep(self._delay)
+            return self._inner(X)
+
+    d = 8
+    cp = CompiledPipeline(_head(d=d), max_batch=16, devices=1).warmup((d,))
+    storm_n = config.serve_storm_expired
+    svc = PipelineService(
+        Slowed(cp, 0.15), max_delay_ms=1.0, inflight=1,
+        flight_dir=str(tmp_path), watchdog_ms=200.0, max_pending=64,
+    )
+    try:
+        x = rng.normal(size=(2, d)).astype(np.float32)
+        first = svc.submit(x)  # occupies the worker for 150ms
+        time.sleep(0.05)  # let the worker pop `first` alone: the doomed
+        # requests below must QUEUE behind the slow flush, not coalesce
+        # into it, so their 20ms deadlines lapse before the next pop.
+        doomed = [
+            svc.submit(x, deadline_ms=20.0) for _ in range(storm_n + 2)
+        ]
+        first.result(timeout=30)
+        for f in doomed:
+            with pytest.raises(Exception):
+                f.result(timeout=30)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not any(
+            "deadline_storm" in p for p in svc._flight.dumps
+        ):
+            time.sleep(0.02)
+        storm = [p for p in svc._flight.dumps if "deadline_storm" in p]
+        assert storm, "expiry burst did not auto-dump"
+        with open(storm[0]) as f:
+            doc = json.load(f)
+        expired = [
+            r for r in doc["records"] if r["outcome"] == "expired"
+        ]
+        assert len(expired) >= storm_n
+    finally:
+        svc.close()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_watchdog_detects_stall_and_recovers(rng, faults, tmp_path):
+    """A dead dispatcher with queued work = a stall: the watchdog bumps
+    serve.stalls, dumps the black box, and the next submit still heals
+    the service (detection, not replacement, of the restart path)."""
+    from keystone_tpu.workflow.serving import stall_counters
+
+    faults("worker_death:1")
+    d = 8
+    cp = CompiledPipeline(_head(d=d), max_batch=16, devices=1).warmup((d,))
+    svc = PipelineService(
+        cp, max_delay_ms=0.5, inflight=1, flight_dir=str(tmp_path),
+        watchdog_ms=150.0,
+    )
+    try:
+        before = stall_counters.get(svc.name)
+        x = rng.normal(size=(2, d)).astype(np.float32)
+        first = svc.submit(x)  # wakes the dispatcher into the death
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and svc.stats()["stalls"] == 0:
+            time.sleep(0.02)
+        stats = svc.stats()
+        assert stats["stalls"] >= 1
+        assert stall_counters.get(svc.name) - before >= 1
+        assert any("stall" in p for p in svc._flight.dumps)
+        with open([p for p in svc._flight.dumps if "stall" in p][0]) as f:
+            doc = json.load(f)
+        assert any(e["kind"] == "stall" for e in doc["errors"])
+        # The stuck request is visible, parked after its submit stamp.
+        stuck = [r for r in doc["records"] if r["outcome"] is None]
+        assert stuck
+        # Recovery: the next submit restarts the worker; both drain.
+        second = svc.submit(x)
+        np.testing.assert_allclose(
+            first.result(timeout=30), cp(x), rtol=2e-6, atol=2e-6
+        )
+        np.testing.assert_allclose(
+            second.result(timeout=30), cp(x), rtol=2e-6, atol=2e-6
+        )
+        assert svc.worker_restarts == 1
+    finally:
+        svc.close()
+
+
+def test_watchdog_quiet_after_idle_period(rng, tmp_path):
+    """An idle stretch longer than the watchdog window must NOT read as
+    a stall when the next request arrives: submit re-arms the progress
+    stamp on the empty->non-empty transition."""
+    d = 8
+    cp = CompiledPipeline(_head(d=d), max_batch=16, devices=1).warmup((d,))
+    svc = PipelineService(
+        cp, max_delay_ms=0.5, inflight=1, flight_dir=str(tmp_path),
+        watchdog_ms=150.0,
+    )
+    try:
+        time.sleep(0.5)  # idle for > 3 watchdog windows
+        x = rng.normal(size=(2, d)).astype(np.float32)
+        svc.submit(x).result(timeout=30)
+        time.sleep(0.1)  # give a watchdog tick a chance to misfire
+        assert svc.stats()["stalls"] == 0
+        assert not any("stall" in p for p in svc._flight.dumps)
+    finally:
+        svc.close()
+
+
+def test_watchdog_disabled_at_zero(rng, tmp_path):
+    d = 8
+    cp = CompiledPipeline(_head(d=d), max_batch=16, devices=1).warmup((d,))
+    svc = PipelineService(
+        cp, max_delay_ms=0.5, inflight=1, flight_dir=str(tmp_path),
+        watchdog_ms=0.0,
+    )
+    try:
+        assert svc._watchdog is None
+        assert svc.stats()["watchdog_ms"] == 0.0
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Request-scoped causal tracing + tail sampling
+# ---------------------------------------------------------------------------
+
+
+def test_spans_carry_request_ids_and_reassemble(rng, traced):
+    tr = traced(True, tail_ms=-1.0)  # tracing on, tail sampling off
+    d = 8
+    cp = CompiledPipeline(_head(d=d), max_batch=32, devices=2).warmup((d,))
+    errs: list = []
+
+    def client(cid, svc):
+        try:
+            for _ in range(8):
+                x = rng.normal(size=(3, d)).astype(np.float32)
+                svc.submit(x).result(timeout=30)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    with PipelineService(cp, max_delay_ms=0.5, inflight=2) as svc:
+        threads = [
+            threading.Thread(target=client, args=(k, svc)) for k in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errs
+    spans = tr.spans()
+    ok = [
+        s for s in spans
+        if s["name"] == "serve.request" and s["args"].get("outcome") == "ok"
+    ]
+    assert len(ok) == 32
+    rids = {s["args"]["req_id"] for s in ok}
+    assert len(rids) == 32  # unique ids, threaded to the resolution span
+    queued_ids = {
+        s["args"]["req_id"] for s in spans if s["name"] == "serve.queued"
+    }
+    assert rids <= queued_ids
+    device_ids = set()
+    for s in spans:
+        if s["name"] == "serve.device":
+            device_ids.update(s["args"].get("req_ids", ()))
+    assert rids <= device_ids  # the cross-thread link is complete
+    # Per-request reassembly covers the whole queued→device→resolved
+    # journey across >= 2 threads.
+    rid = next(iter(rids))
+    journey = tr.spans_for_request(rid)
+    names = {s["name"] for s in journey}
+    assert {"serve.queued", "serve.device", "serve.request"} <= names
+    assert len({s["tid"] for s in journey}) >= 2
+
+
+def test_tail_sampling_retains_only_breaching_requests(rng, traced):
+    d = 8
+    cp = CompiledPipeline(_head(d=d), max_batch=16, devices=1).warmup((d,))
+
+    def serve(n, svc):
+        for _ in range(n):
+            svc.submit(
+                rng.normal(size=(2, d)).astype(np.float32)
+            ).result(timeout=30)
+
+    # Threshold far above any latency: nothing retained.
+    tr = traced(True, tail_ms=60_000.0)
+    with PipelineService(cp, max_delay_ms=0.5, inflight=1) as svc:
+        serve(6, svc)
+    assert tr.retained() == {}
+    # Threshold below every latency: every request retained, and the
+    # export carries the span trees under tailSampled.
+    tr = traced(True, tail_ms=1e-6)
+    with PipelineService(cp, max_delay_ms=0.5, inflight=1) as svc:
+        serve(6, svc)
+    kept = tr.retained()
+    assert len(kept) == 6
+    for rid, spans in kept.items():
+        assert any(
+            s["name"] == "serve.request" and s["args"]["req_id"] == rid
+            for s in spans
+        )
+    doc = tr.export()
+    assert set(doc["tailSampled"]) == {str(rid) for rid in kept}
+    # Negative disables retention even for slow requests.
+    tr = traced(True, tail_ms=-1.0)
+    with PipelineService(cp, max_delay_ms=0.5, inflight=1) as svc:
+        serve(3, svc)
+    assert tr.retained() == {}
+
+
+def test_auto_tail_threshold_needs_samples_then_tracks_p99(rng, traced):
+    """tail_ms=0 (auto) resolves the threshold from the service's
+    always-on e2e histogram: inert below TAIL_MIN_COUNT samples, ~p99
+    above it."""
+    from keystone_tpu.workflow.serving import TAIL_MIN_COUNT
+
+    d = 8
+    cp = CompiledPipeline(_head(d=d), max_batch=16, devices=1).warmup((d,))
+    tr = traced(True, tail_ms=0.0)
+    with PipelineService(cp, max_delay_ms=0.2, inflight=1) as svc:
+        for _ in range(TAIL_MIN_COUNT - 2):
+            svc.submit(
+                rng.normal(size=(2, d)).astype(np.float32)
+            ).result(timeout=30)
+        assert tr.retained() == {}  # below the sample floor: inert
+        for _ in range(3 * TAIL_MIN_COUNT):
+            svc.submit(
+                rng.normal(size=(2, d)).astype(np.float32)
+            ).result(timeout=30)
+        n_ok = svc.stats()["outcomes"]["ok"]
+    kept = tr.retained()
+    # Running p99: only the tail is retained — never the bulk.
+    assert len(kept) < n_ok / 4
+
+
+# ---------------------------------------------------------------------------
+# Export surface: prometheus server + trace_report
+# ---------------------------------------------------------------------------
+
+
+def test_obs_serve_smoke_inprocess():
+    """The tier-1 stand-in for `make obs-serve`: live service, real
+    socket, validated exposition, scrape-vs-snapshot agreement, healthz
+    flip on close."""
+    server_mod = _load_tool("metrics_server")
+    result = server_mod.run_smoke(port=0, requests=12)
+    assert result["pass"]["metrics_200"] is True
+    assert result["pass"]["prometheus_valid"] is True
+    assert result["pass"]["scrape_agrees_with_snapshot"] is True
+    assert result["pass"]["healthz_200_while_open"] is True
+    assert result["pass"]["healthz_503_after_close"] is True
+    assert result["ok"] is True
+
+
+def test_metrics_server_unknown_path_404():
+    server_mod = _load_tool("metrics_server")
+    with server_mod.MetricsServer(port=0) as server:
+        status, _ = server_mod._fetch(server.url("/nope"))
+        assert status == 404
+        status, body = server_mod._fetch(server.url("/healthz"))
+        assert status == 200  # no health source = process liveness
+        assert json.loads(body)["healthy"] is True
+
+
+def test_trace_report_rejects_empty_trace(tmp_path, capsys):
+    report = _load_tool("trace_report")
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"traceEvents": []}))
+    rc = report.main([str(empty)])
+    assert rc == 1
+    assert "zero spans" in capsys.readouterr().err
+    # Metadata-only (no X spans) is just as dead.
+    meta_only = tmp_path / "meta.json"
+    meta_only.write_text(json.dumps({
+        "traceEvents": [
+            {"name": "thread_name", "ph": "M", "pid": 1, "tid": 1,
+             "args": {"name": "t"}}
+        ]
+    }))
+    assert report.main([str(meta_only), "--validate-only"]) == 1
+
+
+def test_trace_report_request_critical_path(rng, traced, tmp_path, capsys):
+    tr = traced(True, tail_ms=1e-6)  # retain everything: ids survive
+    d = 8
+    cp = CompiledPipeline(_head(d=d), max_batch=16, devices=2).warmup((d,))
+    with PipelineService(cp, max_delay_ms=0.5, inflight=2) as svc:
+        for _ in range(6):
+            svc.submit(
+                rng.normal(size=(2, d)).astype(np.float32)
+            ).result(timeout=30)
+    path = str(tmp_path / "trace.json")
+    tr.export(path)
+    ok_ids = sorted(
+        s["args"]["req_id"] for s in tr.spans()
+        if s["name"] == "serve.request" and s["args"].get("outcome") == "ok"
+    )
+    report = _load_tool("trace_report")
+    rc = report.main([path, "--request", str(ok_ids[0])])
+    out = capsys.readouterr()
+    assert rc == 0
+    rep = json.loads(out.out)
+    assert rep["request"] == ok_ids[0]
+    assert rep["outcome"] == "ok"
+    assert rep["phases"]["e2e_ms"] > 0
+    assert rep["phases"]["device_ms"] > 0
+    assert rep["phases"]["queue_wait_ms"] >= 0
+    names = {s["name"] for s in rep["spans"]}
+    assert {"serve.queued", "serve.device", "serve.request"} <= names
+    # Unknown id fails loudly.
+    rc = report.main([path, "--request", "999999999"])
+    assert rc == 1
+    assert "NOT FOUND" in capsys.readouterr().err
+
+
+def test_engine_direct_calls_mint_ids(rng, traced):
+    """CompiledPipeline.__call__ (no service) mints a monotonic id per
+    batch and tags its serve.device spans with it."""
+    tr = traced(True, tail_ms=-1.0)
+    d = 8
+    cp = CompiledPipeline(_head(d=d), max_batch=16, devices=1).warmup((d,))
+    a = next_request_id()
+    cp(rng.normal(size=(4, d)).astype(np.float32))
+    cp(rng.normal(size=(4, d)).astype(np.float32))
+    b = next_request_id()
+    assert b >= a + 3  # two engine calls minted ids in between
+    device = [s for s in tr.spans() if s["name"] == "serve.device"]
+    assert len(device) == 2
+    ids = [s["args"]["req_ids"] for s in device]
+    assert all(len(i) == 1 for i in ids)
+    assert ids[0][0] < ids[1][0]  # monotonic across calls

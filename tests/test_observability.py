@@ -112,6 +112,28 @@ def test_histogram_extremes_clamp_not_crash():
     assert h.snapshot()["max_ms"] == pytest.approx(1e9)
 
 
+def test_histogram_nonpositive_samples_clamped_and_counted():
+    """The satellite guard: non-positive samples never reach the log
+    math — they clamp to the minimum bucket and show up as a
+    ``dropped_nonpositive`` count in the snapshot, so a clock that
+    misbehaves is visible instead of silently skewing the low tail."""
+    h = LatencyHistogram()
+    h.record(-3.0)
+    h.record(0.0)
+    h.record(0.01)
+    snap = h.snapshot()
+    assert snap["count"] == 3
+    assert snap["dropped_nonpositive"] == 2
+    assert snap["min_ms"] == pytest.approx(1e-3)  # clamped to min bucket
+    assert h.percentile(50) is not None
+    dist = h.buckets()
+    assert dist["dropped_nonpositive"] == 2
+    assert dist["buckets"][0][0] == pytest.approx(1e-6)
+    assert dist["buckets"][-1][1] == 3  # cumulative reaches the count
+    h.reset()
+    assert "dropped_nonpositive" not in h.snapshot()  # zero = absent
+
+
 def test_histogram_empty_and_reset():
     h = LatencyHistogram()
     assert h.percentile(99) is None
@@ -352,6 +374,189 @@ def test_registry_reset_resets_every_component():
     snap = metrics_registry.snapshot()
     assert snap["test.reset_probe"] == {"count": 0}
     assert snap["serving"]["calls"] == 0
+
+
+def test_registry_snapshot_under_concurrent_writers():
+    """The satellite gate: 4 writer threads hammer counters, histograms,
+    and a gauge while a reader snapshots in a loop — no exceptions, no
+    torn reads, and every successive counter view is monotone."""
+    counters = metrics_registry.counters("test.concurrent_counts")
+    hist = metrics_registry.histogram("test.concurrent_lat")
+    gauge = metrics_registry.gauge("test.concurrent_depth")
+    counters.reset()
+    hist.reset()
+    gauge.reset()
+    n_threads, per = 4, 3000
+    stop = threading.Event()
+    errs: list = []
+
+    def writer(tid):
+        try:
+            for i in range(per):
+                counters.bump("total")
+                counters.bump(f"w{tid}")
+                hist.record(1e-4 * (1 + (i % 7)))
+                gauge.set(i)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    views: list = []
+
+    def reader():
+        try:
+            while not stop.is_set():
+                snap = metrics_registry.snapshot()
+                views.append(snap["test.concurrent_counts"].get("total", 0))
+                assert snap["test.concurrent_lat"]["count"] >= 0
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    rt = threading.Thread(target=reader)
+    rt.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    rt.join()
+    assert not errs, errs[:2]
+    # Monotone counter views: no snapshot ever ran backwards.
+    assert all(a <= b for a, b in zip(views, views[1:]))
+    snap = metrics_registry.snapshot()
+    assert snap["test.concurrent_counts"]["total"] == n_threads * per
+    assert all(
+        snap["test.concurrent_counts"][f"w{t}"] == per
+        for t in range(n_threads)
+    )
+    assert snap["test.concurrent_lat"]["count"] == n_threads * per
+    counters.reset()
+    hist.reset()
+    gauge.reset()
+
+
+def test_prometheus_exposition_valid_and_agrees_with_snapshot():
+    """The export-surface gate, registry-side: ``prometheus()`` parses
+    under the shared validator, carries instance labels, and its sample
+    values agree with ``snapshot()``."""
+    from keystone_tpu.utils.metrics import (
+        parse_prometheus_text,
+        validate_prometheus_text,
+    )
+
+    h = metrics_registry.histogram("test.prom_lat")
+    h.reset()
+    for v in (0.001, 0.002, 0.004, 0.5):
+        h.record(v)
+    g = metrics_registry.gauge("test.prom_depth[inst0]")
+    g.set(7)
+    c = metrics_registry.counters("test.prom_counts[inst0]")
+    c.reset()
+    c.bump("ok", 3)
+    c.bump("error")
+    text = metrics_registry.prometheus()
+    assert validate_prometheus_text(text) == []
+    samples = {
+        (s["name"], tuple(sorted(s["labels"].items()))): s["value"]
+        for s in parse_prometheus_text(text)
+    }
+    assert samples[("keystone_test_prom_lat_seconds_count", ())] == 4
+    assert samples[
+        ("keystone_test_prom_lat_seconds_sum", ())
+    ] == pytest.approx(0.507)
+    assert samples[
+        ("keystone_test_prom_depth", (("instance", "inst0"),))
+    ] == 7
+    assert samples[
+        ("keystone_test_prom_counts_total",
+         (("instance", "inst0"), ("key", "ok")))
+    ] == 3
+    assert samples[
+        ("keystone_test_prom_counts_total",
+         (("instance", "inst0"), ("key", "error")))
+    ] == 1
+    # Quantiles ride along as gauges in seconds.
+    q99 = samples[
+        ("keystone_test_prom_lat_quantile_seconds", (("quantile", "0.99"),))
+    ]
+    assert q99 == pytest.approx(h.snapshot()["p99_ms"] / 1e3)
+    # The serving counter component flattens with its bucket maps.
+    serving_counters.record_call(8, 5)
+    text = metrics_registry.prometheus()
+    assert validate_prometheus_text(text) == []
+    bucket_hits = [
+        s for s in parse_prometheus_text(text)
+        if s["name"] == "keystone_serving_bucket_hits"
+    ]
+    assert any(
+        s["labels"].get("key") == "8" and s["value"] >= 1
+        for s in bucket_hits
+    )
+    serving_counters.reset()
+    h.reset()
+    g.reset()
+    c.reset()
+
+
+def test_prometheus_label_escaping_round_trips():
+    """Escape decoding is single-pass: a label value with a literal
+    backslash before an 'n' must round-trip, not decode the tail of the
+    escaped backslash as a newline escape."""
+    from keystone_tpu.utils.metrics import (
+        _prom_labels,
+        parse_prometheus_text,
+    )
+
+    for value in ("dir\\name", 'quo"te', "line\nbreak", "\\\\n", "plain"):
+        line = f"m{_prom_labels({'k': value})} 1\n"
+        (sample,) = parse_prometheus_text(line)
+        assert sample["labels"]["k"] == value, (value, sample)
+
+
+def test_retain_request_since_bound_keeps_journey_drops_scan():
+    """The bounded tail-sampling scan: spans recorded before the request
+    existed are skipped via early exit, spans of its journey are kept."""
+    tr = Tracer(256)
+    for i in range(50):  # old unrelated traffic, ends well before t_sub
+        tr.instant(f"old{i}", "t", req_id=999)
+    import time as _t
+
+    _t.sleep(0.02)  # clear the scan slack so the cutoff really binds
+    t_sub = Tracer.now()
+    tr.record("serve.queued", "serving", t_sub, req_id=7)
+    tr.record("serve.device", "serving", t_sub, req_ids=[7, 8])
+    tr.record("serve.request", "serving", t_sub, req_id=7, outcome="ok")
+    n = tr.retain_request(7, since_ns=t_sub)
+    assert n == 3
+    kept = tr.retained()[7]
+    assert [s["name"] for s in kept] == [
+        "serve.queued", "serve.device", "serve.request",
+    ]
+    # Without since_ns the full ring scan finds the same spans.
+    tr2 = Tracer(256)
+    tr2.record("serve.request", "serving", Tracer.now(), req_id=3)
+    assert tr2.retain_request(3) == 1
+
+
+def test_validate_prometheus_rejects_malformed():
+    from keystone_tpu.utils.metrics import validate_prometheus_text
+
+    assert validate_prometheus_text("not a metric line\n") != []
+    assert validate_prometheus_text('x{key=unquoted} 1\n') != []
+    assert validate_prometheus_text("x 1e999e9\n") != []
+    assert validate_prometheus_text("# TYPE x wrongtype\nx 1\n") != []
+    # Histogram discipline: buckets must be cumulative and +Inf-capped.
+    bad = (
+        "# TYPE h histogram\n"
+        'h_bucket{le="0.1"} 5\nh_bucket{le="+Inf"} 3\nh_count 3\n'
+    )
+    assert any("cumulative" in e for e in validate_prometheus_text(bad))
+    no_inf = "# TYPE h histogram\n" 'h_bucket{le="0.1"} 5\n'
+    assert any("+Inf" in e for e in validate_prometheus_text(no_inf))
+    # A validator reports, never raises — even on a non-numeric le.
+    bad_le = "# TYPE h histogram\n" 'h_bucket{le="abc"} 3\n'
+    assert any("non-numeric le" in e for e in validate_prometheus_text(bad_le))
 
 
 def test_record_compile_attributes_bucket():

@@ -70,6 +70,15 @@ ENV_ALLOWED = {"keystone_tpu/config.py"}
 #: them may block on a device transfer.
 DISPATCH_METHODS = {"submit", "_loop", "_dispatch", "_pick_slot_locked",
                     "_ensure_worker_locked"}
+
+#: Method names that are ALWAYS treated as thread-entry roots for the
+#: concurrency rules, even when no ``Thread(target=self.X)`` spawn is
+#: statically visible in the class (spawned via a helper, a registry, or
+#: a future refactor). The observability threads are registered here by
+#: name so lock discipline covers them from day one — a watchdog that
+#: mutates service state outside the lock must be a finding, not a blind
+#: spot behind an indirect spawn.
+KNOWN_THREAD_TARGETS = {"_watchdog_loop", "_watch_loop"}
 HOST_SYNC_CALLS = {"block_until_ready", "device_get", "asarray", "array"}
 
 #: Mutating method names treated as writes for KL001 (deque/list/set/dict
@@ -343,6 +352,12 @@ def _check_class(cls: ast.ClassDef, path: str, lines: List[str],
     thread_targets = set().union(
         *(m.thread_targets for m in methods.values())
     ) & set(methods)
+    # Registered roots: these method names are thread targets by
+    # contract even when the spawn isn't statically visible here.
+    known = KNOWN_THREAD_TARGETS & set(methods)
+    if known:
+        thread_targets |= known
+        spawns = True
     if not lock_attrs and not spawns:
         return  # plain class: no concurrency contract to check
 

@@ -1,0 +1,304 @@
+"""Pull-based metrics/health export: /metrics + /healthz over HTTP.
+
+The PR-4 registry made every process metric readable — but only
+in-process. This is the scrape surface: a stdlib-only (http.server)
+threaded HTTP server exposing
+
+- ``/metrics`` — ``MetricsRegistry.prometheus()`` text exposition
+  (counters, gauges, histogram buckets + percentiles, with the PR-5
+  per-instance namespacing as an ``instance`` label), scrapeable by any
+  Prometheus-compatible collector;
+- ``/healthz`` — JSON health backed by ``PipelineService.stats()``:
+  HTTP 200 while the dispatcher is alive and the service is open, 503
+  once the worker died or the service closed — the load-balancer /
+  kubelet probe shape.
+
+Port comes from ``KEYSTONE_METRICS_PORT`` (``config.metrics_port``);
+0 binds an ephemeral port (the smoke default — the chosen port is
+reported). The server binds 127.0.0.1: this is an export surface for a
+local scraper sidecar, not an authenticated public endpoint.
+
+Usage:
+    python tools/metrics_server.py            # smoke: serve, scrape,
+                                              # validate, report, exit
+    python tools/metrics_server.py --serve    # serve a demo service until
+                                              # interrupted
+    python tools/metrics_server.py --serve --port 9090
+
+The smoke mode is ``make obs-serve`` and runs in-process under tier-1
+(tests/test_flight_recorder.py): it stands up a real warmed service,
+submits traffic, fetches both endpoints over an actual socket, validates
+the Prometheus text against the shared ``validate_prometheus_text``
+oracle, and cross-checks the scraped counts against
+``metrics_registry.snapshot()`` — then closes the service and asserts
+/healthz flips to 503.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Routes /metrics and /healthz to the owning MetricsServer."""
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        owner: "MetricsServer" = self.server.owner  # type: ignore[attr-defined]
+        if self.path.split("?")[0] == "/metrics":
+            body = owner.render_metrics().encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if self.path.split("?")[0] == "/healthz":
+            healthy, doc = owner.health()
+            body = json.dumps(doc).encode()
+            self.send_response(200 if healthy else 503)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        self.send_response(404)
+        self.end_headers()
+
+    def log_message(self, fmt, *args):  # quiet: scrapes are periodic
+        pass
+
+
+class MetricsServer:
+    """The /metrics + /healthz HTTP endpoint over the process registry.
+
+    ``health_source`` is a zero-arg callable returning a stats dict
+    (canonically ``PipelineService.stats``); health is derived from its
+    ``worker_alive``/``closed`` keys. Without a source, /healthz reports
+    healthy process liveness only."""
+
+    def __init__(
+        self,
+        port: Optional[int] = None,
+        health_source: Optional[Callable[[], dict]] = None,
+        registry=None,
+    ):
+        from keystone_tpu.config import config
+        from keystone_tpu.utils.metrics import metrics_registry
+
+        self.requested_port = (
+            config.metrics_port if port is None else int(port)
+        )
+        self.health_source = health_source
+        self.registry = registry if registry is not None else metrics_registry
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+
+    def render_metrics(self) -> str:
+        return self.registry.prometheus()
+
+    def health(self):
+        """(healthy, body) for /healthz. Never raises: a health endpoint
+        that 500s on a half-closed service defeats its purpose."""
+        if self.health_source is None:
+            return True, {"healthy": True}
+        try:
+            stats = self.health_source()
+        except Exception as e:  # lint: broad-ok probe must report, not raise
+            return False, {"healthy": False, "error": str(e)[:200]}
+        healthy = bool(stats.get("worker_alive", True)) and not bool(
+            stats.get("closed", False)
+        )
+        return healthy, {"healthy": healthy, "stats": stats}
+
+    def start(self) -> "MetricsServer":
+        """Bind (ephemeral port when requested_port=0) and serve on a
+        daemon thread; ``self.port`` is the actual bound port."""
+        self._httpd = ThreadingHTTPServer(
+            ("127.0.0.1", self.requested_port), _Handler
+        )
+        self._httpd.owner = self  # type: ignore[attr-defined]
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="keystone-metrics-server", daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def url(self, path: str) -> str:
+        return f"http://127.0.0.1:{self.port}{path}"
+
+    def __enter__(self) -> "MetricsServer":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def _fetch(url: str):
+    """GET url; returns (status, body string). stdlib only."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def run_smoke(port: Optional[int] = None, requests: int = 24) -> dict:
+    """The ``make obs-serve`` flow: a live warmed service + metrics
+    server, both endpoints fetched over a real socket and validated.
+    Returns the verdict dict (``ok`` plus every gate)."""
+    import numpy as np
+
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+    from keystone_tpu.nodes.stats.random_features import CosineRandomFeatures
+    from keystone_tpu.utils.metrics import (
+        metrics_registry,
+        parse_prometheus_text,
+        validate_prometheus_text,
+    )
+    from keystone_tpu.workflow.pipeline import FusedTransformer
+    from keystone_tpu.workflow.serving import CompiledPipeline, PipelineService
+
+    d = 16
+    chain = FusedTransformer(
+        [CosineRandomFeatures.create(d, 64, seed=0), L2Normalizer()]
+    )
+    cp = CompiledPipeline(chain, max_batch=16, devices=1).warmup((d,))
+    rng = np.random.default_rng(0)
+    svc = PipelineService(cp, max_delay_ms=1.0)
+    server = MetricsServer(port=port, health_source=svc.stats).start()
+    try:
+        futs = [
+            svc.submit(rng.normal(size=(d,)).astype(np.float32))
+            for _ in range(requests)
+        ]
+        for f in futs:
+            f.result(timeout=60)
+        # Outcome counters are bumped AFTER the future resolves (the
+        # completer's tail); settle before scraping so the agreement
+        # gate compares two reads of the same final state instead of
+        # racing the last bump.
+        import time
+
+        deadline = time.monotonic() + 10
+        counters = metrics_registry.counters(f"serve.requests[{svc.name}]")
+        while (
+            counters.get("ok") < requests and time.monotonic() < deadline
+        ):
+            time.sleep(0.005)
+
+        m_status, m_body = _fetch(server.url("/metrics"))
+        prom_errors = validate_prometheus_text(m_body)
+        # Scrape-vs-snapshot agreement: the ok-outcome count for THIS
+        # service, read both ways.
+        snap = metrics_registry.snapshot()
+        ok_snap = snap[f"serve.requests[{svc.name}]"].get("ok", 0)
+        ok_scraped = sum(
+            s["value"] for s in parse_prometheus_text(m_body)
+            if s["name"] == "keystone_serve_requests_total"
+            and s["labels"].get("instance") == svc.name
+            and s["labels"].get("key") == "ok"
+        )
+        h_status, h_body = _fetch(server.url("/healthz"))
+        health = json.loads(h_body)
+        svc.close()
+        h2_status, h2_body = _fetch(server.url("/healthz"))
+        health_closed = json.loads(h2_body)
+        result = {
+            "metric": "obs_serve_smoke",
+            "port": server.port,
+            "requests": requests,
+            "metrics_status": m_status,
+            "metrics_bytes": len(m_body),
+            "prometheus_errors": prom_errors[:10],
+            "ok_count_scraped": ok_scraped,
+            "ok_count_snapshot": ok_snap,
+            "healthz_status": h_status,
+            "healthz_closed_status": h2_status,
+            "pass": {
+                "metrics_200": m_status == 200,
+                "prometheus_valid": not prom_errors,
+                "scrape_agrees_with_snapshot": ok_scraped == ok_snap
+                and ok_snap >= requests,
+                "healthz_200_while_open": h_status == 200
+                and health.get("healthy") is True,
+                "healthz_503_after_close": h2_status == 503
+                and health_closed.get("healthy") is False,
+            },
+        }
+        result["ok"] = all(result["pass"].values())
+        return result
+    finally:
+        server.stop()
+        svc.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=None,
+                    help="bind port (default KEYSTONE_METRICS_PORT; "
+                         "0 = ephemeral)")
+    ap.add_argument("--serve", action="store_true",
+                    help="serve a demo service until interrupted instead "
+                         "of running the smoke check")
+    ap.add_argument("--requests", type=int, default=24,
+                    help="smoke-mode request count")
+    args = ap.parse_args(argv)
+
+    if not args.serve:
+        result = run_smoke(port=args.port, requests=args.requests)
+        print(json.dumps(result))
+        if result["ok"]:
+            print("obs-serve smoke: PASS", file=sys.stderr)
+        return 0 if result["ok"] else 1
+
+    import numpy as np
+
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+    from keystone_tpu.workflow.serving import CompiledPipeline, PipelineService
+
+    cp = CompiledPipeline(L2Normalizer(), max_batch=16, devices=1)
+    cp.warmup((8,))
+    svc = PipelineService(cp, max_delay_ms=1.0)
+    svc.submit(np.ones(8, np.float32)).result(timeout=30)
+    with MetricsServer(port=args.port, health_source=svc.stats) as server:
+        print(f"serving {server.url('/metrics')} and "
+              f"{server.url('/healthz')} — Ctrl-C to stop", file=sys.stderr)
+        try:
+            threading.Event().wait()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            svc.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

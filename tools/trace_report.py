@@ -7,10 +7,24 @@ a per-(category, name) aggregate table: span count, total/mean/max
 duration. The file itself opens directly in Perfetto
 (https://ui.perfetto.dev) or chrome://tracing for the timeline view.
 
+A schema-valid trace with ZERO spans is treated as an ERROR, not an empty
+table: it means the tracer was disabled (or never recorded), and a tool
+that prints a clean empty summary over a dead tracer is a false green.
+
+``--request <id>`` switches to the per-request critical-path view: every
+span carrying that request id (``req_id`` on single-request spans,
+membership in ``req_ids`` on group spans — serve.flush / serve.device),
+including span trees tail-sampled into the export's ``tailSampled``
+store after the ring churned past them, broken down into the journey's
+phases: queue wait (submit → flush-group pop), device time (launch →
+materialized), and the resolve tail.
+
 Usage:
     python tools/trace_report.py TRACE.json [--validate-only] [--top N]
+        [--request ID]
 
-Exit status: 0 = valid trace, 1 = schema problems (listed on stderr).
+Exit status: 0 = valid trace, 1 = schema problems / zero spans / unknown
+request id (listed on stderr).
 """
 
 from __future__ import annotations
@@ -50,6 +64,81 @@ def summarize(doc: dict) -> dict:
     }
 
 
+def _mentions(ev: dict, rid: int) -> bool:
+    args = ev.get("args") or {}
+    return args.get("req_id") == rid or rid in (args.get("req_ids") or ())
+
+
+def request_events(doc: dict, rid: int) -> list:
+    """Every X event referencing request ``rid`` — from the live ring
+    (traceEvents) plus the tail-sampled store — deduped and time-ordered."""
+    events = [
+        ev for ev in doc.get("traceEvents", [])
+        if ev.get("ph") == "X" and _mentions(ev, rid)
+    ]
+    seen = {(ev["name"], ev.get("ts")) for ev in events}
+    for ev in doc.get("tailSampled", {}).get(str(rid), []):
+        if ev.get("ph") == "X" and (ev["name"], ev.get("ts")) not in seen:
+            events.append(ev)
+    events.sort(key=lambda ev: ev.get("ts", 0.0))
+    return events
+
+
+def request_report(doc: dict, rid: int) -> dict:
+    """The critical-path breakdown of one request's journey: where its
+    end-to-end latency went, phase by phase. Durations in milliseconds;
+    ``resolve_ms`` is the tail between the device result materializing
+    and the future resolving (slice + deliver + histogram work)."""
+    events = request_events(doc, rid)
+    by_name: dict = {}
+    for ev in events:
+        by_name.setdefault(ev["name"], []).append(ev)
+
+    def total_ms(name):
+        return sum(float(e.get("dur", 0.0)) for e in by_name.get(name, [])) / 1e3
+
+    # serve.queued spans all start at the SUBMIT timestamp — a
+    # re-dispatched request (replica death) gets one per flush-group pop,
+    # and the intervals overlap. Real queue residency is the longest one
+    # (submit -> final pop), not their sum.
+    queued_ms = max(
+        (float(e.get("dur", 0.0)) for e in by_name.get("serve.queued", [])),
+        default=0.0,
+    ) / 1e3
+    device_ms = total_ms("serve.device")
+    flush_ms = total_ms("serve.flush")
+    req_spans = by_name.get("serve.request", [])
+    e2e_ms = total_ms("serve.request")
+    outcome = None
+    for ev in req_spans:
+        outcome = (ev.get("args") or {}).get("outcome", outcome)
+    phases = {
+        "queue_wait_ms": round(queued_ms, 4),
+        "device_ms": round(device_ms, 4),
+        "flush_ms": round(flush_ms, 4),
+        "e2e_ms": round(e2e_ms, 4),
+    }
+    if e2e_ms:
+        phases["resolve_tail_ms"] = round(
+            max(0.0, e2e_ms - queued_ms - device_ms), 4
+        )
+    return {
+        "request": rid,
+        "outcome": outcome,
+        "phases": phases,
+        "spans": [
+            {
+                "name": ev["name"],
+                "ts_ms": round(float(ev.get("ts", 0.0)) / 1e3, 4),
+                "dur_ms": round(float(ev.get("dur", 0.0)) / 1e3, 4),
+                "thread": ev.get("tid"),
+                "args": ev.get("args") or {},
+            }
+            for ev in events
+        ],
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("trace", help="Chrome-trace JSON file (Tracer.export)")
@@ -57,6 +146,9 @@ def main(argv=None) -> int:
                     help="schema check only, no summary table")
     ap.add_argument("--top", type=int, default=0,
                     help="only the N rows with the largest total time")
+    ap.add_argument("--request", type=int, default=None, metavar="ID",
+                    help="critical-path view of one request id instead of "
+                         "the aggregate table")
     args = ap.parse_args(argv)
 
     from keystone_tpu.utils.metrics import validate_chrome_trace
@@ -70,11 +162,50 @@ def main(argv=None) -> int:
         if len(errors) > 20:
             print(f"... and {len(errors) - 20} more", file=sys.stderr)
         return 1
+    n_spans = sum(
+        1 for ev in doc.get("traceEvents", []) if ev.get("ph") == "X"
+    )
+    if n_spans == 0:
+        # A dead tracer must fail loudly, not produce a green empty table.
+        print(
+            f"EMPTY: {args.trace} is schema-valid but contains zero spans "
+            "— was KEYSTONE_TRACE=1 set for the traced run?",
+            file=sys.stderr,
+        )
+        return 1
     if args.validate_only:
         print(json.dumps({
             "trace": args.trace, "valid": True,
             "events": len(doc["traceEvents"]),
         }))
+        return 0
+
+    if args.request is not None:
+        rep = request_report(doc, args.request)
+        if not rep["spans"]:
+            print(
+                f"NOT FOUND: no spans reference request id {args.request} "
+                "(the ring may have churned past it and it was not "
+                "tail-sampled)",
+                file=sys.stderr,
+            )
+            return 1
+        print(json.dumps(rep))
+        ph = rep["phases"]
+        print(
+            f"\nrequest {args.request}  outcome={rep['outcome']}",
+            file=sys.stderr,
+        )
+        for key in ("queue_wait_ms", "device_ms", "flush_ms",
+                    "resolve_tail_ms", "e2e_ms"):
+            if key in ph:
+                print(f"  {key:<16} {ph[key]:>10.4f}", file=sys.stderr)
+        w = max(len(s["name"]) for s in rep["spans"])
+        print(f"\n{'span':<{w}}  {'ts ms':>10}  {'dur ms':>9}  thread",
+              file=sys.stderr)
+        for s in rep["spans"]:
+            print(f"{s['name']:<{w}}  {s['ts_ms']:>10.4f}  "
+                  f"{s['dur_ms']:>9.4f}  {s['thread']}", file=sys.stderr)
         return 0
 
     rows = summarize(doc)
