@@ -1,6 +1,6 @@
 # Developer entry points. Pipelines launch via bin/run-pipeline.sh.
 
-.PHONY: test t1 chaos native bench bench-serve bench-serve-overload bench-serve-replicas trace-demo obs-serve lint dryrun clean tpu-checkride sentinel northstar acceptance
+.PHONY: test t1 chaos native bench bench-serve bench-serve-overload bench-serve-replicas trace-demo obs-serve profile-demo bench-watch lint dryrun clean tpu-checkride sentinel northstar acceptance
 
 # The canonical tier-1 verify (ROADMAP.md), verbatim at the defaults —
 # builders and CI invoke this one entry point instead of hand-copying the
@@ -90,6 +90,23 @@ trace-demo:
 # (tests/test_flight_recorder.py).
 obs-serve:
 	JAX_PLATFORMS=cpu python tools/metrics_server.py
+
+# Training-side profiling smoke: a small fit + apply under the resource
+# profiler — every executed node must get an attribution row with
+# nonzero wall time, the solve node's cost-model FLOPs must land within
+# 2x of the achieved_tflops oracle, KEYSTONE_PROFILE=0 outputs must be
+# bit-identical to profiled ones, and a kill-mid-solve chaos run must
+# auto-dump a flight-recorder journey naming the last completed chunk.
+# Tier-1 runs the same demo in-process (tests/test_profile.py).
+profile-demo:
+	JAX_PLATFORMS=cpu python tools/profile_report.py --demo
+
+# Bench regression sentinel: parse every BENCH_*/MULTICHIP_*/BENCH_serve
+# history row, fit per-metric noise bands from fingerprint-compatible
+# runs, exit nonzero naming any metric whose latest row regresses.
+# Tier-1 runs the same gate in-process (tests/test_bench_watch.py).
+bench-watch:
+	python tools/bench_watch.py
 
 # Static analysis, both layers, against the checked-in expectations:
 # keystone_lint.py (stdlib-ast invariant checker: lock discipline,

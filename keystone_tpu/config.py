@@ -339,6 +339,36 @@ class Config:
     metrics_port: int = field(
         default_factory=lambda: _env_int("KEYSTONE_METRICS_PORT", 0)
     )
+    # Per-node resource attribution (utils/metrics.py ResourceProfile):
+    # when on, every executor walk records wall time, device wait,
+    # cost-model FLOPs/bytes (one AOT lower+compile per executable,
+    # memoized), output nbytes, and the HBM high-water delta per pipeline
+    # node into the process-wide profile (registry name "profile",
+    # exported over /metrics). Off by default: call sites resolve
+    # ``active_profile()`` ONCE per execution walk — the
+    # ``active_plan()`` discipline — so the disabled profiler is a None
+    # check. ``Pipeline.fit(profile=True)`` forces it for one fit.
+    # Env: KEYSTONE_PROFILE.
+    profile: bool = field(default_factory=lambda: env_flag("KEYSTONE_PROFILE"))
+    # Streaming-solve stall watchdog (utils/flight_recorder.py
+    # ProgressReporter): each streaming solve gets a watchdog thread that
+    # fires when no chunk/block completes for this many milliseconds —
+    # bumping the solver stall counters and dumping the solver flight
+    # recorder, so a dead producer mid-fit leaves forensics exactly like
+    # a dead serving worker. 0 disables the per-solve thread.
+    # Env: KEYSTONE_SOLVE_WATCHDOG_MS.
+    solve_watchdog_ms: float = field(
+        default_factory=lambda: _env_float("KEYSTONE_SOLVE_WATCHDOG_MS",
+                                           30000.0)
+    )
+    # Progress-event cadence for streaming solves: every K completed
+    # chunks/blocks appends one structured event (unit, rows/s, ETA,
+    # residual when cheap) to the solve's journey record. 1 = every
+    # unit; higher thins the bounded event ring for hour-scale solves.
+    # Env: KEYSTONE_SOLVE_PROGRESS_EVERY.
+    solve_progress_every: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_SOLVE_PROGRESS_EVERY", 1)
+    )
     # Pipeline-graph lint gate (workflow/analysis.py): run the static
     # graph linter before every fit()/compiled(). "off" (default) = never;
     # "warn" = log findings at their severity; "error" = additionally

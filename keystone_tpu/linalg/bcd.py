@@ -1043,46 +1043,60 @@ def block_coordinate_descent_streamed(
             return put_host(next(src))
         return put(i_next)
 
+    from keystone_tpu.utils.flight_recorder import ProgressReporter
+
+    # Always-on solve journey: block/epoch progress with a known total
+    # (so ETA is live), checkpoint age, stall watchdog; a death mid-epoch
+    # force-dumps the solver recorder naming the last completed block.
+    progress = ProgressReporter("bcd_streamed", total_units=total)
+    n_rows = int(A_host.shape[0])
     try:
-        next_buf = None if no_overlap else put_ahead(start_block)
-        consumed = 0
-        blocks_done = 0
-        for epoch in range(start_epoch, num_iters):
-            first_block = start_block if epoch == start_epoch else 0
-            for i in range(first_block, nb):
-                if no_overlap:
-                    cur = put(i)
-                    cur.block_until_ready()
-                else:
-                    cur = next_buf
-                    consumed += 1
-                    # Prefetch the next block while this one computes
-                    # (double buffering): H2D DMA overlaps the MXU work.
-                    if consumed < total:
-                        next_buf = put_ahead((i + 1) % nb)
-                was_cached = invs[i] is not None
-                t0 = tracer.now() if tracer is not None else 0
-                if invs[i] is None:
-                    R, W[i], invs[i] = first(cur, R, W[i], lam_arr, w_rows)
-                else:
-                    R, W[i] = cached(cur, invs[i], R, W[i], w_rows)
-                if throttle:
-                    R.block_until_ready()
-                if tracer is not None:
-                    # Dispatch time unless throttled (the block above makes
-                    # the CPU path synchronous anyway).
-                    tracer.record(
-                        "bcd.block_update", "solver", t0, epoch=epoch,
-                        block=i, cached_inverse=was_cached,
-                        async_dispatch=not throttle,
-                    )
-                blocks_done += 1
-                if ckpt_store is not None and blocks_done % every == 0:
-                    _bcd_ckpt_save(
-                        ckpt_store, fingerprint, epoch, i + 1, W, R, invs
-                    )
-            if checkpoint_dir is not None:
-                _save_epoch(checkpoint_dir, epoch + 1, W, R, fingerprint)
+        with progress:
+            next_buf = None if no_overlap else put_ahead(start_block)
+            consumed = 0
+            blocks_done = 0
+            for epoch in range(start_epoch, num_iters):
+                first_block = start_block if epoch == start_epoch else 0
+                for i in range(first_block, nb):
+                    if no_overlap:
+                        cur = put(i)
+                        cur.block_until_ready()
+                    else:
+                        cur = next_buf
+                        consumed += 1
+                        # Prefetch the next block while this one computes
+                        # (double buffering): H2D DMA overlaps the MXU
+                        # work.
+                        if consumed < total:
+                            next_buf = put_ahead((i + 1) % nb)
+                    was_cached = invs[i] is not None
+                    t0 = tracer.now() if tracer is not None else 0
+                    if invs[i] is None:
+                        R, W[i], invs[i] = first(
+                            cur, R, W[i], lam_arr, w_rows
+                        )
+                    else:
+                        R, W[i] = cached(cur, invs[i], R, W[i], w_rows)
+                    if throttle:
+                        R.block_until_ready()
+                    if tracer is not None:
+                        # Dispatch time unless throttled (the block above
+                        # makes the CPU path synchronous anyway).
+                        tracer.record(
+                            "bcd.block_update", "solver", t0, epoch=epoch,
+                            block=i, cached_inverse=was_cached,
+                            async_dispatch=not throttle,
+                        )
+                    blocks_done += 1
+                    progress.unit_done(rows=n_rows, epoch=epoch, block=i)
+                    if ckpt_store is not None and blocks_done % every == 0:
+                        _bcd_ckpt_save(
+                            ckpt_store, fingerprint, epoch, i + 1, W, R,
+                            invs,
+                        )
+                        progress.checkpoint()
+                if checkpoint_dir is not None:
+                    _save_epoch(checkpoint_dir, epoch + 1, W, R, fingerprint)
     finally:
         if src is not None:
             src.close()

@@ -268,18 +268,19 @@ class _StreamCheckpointer:
             jnp.asarray(self.atb_np, dtype=cdtype),
         )
 
-    def chunk_done(self, gram, atb) -> None:
+    def chunk_done(self, gram, atb) -> bool:
         """Count one accumulated chunk; snapshot at the cadence. The D2H
         fetch is the only sync this adds, once per K chunks; the atomic
         DiskCache rewrite means a kill mid-save leaves the previous
-        complete snapshot."""
+        complete snapshot. Returns True when a snapshot was written (the
+        progress journey stamps its checkpoint age from this)."""
         self.done += 1
         if (
             self.store is None
             or self.every <= 0
             or self.done % self.every != 0
         ):
-            return
+            return False
         import numpy as np
 
         from keystone_tpu.utils.metrics import reliability_counters
@@ -295,6 +296,7 @@ class _StreamCheckpointer:
             overwrite=True,
         )
         reliability_counters.bump("checkpoints_written")
+        return True
 
     def consume(self) -> None:
         """Delete the snapshot: it belongs to the solve that just
@@ -359,6 +361,7 @@ def solve_least_squares_chunked(
             batches, lam, refine_steps, checkpoint_dir, checkpoint_every
         )
 
+    from keystone_tpu.utils.flight_recorder import ProgressReporter
     from keystone_tpu.utils.metrics import active_tracer
 
     plan = active_plan()
@@ -371,7 +374,12 @@ def solve_least_squares_chunked(
     # and leave closing it to its owner.
     own = not isinstance(batches, PrefetchIterator)
     ctx = prefetched(iter(batches), depth) if own else nullcontext(batches)
-    with ctx as src:
+    # Always-on solve journey (utils/flight_recorder.ProgressReporter):
+    # chunk progress, rows/s, checkpoint age, stall watchdog; an
+    # exception anywhere in the solve force-dumps the solver recorder
+    # naming the last completed chunk.
+    progress = ProgressReporter("lsq_chunked")
+    with progress, ctx as src:
         it = iter(src)
         first = next(it, None)
         if first is None:
@@ -421,6 +429,7 @@ def solve_least_squares_chunked(
             # producer thread parses/featurizes ahead) and stages the next
             # chunk's transfer. An OOM-downshifted chunk accumulates its
             # halves in row order.
+            rows = sum(int(A.data.shape[0]) for A, _B in cur)
             if tracer is None:
                 for A, B in cur:
                     gram, atb = accum(gram, atb, A.data, B.data)
@@ -435,7 +444,10 @@ def solve_least_squares_chunked(
                     "solve.accum", "solver", t0,
                     chunk=ckpt.done, async_dispatch=True,
                 )
-            ckpt.chunk_done(gram, atb)
+            wrote = ckpt.chunk_done(gram, atb)
+            progress.unit_done(rows=rows, chunk=ckpt.done)
+            if wrote:
+                progress.checkpoint(ckpt.done)
             nxt = next(it, None)
             if nxt is None:
                 cur = None
@@ -443,8 +455,8 @@ def solve_least_squares_chunked(
                 cur = _put_chunks_resilient(nxt, plan, retry)
             else:
                 cur = _put_chunks_traced(nxt, plan, retry, tracer, ckpt.done)
-    ckpt.consume()
-    return _chol_solve_maybe_traced(tracer, gram, atb, lam, refine_steps)
+        ckpt.consume()
+        return _chol_solve_maybe_traced(tracer, gram, atb, lam, refine_steps)
 
 
 def _solve_chunked_sync(
@@ -463,6 +475,7 @@ def _solve_chunked_sync(
     what overlap (including plain async dispatch) buys. Never the right
     setting for real runs."""
     from keystone_tpu.config import env_flag
+    from keystone_tpu.utils.flight_recorder import ProgressReporter
     from keystone_tpu.utils.metrics import active_tracer
     from keystone_tpu.utils.reliability import RetryPolicy, active_plan
 
@@ -474,34 +487,47 @@ def _solve_chunked_sync(
     bound = False
     gram = None
     atb = None
-    for chunk in batches:
-        if not bound:
-            bound = True
-            if ckpt.store is not None:
-                if chunk[1] is None:
-                    raise ValueError("chunked solve needs labeled batches")
-                ckpt.resume(chunk)
-                gram, atb = ckpt.restored(jnp.dtype(config.accum_dtype))
-        if ckpt.skipping():
-            continue
-        if tracer is None:
-            pairs = _put_chunks_resilient(chunk, plan, retry)
-        else:
-            pairs = _put_chunks_traced(chunk, plan, retry, tracer, ckpt.done)
-            t0 = tracer.now()
-        for A, B in pairs:
-            g, ab = A.gram_and_atb(B)  # fused: one read of the chunk
-            if serialize:
-                jax.block_until_ready((g, ab))
-            gram = g if gram is None else gram + g
-            atb = ab if atb is None else atb + ab
-        if tracer is not None:
-            tracer.record(
-                "solve.accum", "solver", t0,
-                chunk=ckpt.done, async_dispatch=not serialize,
-            )
-        ckpt.chunk_done(gram, atb)
-    if gram is None:
-        raise ValueError("empty batch stream")
-    ckpt.consume()
-    return _chol_solve_maybe_traced(tracer, gram, atb, lam, refine_steps)
+    # Same always-on journey as the overlapped path: a death mid-stream
+    # dumps the solver recorder naming the last completed chunk.
+    progress = ProgressReporter("lsq_chunked")
+    with progress:
+        for chunk in batches:
+            if not bound:
+                bound = True
+                if ckpt.store is not None:
+                    if chunk[1] is None:
+                        raise ValueError(
+                            "chunked solve needs labeled batches"
+                        )
+                    ckpt.resume(chunk)
+                    gram, atb = ckpt.restored(jnp.dtype(config.accum_dtype))
+            if ckpt.skipping():
+                continue
+            if tracer is None:
+                pairs = _put_chunks_resilient(chunk, plan, retry)
+            else:
+                pairs = _put_chunks_traced(
+                    chunk, plan, retry, tracer, ckpt.done
+                )
+                t0 = tracer.now()
+            rows = 0
+            for A, B in pairs:
+                rows += int(A.data.shape[0])
+                g, ab = A.gram_and_atb(B)  # fused: one read of the chunk
+                if serialize:
+                    jax.block_until_ready((g, ab))
+                gram = g if gram is None else gram + g
+                atb = ab if atb is None else atb + ab
+            if tracer is not None:
+                tracer.record(
+                    "solve.accum", "solver", t0,
+                    chunk=ckpt.done, async_dispatch=not serialize,
+                )
+            wrote = ckpt.chunk_done(gram, atb)
+            progress.unit_done(rows=rows, chunk=ckpt.done)
+            if wrote:
+                progress.checkpoint(ckpt.done)
+        if gram is None:
+            raise ValueError("empty batch stream")
+        ckpt.consume()
+        return _chol_solve_maybe_traced(tracer, gram, atb, lam, refine_steps)

@@ -174,10 +174,16 @@ class FlightRecorder:
         ring holds the reference, so in-flight requests are visible to a
         dump exactly as far as they got."""
         rec = FlightRecord(rid, rows)
+        self.add(rec)
+        return rec
+
+    def add(self, rec) -> None:
+        """Enter an externally-built journey record (anything with an
+        ``as_dict()``) in the ring — solver journeys (``SolveRecord``)
+        ride the same ring/dump machinery as serving requests."""
         with self._lock:
             self._records.append(rec)
             self.records_started += 1
-        return rec
 
     def error(self, kind: str, message: str,
               rid: Optional[int] = None) -> None:
@@ -292,3 +298,346 @@ class FlightRecorder:
                 "dumps_total": self.dumps_total,
                 "pending_dump": self._pending_reason,
             }
+
+
+# ---------------------------------------------------------------------------
+# Solver progress: per-solve journeys, health surface, stall watchdog
+# ---------------------------------------------------------------------------
+
+
+class SolveRecord:
+    """One streaming solve's journey: unit (chunk/block) progress, rates,
+    checkpoint age, and a bounded ring of structured progress events.
+    Mutated only by its owning ``ProgressReporter`` (under the reporter's
+    lock); serialized whole at dump time — a record mid-solve serializes
+    exactly as far as the solve got, per the module's torn-read contract,
+    which is what makes a mid-fit death dump name the last completed
+    unit."""
+
+    __slots__ = ("rid", "kind", "total_units", "units_done", "rows_done",
+                 "started_ns", "last_progress_ns", "oom_downshifts",
+                 "checkpoint_unit", "checkpoint_ns", "residual", "outcome",
+                 "stalls", "events", "fingerprint")
+
+    #: Most recent structured progress events kept per solve.
+    EVENT_CAPACITY = 128
+
+    def __init__(self, rid: int, kind: str,
+                 total_units: Optional[int] = None,
+                 fingerprint: Optional[dict] = None):
+        now = time.perf_counter_ns()
+        self.rid = rid
+        self.kind = kind
+        self.total_units = total_units
+        self.units_done = 0
+        self.rows_done = 0
+        self.started_ns = now
+        self.last_progress_ns = now
+        self.oom_downshifts = 0
+        self.checkpoint_unit: Optional[int] = None
+        self.checkpoint_ns: Optional[int] = None
+        self.residual: Optional[float] = None
+        self.outcome: Optional[str] = None
+        self.stalls = 0
+        self.events: deque = deque(maxlen=self.EVENT_CAPACITY)
+        self.fingerprint = dict(fingerprint or {})
+
+    def progress(self) -> Dict[str, Any]:
+        """Derived progress numbers (rates, ETA, ages). Caller holds the
+        reporter's lock when consistency matters."""
+        now = time.perf_counter_ns()
+        elapsed = max(1e-9, (now - self.started_ns) / 1e9)
+        units_per_s = self.units_done / elapsed
+        eta = None
+        if self.total_units and self.units_done:
+            eta = (self.total_units - self.units_done) / max(
+                units_per_s, 1e-9
+            )
+        return {
+            "units_done": self.units_done,
+            "total_units": self.total_units,
+            "rows_done": self.rows_done,
+            "rows_per_s": round(self.rows_done / elapsed, 3),
+            "eta_s": round(eta, 3) if eta is not None else None,
+            "elapsed_s": round(elapsed, 6),
+            "last_progress_age_s": round(
+                (now - self.last_progress_ns) / 1e9, 6
+            ),
+            "oom_downshifts": self.oom_downshifts,
+            "checkpoint_unit": self.checkpoint_unit,
+            "checkpoint_age_s": (
+                round((now - self.checkpoint_ns) / 1e9, 6)
+                if self.checkpoint_ns is not None else None
+            ),
+            "residual": self.residual,
+            "stalls": self.stalls,
+        }
+
+    def as_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "id": self.rid,
+            "kind": self.kind,
+            "outcome": self.outcome,
+            "fingerprint": dict(self.fingerprint),
+        }
+        d.update(self.progress())
+        d["events"] = list(self.events)
+        return d
+
+
+_solves_lock = threading.Lock()
+_active_solves: Dict[int, "ProgressReporter"] = {}
+_solver_recorder: Optional[FlightRecorder] = None
+
+
+def solver_recorder() -> FlightRecorder:
+    """The process-wide flight recorder for streaming solves (one ring
+    shared by every solve, dump context = ``solver_stats``), built
+    lazily so config (flight dir/capacity) is read at first solve."""
+    global _solver_recorder
+    with _solves_lock:
+        if _solver_recorder is None:
+            _solver_recorder = FlightRecorder("solver", context=solver_stats)
+        return _solver_recorder
+
+
+def reset_solver_recorder() -> None:
+    """Drop the solver recorder so the next solve builds a fresh one
+    under the current config (tests point KEYSTONE_FLIGHT_DIR / a
+    config.flight_dir override at a tmpdir)."""
+    global _solver_recorder
+    with _solves_lock:
+        _solver_recorder = None
+
+
+def solver_stats() -> Dict[str, Any]:
+    """The ``stats()``-style health surface for streaming solves: every
+    in-flight solve's progress (units/rows done, rates, ETA, checkpoint
+    age, stall count) plus the solver recorder's ring/dump summary —
+    what ``tools/metrics_server.py`` serves at ``/solves``."""
+    with _solves_lock:
+        active = list(_active_solves.values())
+        rec = _solver_recorder
+    return {
+        "active_solves": len(active),
+        "solves": [r.stats() for r in active],
+        "recorder": rec.stats() if rec is not None else None,
+    }
+
+
+class ProgressReporter:
+    """Structured progress + stall forensics for ONE streaming solve.
+
+    Always-on, like the serving flight recorder: the solver calls
+    ``unit_done`` once per chunk/block — one locked counter update plus a
+    bounded-ring event append every ``KEYSTONE_SOLVE_PROGRESS_EVERY``
+    units — and the journey (a ``SolveRecord``) lives in the process-wide
+    solver ``FlightRecorder`` ring, so an hour-scale fit is observable
+    (``solver_stats()`` / the ``/solves`` endpoint: units, rows/s, ETA,
+    oom_downshifts, checkpoint age) and a solve that dies mid-fit
+    force-dumps a post-mortem naming the last completed unit, exactly
+    like a dead serving worker.
+
+    A per-solve watchdog thread (``KEYSTONE_SOLVE_WATCHDOG_MS``, 0 = off)
+    fires when no unit completes inside the window — a dead producer or a
+    wedged device queue becomes a ``solve_stalls`` counter bump plus an
+    auto-dump instead of a silent hang; each tick is also an unlocked
+    flush point for pending recorder dumps.
+
+    Use as a context manager around the solve loop: clean exit stamps
+    outcome ``ok``; an exception stamps ``error:<type>`` and dumps."""
+
+    def __init__(self, kind: str, total_units: Optional[int] = None,
+                 recorder: Optional[FlightRecorder] = None,
+                 watchdog_ms: Optional[float] = None,
+                 progress_every: Optional[int] = None):
+        from keystone_tpu.config import config
+        from keystone_tpu.utils.metrics import (
+            metrics_registry,
+            reliability_counters,
+            runtime_fingerprint,
+        )
+
+        self.kind = kind
+        self.recorder = solver_recorder() if recorder is None else recorder
+        self._watchdog_s = (
+            config.solve_watchdog_ms if watchdog_ms is None else watchdog_ms
+        ) / 1e3
+        self._every = max(1, int(
+            config.solve_progress_every if progress_every is None
+            else progress_every
+        ))
+        self.rid = next_request_id()
+        self.record = SolveRecord(
+            self.rid, kind, total_units, fingerprint=runtime_fingerprint()
+        )
+        self._lock = threading.Lock()
+        self._done = False
+        self._stop = threading.Event()
+        # Re-arm stamp for the stall watchdog, SEPARATE from the
+        # record's last_progress_ns: rate-limiting stall dumps must not
+        # falsify the journey's real last-progress age on /solves.
+        self._last_stall_ns = self.record.started_ns
+        # oom_downshifts attribution is the process counter's delta since
+        # solve start (concurrent downshifting solves share attribution —
+        # the honest cheap reading).
+        self._reliability = reliability_counters
+        self._oom0 = reliability_counters.get("oom_downshifts")
+        self._events_counter = metrics_registry.counters("solver.events")
+        self._units_gauge = metrics_registry.gauge(
+            f"solve.units_done[{kind}]"
+        )
+        self.recorder.add(self.record)
+        with _solves_lock:
+            _active_solves[self.rid] = self
+        self._watchdog: Optional[threading.Thread] = None
+        if self._watchdog_s > 0:
+            self._watchdog = threading.Thread(
+                target=self._solve_watch_loop,
+                name=f"keystone-solve-watchdog-{self.rid}", daemon=True,
+            )
+            self._watchdog.start()
+
+    # -- the solve loop's side ---------------------------------------------
+
+    def unit_done(self, rows: int = 0, residual: Optional[float] = None,
+                  **attrs) -> None:
+        """Record one completed chunk/block (and the rows it consumed).
+        ``residual`` is optional — passed only where the solver already
+        has it cheaply (never synced for reporting). Extra ``attrs``
+        (epoch, block, chunk) ride on the structured event."""
+        now = time.perf_counter_ns()
+        with self._lock:
+            rec = self.record
+            rec.units_done += 1
+            rec.rows_done += int(rows)
+            rec.last_progress_ns = now
+            if residual is not None:
+                rec.residual = float(residual)
+            rec.oom_downshifts = (
+                self._reliability.get("oom_downshifts") - self._oom0
+            )
+            units = rec.units_done
+            if units % self._every == 0:
+                ev: Dict[str, Any] = {"unit": units, "t_ns": now}
+                ev.update(attrs)
+                p = rec.progress()
+                ev["rows_per_s"] = p["rows_per_s"]
+                ev["eta_s"] = p["eta_s"]
+                if residual is not None:
+                    ev["residual"] = float(residual)
+                rec.events.append(ev)
+        self._units_gauge.set(units)
+        self._events_counter.bump(f"{self.kind}_units")
+
+    def checkpoint(self, unit: Optional[int] = None) -> None:
+        """Stamp a written checkpoint (``unit`` defaults to the current
+        unit count) — feeds the health surface's checkpoint age."""
+        now = time.perf_counter_ns()
+        with self._lock:
+            self.record.checkpoint_unit = (
+                self.record.units_done if unit is None else int(unit)
+            )
+            self.record.checkpoint_ns = now
+
+    def finish(self, outcome: str = "ok") -> None:
+        """Close the journey (idempotent) and stop the watchdog."""
+        with self._lock:
+            if self._done:
+                return
+            self._done = True
+            self.record.outcome = outcome
+        self._stop.set()
+        with _solves_lock:
+            _active_solves.pop(self.rid, None)
+        self._events_counter.bump(f"{self.kind}_solves")
+        # Unlocked point: flush any dump the watchdog marked pending.
+        self.recorder.poll()
+
+    def fail(self, exc: BaseException) -> None:
+        """A solve died mid-fit: stamp the failure and force-dump the
+        solver recorder — the journey names the last completed unit."""
+        with self._lock:
+            if self._done:
+                return
+            self._done = True
+            self.record.outcome = f"error:{type(exc).__name__}"
+            done = self.record.units_done
+        self._stop.set()
+        with _solves_lock:
+            _active_solves.pop(self.rid, None)
+        self._events_counter.bump(f"{self.kind}_failures")
+        self.recorder.error(
+            "solve_death",
+            f"{self.kind} solve {self.rid} died after unit {done}: {exc}",
+            rid=self.rid,
+        )
+        logger.warning(
+            "%s solve %d died after unit %d (%s); dumping solver "
+            "flight recorder", self.kind, self.rid, done, exc,
+        )
+        self.recorder.dump("solve_death", force=True)
+
+    def stats(self) -> Dict[str, Any]:
+        """This solve's live progress (the per-solve health surface)."""
+        with self._lock:
+            d: Dict[str, Any] = {
+                "id": self.rid,
+                "kind": self.kind,
+                "outcome": self.record.outcome,
+            }
+            d.update(self.record.progress())
+        return d
+
+    def __enter__(self) -> "ProgressReporter":
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is not None:
+            self.fail(exc)
+        else:
+            self.finish("ok")
+        return False
+
+    # -- the watchdog's side -----------------------------------------------
+
+    def _solve_watch_loop(self) -> None:
+        """Per-solve stall watchdog (registered thread root — see
+        tools/keystone_lint.py KNOWN_THREAD_TARGETS): no unit completed
+        inside the window → counter bump + recorder dump, re-armed so one
+        stall yields one dump per window, not one per tick."""
+        from keystone_tpu.utils.metrics import metrics_registry
+
+        interval = max(self._watchdog_s / 4.0, 0.05)
+        while not self._stop.wait(interval):
+            self.recorder.poll()
+            now = time.perf_counter_ns()
+            with self._lock:
+                if self._done:
+                    return
+                age_s = (now - self.record.last_progress_ns) / 1e9
+                since_fire_s = (now - self._last_stall_ns) / 1e9
+                if age_s < self._watchdog_s or since_fire_s < self._watchdog_s:
+                    continue
+                # Re-arm the FIRE stamp before dumping (one stall = one
+                # dump per window); the record keeps the true
+                # last-progress time so /solves reports the real age.
+                self._last_stall_ns = now
+                self.record.stalls += 1
+                done = self.record.units_done
+            metrics_registry.counters("solver.events").bump(
+                f"{self.kind}_stalls"
+            )
+            self._reliability.bump("solve_stalls")
+            self.recorder.error(
+                "stall",
+                f"{self.kind} solve {self.rid}: no progress for "
+                f"{age_s * 1e3:.0f}ms after unit {done}",
+                rid=self.rid,
+            )
+            logger.warning(
+                "%s solve %d: watchdog stall — no unit completed for "
+                "%.0fms (last unit %d); dumping solver flight recorder",
+                self.kind, self.rid, age_s * 1e3, done,
+            )
+            self.recorder.dump("solve_stall")

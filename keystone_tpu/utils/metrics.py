@@ -987,35 +987,461 @@ def environment_fingerprint(devices: bool = True) -> Dict[str, Any]:
     return fp
 
 
+# Device memory probes, memoized per process: ``jax.local_devices()`` and
+# an unsupported ``memory_stats()`` are host syncs, and once the profiler
+# wires these onto the per-node hot path they must cost a dict read, not a
+# runtime round-trip per node. ``False`` = probed and unavailable.
+_memprobe_lock = threading.Lock()
+_memprobe_device: Any = None
+_hbm_limit_memo: Any = None  # None=unprobed, False=not reported, else int
+_peak_supported: Optional[bool] = None
+
+
+def reset_memory_probe() -> None:
+    """Drop the memoized device/limit probes (tests, backend swaps)."""
+    global _memprobe_device, _hbm_limit_memo, _peak_supported
+    with _memprobe_lock:
+        _memprobe_device = None
+        _hbm_limit_memo = None
+        _peak_supported = None
+
+
+def _memory_stats_device():
+    """Device 0 for ``memory_stats`` probes, resolved ONCE per process
+    (None when the backend is dead or deviceless)."""
+    global _memprobe_device
+    dev = _memprobe_device
+    if dev is None:
+        with _memprobe_lock:
+            if _memprobe_device is None:
+                try:
+                    devs = jax.local_devices()
+                    _memprobe_device = devs[0] if devs else False
+                except Exception:  # lint: broad-ok a dead/deviceless backend raises backend-specific types; all mean 'nothing to probe'
+                    _memprobe_device = False
+            dev = _memprobe_device
+    return dev if dev is not False else None
+
+
 def device_hbm_bytes(default: int | None = None) -> int:
     """Memory budget of device 0 as the runtime reports it (``bytes_limit``
     from ``memory_stats``), falling back to ``config.hbm_budget_bytes`` for
-    backends that don't report one (notably CPU)."""
+    backends that don't report one (notably CPU). The device probe AND the
+    reported limit are memoized per process — the limit is static, and
+    re-asking the runtime per call is a host sync. Always returns an int."""
     from keystone_tpu.config import config
 
-    try:
-        stats = jax.local_devices()[0].memory_stats() or {}
-        limit = stats.get("bytes_limit")
-        if limit:
-            return int(limit)
-    except Exception:  # lint: broad-ok backend-specific probe failures all mean 'no reported limit'
-        pass
-    return default if default is not None else config.hbm_budget_bytes
+    global _hbm_limit_memo
+    limit = _hbm_limit_memo
+    if limit is None:
+        dev = _memory_stats_device()
+        found: Any = False
+        if dev is not None:
+            try:
+                stats = dev.memory_stats() or {}
+                raw = stats.get("bytes_limit")
+                if raw:
+                    found = int(raw)
+            except Exception:  # lint: broad-ok backend-specific probe failures all mean 'no reported limit'
+                pass
+        with _memprobe_lock:
+            _hbm_limit_memo = found
+        limit = found
+    if limit is not False:
+        return int(limit)
+    return int(default) if default is not None else config.hbm_budget_bytes
 
 
 def peak_hbm_bytes() -> int | None:
     """HBM high-water of device 0 (``peak_bytes_in_use``), or None where
     the runtime doesn't report it (notably CPU). Shared by the
-    single-number evidence rows (bench line, streamed-overlap step); the
-    checkride ``memory_stats`` step deliberately keeps its own multi-key
-    probe — it exists to record the runtime's whole key set, including
-    whatever a different runtime names the peak."""
-    try:
-        stats = jax.local_devices()[0].memory_stats() or {}
-    except Exception:  # lint: broad-ok backend-specific probe failures all mean 'no reported peak'
+    single-number evidence rows (bench line, streamed-overlap step) and
+    the profiler's per-node HBM deltas; the checkride ``memory_stats``
+    step deliberately keeps its own multi-key probe — it exists to record
+    the runtime's whole key set, including whatever a different runtime
+    names the peak.
+
+    The device handle and the does-this-runtime-report-a-peak verdict are
+    memoized per process (the CPU backend answers None forever; asking it
+    again per profiled node would put a host sync on the hot path). The
+    peak VALUE itself is re-read on every call where supported."""
+    global _peak_supported
+    if _peak_supported is False:
         return None
-    peak = stats.get("peak_bytes_in_use")
-    return int(peak) if peak is not None else None
+    dev = _memory_stats_device()
+    peak = None
+    if dev is not None:
+        try:
+            stats = dev.memory_stats() or {}
+            peak = stats.get("peak_bytes_in_use")
+        except Exception:  # lint: broad-ok backend-specific probe failures all mean 'no reported peak'
+            peak = None
+    if peak is None:
+        with _memprobe_lock:
+            _peak_supported = False
+        return None
+    if _peak_supported is None:
+        with _memprobe_lock:
+            _peak_supported = True
+    return int(peak)
+
+
+_runtime_fp_lock = threading.Lock()
+_runtime_fp: Optional[Dict[str, Any]] = None
+
+
+def runtime_fingerprint() -> Dict[str, Any]:
+    """The small memoized backend-identity subset of
+    ``environment_fingerprint`` (jax version, backend, device kind/count)
+    that profile snapshots and solver journey records carry, so
+    ``tools/bench_watch.py`` can refuse to compare rows recorded under
+    different backends or device counts. Memoized per process: the full
+    fingerprint probes devices per call, which is a host sync once this
+    rides every solve record."""
+    global _runtime_fp
+    fp = _runtime_fp
+    if fp is None:
+        fp = {
+            "jax": getattr(jax, "__version__", None),
+            "backend": None,
+            "device_kind": None,
+            "device_count": None,
+        }
+        try:
+            fp["backend"] = jax.default_backend()
+            fp["device_count"] = int(jax.device_count())
+            devs = jax.local_devices()
+            fp["device_kind"] = devs[0].device_kind if devs else None
+        except Exception as e:  # lint: broad-ok deviceless/dead backend raises backend-specific types: record, don't die
+            fp["backend_error"] = str(e)[:200]
+        with _runtime_fp_lock:
+            _runtime_fp = fp
+    return dict(fp)
+
+
+# ---------------------------------------------------------------------------
+# Per-node resource attribution (the training-side profiler)
+# ---------------------------------------------------------------------------
+
+#: FIFO bound on the per-(transformer, shape, dtype) cost-model memo.
+_NODE_COST_CAP = 256
+_node_cost_lock = threading.Lock()
+#: key -> (estimate dict | None, transformer pin). The pin keeps the
+#: transformer alive while its id() keys the memo, so CPython id reuse
+#: can never alias a stale entry (the _prefix_pins discipline).
+_node_cost_memo: "OrderedDict[tuple, tuple]" = OrderedDict()
+
+
+def _memory_analysis(compiled) -> Dict[str, float]:
+    """Whatever ``memory_analysis`` the backend reports for a compiled
+    executable, as plain floats (empty where unsupported)."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:  # lint: broad-ok memory_analysis is backend-optional; absence means 'no estimate'
+        return {}
+    out: Dict[str, float] = {}
+    for attr, key in (
+        ("temp_size_in_bytes", "temp_bytes"),
+        ("argument_size_in_bytes", "argument_bytes"),
+        ("output_size_in_bytes", "output_bytes"),
+        ("generated_code_size_in_bytes", "code_bytes"),
+    ):
+        v = getattr(mem, attr, None)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[key] = float(v)
+    return out
+
+
+def node_cost_analysis(transformer, X) -> Optional[Dict[str, float]]:
+    """Cost-model estimate (FLOPs, bytes accessed, memory analysis) of
+    running ``transformer.apply_batch`` at ``X``'s shape — computed ONCE
+    per (transformer, shape, dtype) via an abstract AOT lower+compile
+    (``ShapeDtypeStruct``: no data touched, nothing executed) and
+    memoized, so a profiled fit pays one extra compile per distinct
+    executable, never one per node execution. Returns None where the
+    transformer can't lower (host nodes, non-array inputs) — those rows
+    stay measured-only."""
+    shape = tuple(getattr(X, "shape", ()) or ())
+    dtype = getattr(X, "dtype", None)
+    if not shape or dtype is None or not getattr(transformer, "jittable", False):
+        return None
+    key = (id(transformer), shape, str(dtype))
+    with _node_cost_lock:
+        hit = _node_cost_memo.get(key)
+    if hit is not None:
+        est = hit[0]
+        return dict(est) if est else None
+    try:
+        spec = jax.ShapeDtypeStruct(shape, dtype)
+        # The transformer's own cached jit wrapper (built lazily by
+        # batch_call) keeps this the SAME executable identity the traced
+        # path runs where the runtime caches by avals.
+        jitted = getattr(transformer, "_jitted", None)
+        fn = jitted() if jitted is not None else jax.jit(transformer.apply_batch)
+        compiled = fn.lower(spec).compile()
+        cost = compiled_cost(compiled)
+        est = {
+            "flops": cost["flops"],
+            "bytes_accessed": cost["bytes_accessed"],
+        }
+        est.update(_memory_analysis(compiled))
+    except Exception:  # lint: broad-ok the cost model is best-effort; any lowering/compile failure means 'no estimate', never a failed fit
+        est = None
+    with _node_cost_lock:
+        _node_cost_memo[key] = (est, transformer)
+        while len(_node_cost_memo) > _NODE_COST_CAP:
+            _node_cost_memo.popitem(last=False)
+    return dict(est) if est else None
+
+
+class ResourceProfile:
+    """Per-node resource attribution for executor walks — the
+    training-side answer to "what does each operator cost", the
+    measurement substrate KeystoneML's cost-based optimization presumes.
+
+    One process-wide instance aggregates rows keyed by node label:
+    per-node call count, wall time (covering device completion — the
+    profiled path blocks on array outputs), dispatch time, cost-model
+    FLOPs / bytes accessed (from the memoized ``node_cost_analysis``
+    AOT compile — estimates, not measurements), output nbytes, the HBM
+    high-water delta where the runtime reports one, and cache-status
+    tallies (hit / memo / miss). Registered in ``metrics_registry`` as
+    ``"profile"`` so ``snapshot()`` and the Prometheus exposition carry
+    the per-node families (``keystone_profile_node_*{key="<label>"}``).
+
+    Thread-safe; populated only when ``active_profile()`` resolves
+    non-None (KEYSTONE_PROFILE, or a ``profile_scope()`` forced by
+    ``Pipeline.fit(profile=True)``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._nodes: "OrderedDict[str, dict]" = OrderedDict()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._nodes.clear()
+
+    def record_node(
+        self,
+        label: str,
+        wall_ns: int = 0,
+        dispatch_ns: Optional[int] = None,
+        flops: Optional[float] = None,
+        bytes_accessed: Optional[float] = None,
+        out_nbytes: Optional[int] = None,
+        hbm_delta: Optional[int] = None,
+        cache: str = "miss",
+    ) -> None:
+        """Fold one node execution into the label's aggregate row."""
+        with self._lock:
+            agg = self._nodes.get(label)
+            if agg is None:
+                agg = self._nodes[label] = {
+                    "calls": 0, "wall_ns": 0, "dispatch_ns": 0,
+                    "flops": 0.0, "bytes_accessed": 0.0, "output_bytes": 0,
+                    "hbm_delta_bytes": 0, "cost_modeled": 0,
+                    "hbm_known": False,
+                    "cache": {"hit": 0, "memo": 0, "miss": 0},
+                }
+            agg["calls"] += 1
+            agg["wall_ns"] += int(wall_ns)
+            if dispatch_ns is not None:
+                agg["dispatch_ns"] += int(dispatch_ns)
+            if flops is not None:
+                agg["flops"] += float(flops)
+                agg["cost_modeled"] += 1
+            if bytes_accessed is not None:
+                agg["bytes_accessed"] += float(bytes_accessed)
+            if out_nbytes is not None:
+                agg["output_bytes"] += int(out_nbytes)
+            if hbm_delta is not None:
+                agg["hbm_delta_bytes"] += int(hbm_delta)
+                agg["hbm_known"] = True
+            agg["cache"][cache] = agg["cache"].get(cache, 0) + 1
+
+    #: Numeric aggregate fields a ``mark()`` delta subtracts.
+    _DELTA_FIELDS = ("calls", "wall_ns", "dispatch_ns", "flops",
+                     "bytes_accessed", "output_bytes", "hbm_delta_bytes",
+                     "cost_modeled")
+
+    def mark(self) -> Dict[str, dict]:
+        """Opaque snapshot of the per-label aggregates, for delta views:
+        ``rows(since=mark)`` / ``table(since=mark)`` report only what was
+        recorded AFTER the mark — how ``Pipeline.fit(profile=True)``
+        logs one fit's attribution without resetting the process-wide
+        profile other readers (Prometheus) are watching."""
+        with self._lock:
+            return {
+                label: dict(agg, cache=dict(agg["cache"]))
+                for label, agg in self._nodes.items()
+            }
+
+    def rows(
+        self, since: Optional[Dict[str, dict]] = None
+    ) -> List[Dict[str, Any]]:
+        """Attribution rows (one per node label, heaviest wall first) in
+        the shape ``render_attribution_table`` and
+        ``tools/profile_report.py`` consume. FLOPs/bytes are cost-model
+        ESTIMATES (provenance ``cost-model``); wall/dispatch/output are
+        measured. ``since`` (a ``mark()``) restricts to the delta —
+        labels untouched after the mark are dropped."""
+        with self._lock:
+            items = [(label, dict(agg), dict(agg["cache"]))
+                     for label, agg in self._nodes.items()]
+        if since is not None:
+            delta_items = []
+            for label, agg, cache in items:
+                base = since.get(label)
+                if base is not None:
+                    agg = dict(agg)
+                    for f in self._DELTA_FIELDS:
+                        agg[f] = agg[f] - base[f]
+                    cache = {
+                        k: v - base["cache"].get(k, 0)
+                        for k, v in cache.items()
+                    }
+                if agg["calls"] > 0:
+                    delta_items.append((label, agg, cache))
+            items = delta_items
+        rows = []
+        for label, agg, cache in items:
+            executed = cache.get("miss", 0)
+            rows.append({
+                "node": label,
+                "calls": agg["calls"],
+                "wall_ms": round(agg["wall_ns"] / 1e6, 4),
+                "dispatch_ms": round(agg["dispatch_ns"] / 1e6, 4),
+                "device_wait_ms": round(
+                    max(0, agg["wall_ns"] - agg["dispatch_ns"]) / 1e6, 4
+                ),
+                "flops": agg["flops"] if agg["cost_modeled"] else None,
+                "bytes_accessed": (
+                    agg["bytes_accessed"] if agg["cost_modeled"] else None
+                ),
+                "output_bytes": agg["output_bytes"] or None,
+                "hbm_delta_bytes": (
+                    agg["hbm_delta_bytes"] if agg["hbm_known"] else None
+                ),
+                "cache_hits": cache.get("hit", 0) + cache.get("memo", 0),
+                "executed": executed,
+                "provenance": (
+                    "cost-model" if agg["cost_modeled"] else "measured"
+                ),
+            })
+        rows.sort(key=lambda r: -r["wall_ms"])
+        return rows
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Registry-shape snapshot: per-label numeric families (flattened
+        by the Prometheus exposition into ``key``-labelled gauges) plus
+        the memoized runtime fingerprint for cross-run comparability."""
+        with self._lock:
+            items = [(label, dict(agg)) for label, agg in self._nodes.items()]
+        snap: Dict[str, Any] = {
+            "nodes": len(items),
+            "node_calls": {}, "node_wall_seconds": {},
+            "node_device_wait_seconds": {}, "node_flops": {},
+            "node_bytes_accessed": {}, "node_output_bytes": {},
+            "node_hbm_delta_bytes": {},
+        }
+        for label, agg in items:
+            snap["node_calls"][label] = agg["calls"]
+            snap["node_wall_seconds"][label] = agg["wall_ns"] / 1e9
+            snap["node_device_wait_seconds"][label] = (
+                max(0, agg["wall_ns"] - agg["dispatch_ns"]) / 1e9
+            )
+            if agg["cost_modeled"]:
+                snap["node_flops"][label] = agg["flops"]
+                snap["node_bytes_accessed"][label] = agg["bytes_accessed"]
+            if agg["output_bytes"]:
+                snap["node_output_bytes"][label] = agg["output_bytes"]
+            if agg["hbm_known"]:
+                snap["node_hbm_delta_bytes"][label] = agg["hbm_delta_bytes"]
+        snap["fingerprint"] = runtime_fingerprint()
+        return snap
+
+    def table(self, since: Optional[Dict[str, dict]] = None) -> str:
+        """The attribution table, rendered (see
+        ``render_attribution_table``); ``since`` as in ``rows``."""
+        return render_attribution_table(self.rows(since=since))
+
+    def export(self, path: str) -> dict:
+        """Write rows + snapshot as JSON (atomic), for
+        ``tools/profile_report.py`` to render offline."""
+        doc = {"profile": self.snapshot(), "rows": self.rows()}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, path)
+        return doc
+
+
+def render_attribution_table(rows: List[Dict[str, Any]]) -> str:
+    """The trace_report-style attribution table over profile rows — ONE
+    renderer shared by ``Pipeline.fit(profile=True)``'s log line,
+    ``tools/profile_report.py``, and ``tools/trace_report.py --fit``, so
+    a live profile and a Chrome trace of the same fit render identically.
+    Missing columns (a trace has no cost model) print as ``-``."""
+
+    def num(v, scale=1.0, fmt="{:.3f}"):
+        if v is None:
+            return "-"
+        return fmt.format(v / scale)
+
+    header = (
+        f"{'node':<40} {'calls':>5} {'wall ms':>10} {'wait ms':>9} "
+        f"{'MFLOP':>10} {'MB moved':>9} {'out MB':>8} {'hbm Δ MB':>9} "
+        f"{'cache':>6}  src"
+    )
+    lines = [header, "-" * len(header)]
+    for r in rows:
+        lines.append(
+            f"{r['node'][:40]:<40} {r['calls']:>5} "
+            f"{num(r.get('wall_ms')):>10} {num(r.get('device_wait_ms')):>9} "
+            f"{num(r.get('flops'), 1e6):>10} "
+            f"{num(r.get('bytes_accessed'), 1e6):>9} "
+            f"{num(r.get('output_bytes'), 1e6):>8} "
+            f"{num(r.get('hbm_delta_bytes'), 1e6):>9} "
+            f"{r.get('cache_hits', 0):>6}  {r.get('provenance', 'measured')}"
+        )
+    return "\n".join(lines)
+
+
+resource_profile = ResourceProfile()
+metrics_registry.register("profile", resource_profile)
+
+#: profile_scope() nesting depth — nonzero forces ``active_profile()`` on
+#: regardless of config (the Pipeline.fit(profile=True) path).
+_profile_force = 0
+_profile_force_lock = threading.Lock()
+
+
+@contextmanager
+def profile_scope():
+    """Force per-node profiling on for the dynamic extent of one fit /
+    apply (``Pipeline.fit(profile=True)``), yielding the process-wide
+    ``ResourceProfile``. Nests; restores on exit."""
+    global _profile_force
+    with _profile_force_lock:
+        _profile_force += 1
+    try:
+        yield resource_profile
+    finally:
+        with _profile_force_lock:
+            _profile_force -= 1
+
+
+def active_profile() -> Optional[ResourceProfile]:
+    """The process-wide ``ResourceProfile``, or None when profiling is
+    disabled (``config.profile`` / KEYSTONE_PROFILE off and no
+    ``profile_scope()`` active). Resolve ONCE per executor walk — the
+    ``active_plan()`` discipline — so the unprofiled walk pays one None
+    check per node."""
+    from keystone_tpu.config import config
+
+    if config.profile or _profile_force:
+        return resource_profile
+    return None
 
 
 def achieved_tflops(fn: Callable, *args, repeats: int = 3) -> Dict[str, float]:

@@ -59,6 +59,66 @@ def _no_sources(sid: SourceId):
     )
 
 
+def _observed_execute(op, deps, tracer, profile):
+    """Execute one node under the tracer and/or the resource profile.
+
+    The profiled path blocks on array outputs so wall time covers device
+    completion (dispatch vs wait attributed separately) and attributes
+    cost-model FLOPs/bytes via the memoized abstract AOT compile — the
+    node's VALUES are untouched, which is what keeps KEYSTONE_PROFILE=0
+    and =1 fits bit-identical."""
+    import time
+
+    label = op.label()
+    if profile is None:
+        t0 = tracer.now()
+        out = op.execute(deps)
+        tracer.record(
+            "node:" + label, "executor", t0,
+            cache="miss", shape=_span_shape(out),
+        )
+        return out
+
+    import jax
+
+    from keystone_tpu.utils.metrics import node_cost_analysis, peak_hbm_bytes
+
+    hbm0 = peak_hbm_bytes()
+    t0 = time.perf_counter_ns()
+    out = op.execute(deps)
+    t_disp = time.perf_counter_ns()
+    if isinstance(out, jax.Array):
+        out.block_until_ready()
+    end = time.perf_counter_ns()
+    hbm1 = peak_hbm_bytes()
+    cost = None
+    if (
+        isinstance(op, TransformerOperator)
+        and deps
+        and hasattr(deps[0], "shape")
+        and hasattr(deps[0], "dtype")
+    ):
+        cost = node_cost_analysis(op.transformer, deps[0])
+    profile.record_node(
+        label,
+        wall_ns=end - t0,
+        dispatch_ns=t_disp - t0,
+        flops=(cost or {}).get("flops"),
+        bytes_accessed=(cost or {}).get("bytes_accessed"),
+        out_nbytes=getattr(out, "nbytes", None),
+        hbm_delta=(
+            hbm1 - hbm0 if hbm0 is not None and hbm1 is not None else None
+        ),
+        cache="miss",
+    )
+    if tracer is not None:
+        tracer.record(
+            "node:" + label, "executor", t0, end,
+            cache="miss", shape=_span_shape(out), profiled=True,
+        )
+    return out
+
+
 class GraphExecutor:
     def __init__(self, env: "PipelineEnv"):
         self.env = env
@@ -73,11 +133,13 @@ class GraphExecutor:
         subgraph is never visited — cached values short-circuit
         recomputation, not just value storage.
         """
-        from keystone_tpu.utils.metrics import active_tracer
+        from keystone_tpu.utils.metrics import active_profile, active_tracer
 
         # Resolved once per execution walk (the active_plan discipline):
-        # the untraced walk pays one None check per node, nothing more.
+        # the untraced/unprofiled walk pays one None check per node,
+        # nothing more.
         tracer = active_tracer()
+        profile = active_profile()
         for t in targets:
             if isinstance(t, SourceId):
                 _no_sources(t)
@@ -144,6 +206,8 @@ class GraphExecutor:
                     tracer.instant(
                         "node:" + op.label(), "executor", cache="hit"
                     )
+                if profile is not None:
+                    profile.record_node(op.label(), cache="hit")
                 continue  # leaf: do not descend into its dependencies
             stack.append((gid, True))
             for dep in graph.dependencies[gid]:
@@ -159,6 +223,8 @@ class GraphExecutor:
                     tracer.instant(
                         "node:" + op.label(), "executor", cache="memo"
                     )
+                if profile is not None:
+                    profile.record_node(op.label(), cache="memo")
                 # A cache node hashes identically to its dependency (it's an
                 # identity), so it lands here — still persist its value.
                 if getattr(op, "persist", False) and h not in self.env.node_cache:
@@ -168,15 +234,10 @@ class GraphExecutor:
                     )
                 continue
             deps = [values[d] for d in graph.dependencies[nid]]
-            if tracer is None:
+            if tracer is None and profile is None:
                 out = op.execute(deps)
             else:
-                t0 = tracer.now()
-                out = op.execute(deps)
-                tracer.record(
-                    "node:" + op.label(), "executor", t0,
-                    cache="miss", shape=_span_shape(out),
-                )
+                out = _observed_execute(op, deps, tracer, profile)
             values[nid] = by_hash[h] = out
             if isinstance(op, EstimatorOperator):
                 self._cache_fit(graph, nid, h, op, out)

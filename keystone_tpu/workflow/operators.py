@@ -198,6 +198,12 @@ class TransformerOperator(Operator):
         return (self.transformer,)
 
     def label(self):
+        # A fused chain names its stages: the profiler/trace attribution
+        # row for one XLA program should say WHICH operators it fused,
+        # not the anonymous wrapper class.
+        stages = getattr(self.transformer, "stages", None)
+        if stages:
+            return "Fused(" + "|".join(type(s).__name__ for s in stages) + ")"
         return type(self.transformer).__name__
 
 

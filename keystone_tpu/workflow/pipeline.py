@@ -475,15 +475,29 @@ class Pipeline:
 
     # -- fitting -----------------------------------------------------------
 
-    def fit(self) -> "Pipeline":
+    def fit(self, profile: Optional[bool] = None) -> "Pipeline":
         """Force every estimator in the graph and return a transformer-only
         pipeline (the reference's fitted pipeline).
+
+        ``profile=True`` forces per-node resource attribution for this
+        fit (``utils.metrics.profile_scope``) regardless of
+        KEYSTONE_PROFILE, and logs the attribution table — wall/device
+        time, cost-model FLOPs/bytes, output nbytes, HBM delta per node
+        — when the fit completes; the rows stay readable afterwards via
+        ``utils.metrics.resource_profile`` and the registry/Prometheus
+        surface. ``None`` (default) follows ``config.profile``.
+        Profiling never changes fit OUTPUTS (bit-identical either way);
+        it only measures.
 
         Ref: Pipeline.fit returning FittedPipeline [unverified].
         """
         from contextlib import nullcontext
 
-        from keystone_tpu.utils.metrics import active_tracer
+        from keystone_tpu.utils.metrics import (
+            active_tracer,
+            profile_scope,
+            resource_profile,
+        )
         from keystone_tpu.workflow.analysis import enforce_lint
         from keystone_tpu.workflow.executor import PipelineEnv
 
@@ -493,10 +507,20 @@ class Pipeline:
         # Cold path (once per fit): nullcontext keeps one call body; the
         # hot loops (solvers, prefetch, serving) branch explicitly instead.
         tracer = active_tracer()
-        with (tracer.span("pipeline.fit", "pipeline")
-              if tracer is not None else nullcontext()):
-            graph = PipelineEnv.get().executor.fit_estimators(
-                self.graph, self.sink
+        # mark() scopes the logged table to THIS fit's delta — the
+        # process-wide profile keeps accumulating for registry readers.
+        mark = resource_profile.mark() if profile else None
+        with (profile_scope() if profile else nullcontext()):
+            with (tracer.span("pipeline.fit", "pipeline")
+                  if tracer is not None else nullcontext()):
+                graph = PipelineEnv.get().executor.fit_estimators(
+                    self.graph, self.sink
+                )
+        if profile:
+            import logging
+
+            logging.getLogger("keystone_tpu").info(
+                "fit attribution:\n%s", resource_profile.table(since=mark)
             )
         # Prune to the subgraph feeding our sink.
         return Pipeline(graph, self.source, self.sink)

@@ -1,0 +1,190 @@
+"""Bench regression sentinel (tools/bench_watch.py = make bench-watch).
+
+Pins the ISSUE-9 gate contract:
+
+1. The checked-in bench history passes (exit 0) — the sentinel must
+   gate the trajectory as committed, or it could never run in CI.
+2. A synthetic 2x p99 regression row appended with a COMPATIBLE
+   fingerprint exits nonzero and names the metric.
+3. The same row under a different backend/device-count fingerprint is
+   refused for comparison (skipped), NOT flagged — the
+   environment_fingerprint provenance satellite.
+4. Boolean gate flags flipping true -> false regress; throughput-like
+   leaves regress downward; unknown leaves are never gated.
+5. ``--bless`` records an intentional change and waives exactly that
+   series while its value holds.
+"""
+
+import json
+import os
+import shutil
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+try:
+    import bench_watch
+finally:
+    sys.path.pop(0)
+
+
+@pytest.fixture
+def history(tmp_path):
+    """A private copy of the repo's checked-in bench history."""
+    for name in os.listdir(REPO_ROOT):
+        if name.startswith(("BENCH_", "MULTICHIP_")) and name.endswith(
+            ".json"
+        ):
+            shutil.copy(os.path.join(REPO_ROOT, name), tmp_path / name)
+    return tmp_path
+
+
+def _append_serve_row(root, mutate):
+    path = os.path.join(root, "BENCH_serve.json")
+    rows = [json.loads(line) for line in open(path)]
+    row = json.loads(json.dumps(rows[-1]))  # deep copy of the latest
+    mutate(row)
+    with open(path, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    return row
+
+
+def test_checked_in_history_passes():
+    result = bench_watch.run(REPO_ROOT)
+    assert result["ok"], result["regressions"]
+    assert result["series"] > 50
+    # The fingerprint refusal is live on the real history: the TPU round
+    # (BENCH_r03) must be excluded from the CPU rounds' bands.
+    skipped = [v for v in result["verdicts"]
+               if v.get("skipped_incompatible")]
+    assert skipped, "expected the TPU history row to be refused"
+
+
+def test_make_bench_watch_cli_green():
+    assert bench_watch.main(["--root", REPO_ROOT]) == 0
+
+
+def test_synthetic_p99_regression_fails(history):
+    def mutate(row):
+        row["bucketed"]["p99_ms"] *= 2.0
+
+    _append_serve_row(history, mutate)
+    result = bench_watch.run(str(history))
+    assert not result["ok"]
+    names = [v["series"] for v in result["regressions"]]
+    assert "serve:serve_bucketed_vs_pershape:bucketed.p99_ms" in names
+    (reg,) = [v for v in result["regressions"]
+              if v["series"].endswith("bucketed.p99_ms")]
+    assert "above noise band" in reg["reason"]
+    assert bench_watch.main(["--root", str(history)]) == 1
+
+
+def test_fingerprint_change_is_refused_not_flagged(history):
+    def mutate(row):
+        row["bucketed"]["p99_ms"] *= 2.0
+        row["env"]["backend"] = "tpu"
+        row["env"]["device_count"] = 4
+        row["backend"] = "tpu"
+
+    _append_serve_row(history, mutate)
+    result = bench_watch.run(str(history))
+    assert result["ok"], result["regressions"]
+    v = next(v for v in result["verdicts"]
+             if v["series"] == "serve:serve_bucketed_vs_pershape:"
+                               "bucketed.p99_ms")
+    assert v["status"] == "no_history"
+    assert v["skipped_incompatible"] >= 1
+
+
+def test_throughput_drop_and_bool_flip_regress(history):
+    def mutate(row):
+        row["bucketed"]["rows_per_s"] /= 3.0
+        row["pass"]["zero_post_warmup_compiles"] = False
+
+    _append_serve_row(history, mutate)
+    result = bench_watch.run(str(history))
+    names = {v["series"] for v in result["regressions"]}
+    assert "serve:serve_bucketed_vs_pershape:bucketed.rows_per_s" in names
+    assert any(s.endswith("pass.zero_post_warmup_compiles") for s in names)
+
+
+def test_unjudged_leaves_never_gate(history):
+    def mutate(row):
+        row["features"] = row.get("features", 512) * 100  # config, not perf
+
+    _append_serve_row(history, mutate)
+    result = bench_watch.run(str(history))
+    assert result["ok"], result["regressions"]
+
+
+def test_bless_waives_exactly_that_series(history):
+    def mutate(row):
+        row["bucketed"]["p99_ms"] *= 2.0
+
+    _append_serve_row(history, mutate)
+    assert bench_watch.main(["--root", str(history)]) == 1
+    series = "serve:serve_bucketed_vs_pershape:bucketed.p99_ms"
+    assert bench_watch.main([
+        "--root", str(history), "--bless", series,
+        "--why", "intentional trade for test",
+    ]) == 0
+    result = bench_watch.run(str(history))
+    assert result["ok"]
+    v = next(x for x in result["verdicts"] if x["series"] == series)
+    assert v["status"] == "blessed"
+    # A FURTHER regression past the blessed value re-fires the gate.
+    _append_serve_row(history, mutate)
+    result = bench_watch.run(str(history))
+    assert not result["ok"]
+
+
+def test_bless_waives_boolean_series_too(history):
+    def mutate(row):
+        row["pass"]["zero_post_warmup_compiles"] = False
+
+    _append_serve_row(history, mutate)
+    result = bench_watch.run(str(history))
+    (reg,) = [v for v in result["regressions"]
+              if v["series"].endswith("pass.zero_post_warmup_compiles")]
+    assert "true -> false" in reg["reason"]
+    assert bench_watch.main([
+        "--root", str(history), "--bless", reg["series"],
+        "--why", "known infra outage",
+    ]) == 0
+    result = bench_watch.run(str(history))
+    v = next(x for x in result["verdicts"] if x["series"] == reg["series"])
+    assert v["status"] == "blessed"
+    assert result["ok"]
+
+
+def test_residual_leaf_is_gated(history):
+    # A numeric-quality regression (relative_residual blowing up) must
+    # gate, not ride through as unjudged.
+    path = os.path.join(str(history), "BENCH_r06.json")
+    doc = json.load(open(os.path.join(str(history), "BENCH_r05.json")))
+    doc["n"] = 6
+    doc["parsed"]["detail"]["relative_residual"] = 0.9
+    json.dump(doc, open(path, "w"))
+    result = bench_watch.run(str(history))
+    assert any(
+        v["series"].endswith("detail.relative_residual")
+        for v in result["regressions"]
+    ), result["by_status"]
+
+
+def test_bless_requires_known_series_and_why(history):
+    assert bench_watch.main([
+        "--root", str(history), "--bless", "no:such:series", "--why", "x",
+    ]) == 2
+    assert bench_watch.main([
+        "--root", str(history), "--bless", "a:b:c",
+    ]) == 2
+
+
+def test_unreadable_history_fails_loudly(history):
+    (history / "BENCH_r09.json").write_text("{not json")
+    with pytest.raises(RuntimeError, match="unreadable history row"):
+        bench_watch.run(str(history))
+    assert bench_watch.main(["--root", str(history)]) == 2

@@ -1,0 +1,271 @@
+"""Solver progress telemetry (utils/flight_recorder.ProgressReporter).
+
+Pins the ISSUE-9 streaming-solve observability contract:
+
+1. Every streaming solve gets an always-on journey record in the solver
+   flight recorder: units/rows done, rates, ETA (when the total is
+   known), checkpoint age, structured progress events, and the
+   environment fingerprint (so bench_watch can refuse cross-backend
+   comparisons).
+2. A solve that dies mid-fit force-dumps the solver recorder and the
+   journey names the last completed unit — for both chunked-LSQ paths
+   and the streamed BCD.
+3. The per-solve watchdog turns a stalled solve into a counter bump plus
+   an auto-dump, then keeps quiet once progress resumes or the solve
+   finishes.
+4. ``solver_stats()`` is the live health surface and is served at the
+   metrics server's ``/solves`` endpoint.
+5. Progress reporting never perturbs solve RESULTS (bit-identity with a
+   plain solve is covered by the solver equivalence suites, which now
+   run over the instrumented paths).
+"""
+
+import glob
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from keystone_tpu.config import config
+from keystone_tpu.utils import flight_recorder
+from keystone_tpu.utils.flight_recorder import (
+    FlightRecorder,
+    ProgressReporter,
+    SolveRecord,
+    solver_stats,
+)
+from keystone_tpu.utils.metrics import metrics_registry, reliability_counters
+
+
+@pytest.fixture
+def solver_dir(tmp_path, monkeypatch):
+    """Route the process solver recorder's dumps at a tmpdir."""
+    monkeypatch.setattr(config, "flight_dir", str(tmp_path))
+    flight_recorder.reset_solver_recorder()
+    yield str(tmp_path)
+    flight_recorder.reset_solver_recorder()
+
+
+def _solver_dumps(d):
+    return sorted(glob.glob(os.path.join(d, "keystone_flight_solver_*")))
+
+
+def _journeys(dump_path, kind):
+    doc = json.load(open(dump_path))
+    return [r for r in doc["records"] if r.get("kind") == kind]
+
+
+# ---------------------------------------------------------------------------
+# ProgressReporter unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_reporter_tracks_progress_and_events(tmp_path):
+    rec = FlightRecorder("solver-test", capacity=16, directory=str(tmp_path))
+    rep = ProgressReporter("unit_test", total_units=10, recorder=rec,
+                           watchdog_ms=0, progress_every=2)
+    with rep:
+        for i in range(6):
+            rep.unit_done(rows=100, block=i)
+        rep.checkpoint()
+    s = rep.stats()
+    assert s["units_done"] == 6 and s["rows_done"] == 600
+    assert s["outcome"] == "ok"
+    assert s["eta_s"] is not None and s["eta_s"] >= 0
+    assert s["checkpoint_unit"] == 6 and s["checkpoint_age_s"] >= 0
+    snap = rec.snapshot()
+    (journey,) = snap["records"]
+    # progress_every=2 thins the event ring: units 2, 4, 6.
+    assert [e["unit"] for e in journey["events"]] == [2, 4, 6]
+    assert journey["events"][-1]["block"] == 5
+    assert journey["fingerprint"]["backend"] == "cpu"
+    assert journey["outcome"] == "ok"
+
+
+def test_reporter_failure_dumps_naming_last_unit(tmp_path):
+    rec = FlightRecorder("solver-test", capacity=16, directory=str(tmp_path))
+    with pytest.raises(RuntimeError):
+        with ProgressReporter("unit_test", recorder=rec, watchdog_ms=0) as rep:
+            rep.unit_done(rows=10)
+            rep.unit_done(rows=10)
+            raise RuntimeError("boom")
+    dumps = sorted(glob.glob(os.path.join(str(tmp_path), "*solver-test*")))
+    assert dumps, "failure must force-dump the recorder"
+    (journey,) = _journeys(dumps[-1], "unit_test")
+    assert journey["units_done"] == 2
+    assert journey["outcome"] == "error:RuntimeError"
+    errors = json.load(open(dumps[-1]))["errors"]
+    assert any(e["kind"] == "solve_death" for e in errors)
+
+
+def test_reporter_finish_is_idempotent_and_unregisters(tmp_path):
+    rec = FlightRecorder("solver-test", capacity=16, directory=str(tmp_path))
+    rep = ProgressReporter("unit_test", recorder=rec, watchdog_ms=0)
+    assert any(s["id"] == rep.rid for s in solver_stats()["solves"])
+    rep.finish()
+    rep.finish()
+    rep.fail(RuntimeError("late"))  # after finish: no-op, no dump
+    assert not any(s["id"] == rep.rid for s in solver_stats()["solves"])
+    assert rep.stats()["outcome"] == "ok"
+    assert not glob.glob(os.path.join(str(tmp_path), "*solver-test*"))
+
+
+def test_watchdog_stall_dumps_then_heals(tmp_path):
+    rec = FlightRecorder("solver-test", capacity=16, directory=str(tmp_path))
+    before = reliability_counters.get("solve_stalls")
+    rep = ProgressReporter("stall_test", recorder=rec, watchdog_ms=150)
+    try:
+        rep.unit_done(rows=1)
+        deadline = time.monotonic() + 5
+        while rec.stats()["dumps_total"] == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert rec.stats()["dumps_total"] >= 1, "stall must auto-dump"
+        assert reliability_counters.get("solve_stalls") > before
+        assert metrics_registry.counters("solver.events").get(
+            "stall_test_stalls"
+        ) >= 1
+        assert rep.stats()["stalls"] >= 1
+        # The stall re-arm must NOT falsify the health surface: the
+        # journey still reports the true time since real progress
+        # (>= the watchdog window), not the watchdog's fire time.
+        assert rep.stats()["last_progress_age_s"] >= 0.15
+    finally:
+        rep.finish()
+    # The watchdog thread exits promptly once finished.
+    rep._watchdog.join(timeout=2)
+    assert not rep._watchdog.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# Solver integration
+# ---------------------------------------------------------------------------
+
+
+def _xy(rng, n=64, d=8, k=3):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    Y = (X @ rng.normal(size=(d, k))).astype(np.float32)
+    return X, Y
+
+
+@pytest.mark.parametrize("depth", [0, 2])
+def test_chunked_solve_records_journey(rng, solver_dir, depth):
+    from keystone_tpu.linalg import solve_least_squares_chunked
+    from keystone_tpu.loaders.stream import BatchIterator
+
+    X, Y = _xy(rng)
+    solve_least_squares_chunked(
+        BatchIterator.from_arrays(X, Y, batch_rows=16), lam=1e-3,
+        prefetch_depth=depth,
+    )
+    snap = flight_recorder.solver_recorder().snapshot()
+    journeys = [r for r in snap["records"] if r["kind"] == "lsq_chunked"]
+    assert journeys and journeys[-1]["outcome"] == "ok"
+    assert journeys[-1]["units_done"] == 4
+    assert journeys[-1]["rows_done"] == 64
+    assert journeys[-1]["fingerprint"]["device_count"] == 8
+
+
+@pytest.mark.parametrize("depth", [0, 2])
+def test_chunked_solve_death_dumps_last_chunk(rng, solver_dir, depth):
+    from keystone_tpu.linalg import solve_least_squares_chunked
+
+    X, Y = _xy(rng)
+
+    def dying():
+        for i in range(4):
+            if i == 2:
+                raise RuntimeError("injected death")
+            yield (X[i * 16:(i + 1) * 16], Y[i * 16:(i + 1) * 16])
+
+    with pytest.raises(RuntimeError):
+        solve_least_squares_chunked(dying(), lam=1e-3, prefetch_depth=depth)
+    dumps = _solver_dumps(solver_dir)
+    assert dumps, "mid-solve death must dump the solver recorder"
+    journeys = _journeys(dumps[-1], "lsq_chunked")
+    assert journeys[-1]["units_done"] == 2
+    assert journeys[-1]["outcome"].startswith("error:")
+
+
+def test_streamed_bcd_journey_has_total_and_checkpoints(
+    rng, solver_dir, tmp_path
+):
+    from keystone_tpu.linalg import block_coordinate_descent_streamed
+    from keystone_tpu.linalg.row_matrix import RowMatrix
+
+    n, d, k = 64, 32, 3
+    A = rng.normal(size=(n, d)).astype(np.float32)
+    B = rng.normal(size=(n, k)).astype(np.float32)
+    ckpt = tmp_path / "bcd_ckpt"
+    block_coordinate_descent_streamed(
+        A, RowMatrix.from_array(B), block_size=8, num_iters=2, lam=0.1,
+        checkpoint_dir=str(ckpt), checkpoint_every=3,
+    )
+    snap = flight_recorder.solver_recorder().snapshot()
+    journeys = [r for r in snap["records"] if r["kind"] == "bcd_streamed"]
+    assert journeys and journeys[-1]["outcome"] == "ok"
+    # 2 epochs x 4 blocks, total known up front -> ETA was available.
+    assert journeys[-1]["units_done"] == 8
+    assert journeys[-1]["total_units"] == 8
+    assert journeys[-1]["checkpoint_unit"] is not None
+    assert journeys[-1]["events"]
+
+
+# ---------------------------------------------------------------------------
+# Health surface / metrics server
+# ---------------------------------------------------------------------------
+
+
+def test_solver_stats_shape(tmp_path):
+    rec = FlightRecorder("solver-test", capacity=8, directory=str(tmp_path))
+    rep = ProgressReporter("surface_test", total_units=4, recorder=rec,
+                           watchdog_ms=0)
+    try:
+        rep.unit_done(rows=5)
+        stats = solver_stats()
+        assert stats["active_solves"] >= 1
+        mine = [s for s in stats["solves"] if s["id"] == rep.rid]
+        assert mine and mine[0]["units_done"] == 1
+        assert mine[0]["kind"] == "surface_test"
+    finally:
+        rep.finish()
+
+
+def test_metrics_server_serves_solves_endpoint(tmp_path):
+    import importlib
+    import sys
+    import urllib.request
+
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools"),
+    )
+    try:
+        metrics_server = importlib.import_module("metrics_server")
+    finally:
+        sys.path.pop(0)
+    rec = FlightRecorder("solver-test", capacity=8, directory=str(tmp_path))
+    rep = ProgressReporter("endpoint_test", recorder=rec, watchdog_ms=0)
+    server = metrics_server.MetricsServer(port=0).start()
+    try:
+        rep.unit_done(rows=7)
+        with urllib.request.urlopen(server.url("/solves"), timeout=10) as r:
+            assert r.status == 200
+            doc = json.loads(r.read().decode())
+        assert doc["active_solves"] >= 1
+        assert any(s["kind"] == "endpoint_test" for s in doc["solves"])
+    finally:
+        server.stop()
+        rep.finish()
+
+
+def test_solve_record_serializes_whole(tmp_path):
+    rec = SolveRecord(7, "shape_test", total_units=3,
+                      fingerprint={"backend": "cpu"})
+    d = rec.as_dict()
+    assert d["id"] == 7 and d["kind"] == "shape_test"
+    assert d["total_units"] == 3 and d["units_done"] == 0
+    assert d["outcome"] is None and d["events"] == []
+    json.dumps(d)  # must be JSON-serializable for the dump path

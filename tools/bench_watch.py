@@ -1,0 +1,486 @@
+#!/usr/bin/env python3
+"""bench-watch: the bench regression sentinel (``make bench-watch``).
+
+The repo's bench history — ``BENCH_r*.json`` round snapshots,
+``MULTICHIP_r*.json`` dryrun verdicts, and the ``BENCH_serve.json`` JSONL
+rows — has so far been an archive: every PR appends fingerprinted
+evidence, nothing reads it back. This tool turns the trajectory into a
+GATE: it parses every history row, fits a per-metric noise band from the
+recorded runs, and exits nonzero with a named-metric report when the
+LATEST row of any series regresses outside its band.
+
+Pure stdlib (json/glob/statistics): no jax import, so it runs anywhere —
+CI, a laptop, the TPU host — in milliseconds.
+
+How a series is judged
+----------------------
+
+- Every numeric leaf of every row becomes a series
+  ``<file-family>:<metric>:<dotted.path>`` (booleans too — a gate flag
+  that flips true→false is a regression by definition).
+- Only fingerprint-COMPATIBLE history feeds a band: rows recorded under
+  a different backend, device count, or host core count than the latest
+  row are excluded (and reported as skipped), so a TPU round can never
+  flag a CPU round as a regression — the refusal the
+  ``environment_fingerprint`` provenance blocks exist for.
+- The band over history values ``h``: ``[min(h), max(h)]`` widened by a
+  relative margin ``max(BASE_MARGIN, CV_K * cv(h))`` — noisier series
+  earn wider bands, quiet ones stay tight.
+- Direction comes from the leaf name (and the row's ``unit`` field):
+  latency-like leaves (``*_ms``, ``p99``, ``seconds``, ``wall``…)
+  regress ABOVE the band; throughput-like leaves (``tflops``,
+  ``rows_per_s``, ``speedup``…) regress BELOW it. Leaves matching
+  neither list are tracked but never gated (reported as unjudged).
+- A series with no comparable history passes vacuously: the sentinel
+  gates the trajectory, it cannot invent a baseline.
+
+Blessing an intentional change
+------------------------------
+
+A real perf trade (e.g. a latency increase bought for throughput) is
+recorded, not reverted: ``--bless 'SERIES' --why 'reason'`` writes the
+series' current latest value into ``tools/bench_watch_bless.json``; the
+gate then waives that series while its latest value stays within
+``BLESS_TOL`` of the blessed value. Once enough post-change history
+accumulates, delete the entry — the band has re-fit around the new
+regime.
+
+Usage:
+    python tools/bench_watch.py [--root DIR] [--json] [--verbose]
+    python tools/bench_watch.py --bless SERIES --why "reason"
+
+Exit status: 0 = no regression, 1 = regression(s) (named on stderr),
+2 = usage / unreadable history.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import re
+import statistics
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BLESS_FILE = os.path.join("tools", "bench_watch_bless.json")
+
+#: Base relative noise margin on every band; a 2x move always breaches.
+BASE_MARGIN = 0.5
+#: Widening per coefficient of variation of the history (noisy series
+#: earn wider bands).
+CV_K = 3.0
+#: Hard cap so even a wildly noisy series still catches a 2x regression.
+MAX_MARGIN = 0.9
+#: A blessed series stays waived while its latest value is within this
+#: relative distance of the blessed value.
+BLESS_TOL = 0.1
+
+#: Leaf-name fragments that mark a lower-is-better series (latency,
+#: durations, overheads).
+LOWER_BETTER = (
+    "latency", "p50_", "p95_", "p99_", "_ms", "ms_", "seconds", "wall",
+    "overhead", "expired", "dropped", "stalls", "deaths", "residual",
+)
+#: Leaf-name fragments that mark a higher-is-better series (rates,
+#: speedups, utilization).
+HIGHER_BETTER = (
+    "tflops", "throughput", "per_s", "per_sec", "speedup", "img_per",
+    "rows_per", "mfu",
+)
+
+
+def _leaf_direction(path: str, unit: Optional[str]) -> Optional[str]:
+    """'lower' / 'higher' / None (unjudged) for a dotted leaf path."""
+    leaf = path.lower()
+    if unit:
+        u = unit.lower()
+        if any(k in u for k in HIGHER_BETTER):
+            if leaf.endswith(("value", "vs_baseline")):
+                return "higher"
+        if ("ms" in u or "second" in u) and leaf.endswith("value"):
+            return "lower"
+    for frag in LOWER_BETTER:
+        if frag in leaf:
+            return "lower"
+    for frag in HIGHER_BETTER:
+        if frag in leaf:
+            return "higher"
+    return None
+
+
+def _flatten(obj: Any, prefix: str = "") -> List[Tuple[str, Any]]:
+    """Numeric/bool leaves of a JSON row as (dotted path, value)."""
+    out: List[Tuple[str, Any]] = []
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            key = f"{prefix}.{k}" if prefix else str(k)
+            out.extend(_flatten(v, key))
+    elif isinstance(obj, bool):
+        out.append((prefix, obj))
+    elif isinstance(obj, (int, float)) and not (
+        isinstance(obj, float) and (math.isnan(obj) or math.isinf(obj))
+    ):
+        out.append((prefix, obj))
+    return out
+
+
+class Observation:
+    """One history row's reading of one series."""
+
+    __slots__ = ("order", "value", "fingerprint", "source")
+
+    def __init__(self, order: int, value: Any, fingerprint: dict,
+                 source: str):
+        self.order = order
+        self.value = value
+        self.fingerprint = fingerprint
+        self.source = source
+
+
+def _fingerprint_of(row: dict) -> Dict[str, Any]:
+    """The comparability key of a bench row: backend / device count /
+    host cores, from wherever this row family records them. Missing
+    keys are wildcards (old rows predate the fingerprint satellite)."""
+    env = row.get("env") or {}
+    detail = row.get("detail") or {}
+    fp = {
+        "backend": row.get("backend") or env.get("backend"),
+        "device_count": (
+            env.get("device_count") or detail.get("devices")
+            or row.get("n_devices")
+        ),
+        "host_cores": row.get("host_cores") or env.get("cpu_count"),
+    }
+    return fp
+
+
+def _compatible(a: dict, b: dict) -> bool:
+    """Two fingerprints are comparable when no KNOWN key disagrees."""
+    for k in ("backend", "device_count", "host_cores"):
+        if a.get(k) is not None and b.get(k) is not None \
+                and a[k] != b[k]:
+            return False
+    return True
+
+
+def _fp_str(fp: dict) -> str:
+    return "/".join(
+        f"{k}={fp.get(k)}" for k in ("backend", "device_count", "host_cores")
+        if fp.get(k) is not None
+    ) or "unfingerprinted"
+
+
+# ---------------------------------------------------------------------------
+# History loaders — one per row family
+# ---------------------------------------------------------------------------
+
+
+def _round_files(root: str, pattern: str) -> List[Tuple[int, str]]:
+    """(round, path) pairs of numbered history files, ascending."""
+    out = []
+    for path in glob.glob(os.path.join(root, pattern)):
+        m = re.search(r"_r(\d+)\.json$", path)
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def load_series(
+    root: str,
+) -> Tuple[Dict[str, List[Observation]], Dict[str, str]]:
+    """Every series in the repo's bench history, keyed
+    ``family:metric:path``, observations in chronological order — plus
+    the recorded ``unit`` per series where the row family carries one
+    (the bench rows' TFLOPS/ms units drive direction for the bare
+    ``value`` leaf)."""
+    series: Dict[str, List[Observation]] = {}
+    units: Dict[str, str] = {}
+
+    def add(family: str, metric: str, order: int, row: dict, source: str,
+            unit: Optional[str] = None):
+        fp = _fingerprint_of(row)
+        for path, value in _flatten(row):
+            # Provenance/env numbers are identity, not performance.
+            if path.startswith(("env.", "keystone_env.", "detail.n",
+                               "detail.d", "detail.k")):
+                continue
+            key = f"{family}:{metric}:{path}"
+            series.setdefault(key, []).append(
+                Observation(order, value, fp, source)
+            )
+            if unit:
+                units[key] = unit
+
+    for rnd, path in _round_files(root, "BENCH_r*.json"):
+        try:
+            doc = json.load(open(path))
+        except (OSError, json.JSONDecodeError) as e:
+            raise RuntimeError(f"unreadable history row {path}: {e}")
+        parsed = doc.get("parsed")
+        if not isinstance(parsed, dict):
+            continue  # a round that produced no machine row gates nothing
+        add("bench", str(parsed.get("metric", "unknown")), rnd, parsed,
+            os.path.basename(path), unit=parsed.get("unit"))
+
+    for rnd, path in _round_files(root, "MULTICHIP_r*.json"):
+        try:
+            doc = json.load(open(path))
+        except (OSError, json.JSONDecodeError) as e:
+            raise RuntimeError(f"unreadable history row {path}: {e}")
+        if doc.get("skipped"):
+            continue
+        row = {k: doc.get(k) for k in ("ok", "rc", "n_devices")}
+        add("multichip", "dryrun", rnd, row, os.path.basename(path))
+
+    serve_path = os.path.join(root, "BENCH_serve.json")
+    if os.path.exists(serve_path):
+        with open(serve_path) as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError as e:
+                    raise RuntimeError(
+                        f"unreadable history row {serve_path}:{i + 1}: {e}"
+                    )
+                add("serve", str(row.get("metric", "unknown")), i, row,
+                    f"BENCH_serve.json:{i + 1}")
+
+    return series, units
+
+
+# ---------------------------------------------------------------------------
+# Band fitting and judgement
+# ---------------------------------------------------------------------------
+
+
+def judge_series(key: str, obs: List[Observation],
+                 blessed: Dict[str, dict],
+                 unit: Optional[str] = None) -> Dict[str, Any]:
+    """One series' verdict: ``ok`` / ``regression`` /
+    ``unjudged`` / ``no_history`` / ``blessed``, with the band and the
+    history that fit it."""
+    latest = obs[-1]
+    metric_path = key.split(":", 2)[2]
+    direction = _leaf_direction(metric_path, unit)
+    verdict: Dict[str, Any] = {
+        "series": key,
+        "latest": latest.value,
+        "latest_source": latest.source,
+        "fingerprint": _fp_str(latest.fingerprint),
+        "direction": direction,
+    }
+    history = [
+        o for o in obs[:-1]
+        if _compatible(o.fingerprint, latest.fingerprint)
+    ]
+    skipped = len(obs) - 1 - len(history)
+    if skipped:
+        verdict["skipped_incompatible"] = skipped
+    bless = blessed.get(key)
+    if isinstance(latest.value, bool) or all(
+        isinstance(o.value, bool) for o in obs
+    ):
+        # Boolean gate: true→false is a regression, everything else ok.
+        # The blessed waiver applies here too (a flag held false during a
+        # known outage must be blessable like any other series).
+        held = any(o.value is True for o in history)
+        if held and latest.value is False:
+            if bless is not None and _within(latest.value,
+                                             bless.get("value"), BLESS_TOL):
+                verdict["status"] = "blessed"
+                verdict["blessed_why"] = bless.get("why", "")
+            else:
+                verdict["status"] = "regression"
+                verdict["reason"] = "gate flag flipped true -> false"
+        else:
+            verdict["status"] = "ok" if history else "no_history"
+        return verdict
+    if not history:
+        verdict["status"] = "no_history"
+        return verdict
+    values = [float(o.value) for o in history]
+    lo, hi = min(values), max(values)
+    mean = statistics.fmean(values)
+    cv = 0.0
+    if len(values) >= 3 and mean:
+        cv = statistics.pstdev(values) / abs(mean)
+    margin = min(MAX_MARGIN, max(BASE_MARGIN, CV_K * cv))
+    verdict["band"] = {
+        "lo": lo, "hi": hi, "n": len(values),
+        "margin": round(margin, 4),
+    }
+    if direction is None:
+        verdict["status"] = "unjudged"
+        return verdict
+    if bless is not None and _within(latest.value, bless.get("value"),
+                                     BLESS_TOL):
+        verdict["status"] = "blessed"
+        verdict["blessed_why"] = bless.get("why", "")
+        return verdict
+    latest_v = float(latest.value)
+    if direction == "lower":
+        limit = hi * (1.0 + margin) if hi >= 0 else hi * (1.0 - margin)
+        if latest_v > limit:
+            verdict["status"] = "regression"
+            verdict["reason"] = (
+                f"{latest_v:g} above noise band (history max {hi:g} "
+                f"* {1 + margin:.2f} = {limit:g}, n={len(values)})"
+            )
+            return verdict
+    else:
+        limit = lo * (1.0 - margin) if lo >= 0 else lo * (1.0 + margin)
+        if latest_v < limit:
+            verdict["status"] = "regression"
+            verdict["reason"] = (
+                f"{latest_v:g} below noise band (history min {lo:g} "
+                f"* {1 - margin:.2f} = {limit:g}, n={len(values)})"
+            )
+            return verdict
+    verdict["status"] = "ok"
+    return verdict
+
+
+def _within(a, b, tol: float) -> bool:
+    if a is None or b is None:
+        return False
+    a, b = float(a), float(b)
+    scale = max(abs(a), abs(b), 1e-12)
+    return abs(a - b) / scale <= tol
+
+
+def load_bless(root: str) -> Dict[str, dict]:
+    path = os.path.join(root, BLESS_FILE)
+    if not os.path.exists(path):
+        return {}
+    doc = json.load(open(path))
+    return {e["series"]: e for e in doc.get("entries", [])}
+
+
+def run(root: str) -> Dict[str, Any]:
+    """Judge every series; the verdict dict the CLI prints/gates on."""
+    series, units = load_series(root)
+    blessed = load_bless(root)
+    verdicts = [
+        judge_series(key, obs, blessed, unit=units.get(key))
+        for key, obs in sorted(series.items())
+    ]
+    by_status: Dict[str, int] = {}
+    for v in verdicts:
+        by_status[v["status"]] = by_status.get(v["status"], 0) + 1
+    regressions = [v for v in verdicts if v["status"] == "regression"]
+    return {
+        "metric": "bench_watch",
+        "series": len(verdicts),
+        "by_status": by_status,
+        "regressions": regressions,
+        "verdicts": verdicts,
+        "ok": not regressions,
+    }
+
+
+def bless(root: str, series_key: str, why: str) -> dict:
+    """Record the series' current latest value as intentionally accepted."""
+    series, _units = load_series(root)
+    if series_key not in series:
+        raise KeyError(
+            f"unknown series {series_key!r}; run without --bless to list"
+        )
+    latest = series[series_key][-1]
+    path = os.path.join(root, BLESS_FILE)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    doc = {"version": 1, "entries": []}
+    if os.path.exists(path):
+        doc = json.load(open(path))
+    entries = [e for e in doc.get("entries", [])
+               if e.get("series") != series_key]
+    entry = {
+        "series": series_key,
+        "value": latest.value,
+        "source": latest.source,
+        "why": why,
+    }
+    entries.append(entry)
+    doc["entries"] = entries
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    os.replace(tmp, path)
+    return entry
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="bench regression sentinel over the checked-in "
+                    "bench history"
+    )
+    ap.add_argument("--root", default=REPO_ROOT,
+                    help="repo root holding the BENCH_*/MULTICHIP_* history")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="full machine-readable verdict")
+    ap.add_argument("--verbose", action="store_true",
+                    help="print every series verdict, not just regressions")
+    ap.add_argument("--bless", metavar="SERIES", default=None,
+                    help="accept SERIES' current latest value as an "
+                         "intentional change (records it in "
+                         f"{BLESS_FILE})")
+    ap.add_argument("--why", default="",
+                    help="justification recorded with --bless")
+    args = ap.parse_args(argv)
+
+    if args.bless:
+        if not args.why:
+            print("bench-watch: --bless requires --why", file=sys.stderr)
+            return 2
+        try:
+            entry = bless(args.root, args.bless, args.why)
+        except (KeyError, RuntimeError) as e:
+            print(f"bench-watch: {e}", file=sys.stderr)
+            return 2
+        print(json.dumps({"blessed": entry}))
+        return 0
+
+    try:
+        result = run(args.root)
+    except RuntimeError as e:
+        print(f"bench-watch: {e}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps(result))
+    else:
+        print(json.dumps({k: result[k] for k in
+                          ("metric", "series", "by_status", "ok")}))
+    shown = result["verdicts"] if args.verbose else result["regressions"]
+    for v in shown:
+        line = f"{v['status'].upper():<11} {v['series']}"
+        if v.get("reason"):
+            line += f" — {v['reason']}"
+        if v.get("skipped_incompatible"):
+            line += (f" [{v['skipped_incompatible']} row(s) skipped: "
+                     f"fingerprint != {v['fingerprint']}]")
+        print(line, file=sys.stderr)
+    if result["regressions"]:
+        print(
+            f"bench-watch: {len(result['regressions'])} regression(s) "
+            "— re-run the bench, or bless an intentional change with "
+            "--bless SERIES --why '...'",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"bench-watch: PASS ({result['series']} series, "
+        f"{result['by_status']})", file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,8 +20,9 @@ Rule catalog (KL = Keystone Lint):
   Conditions constructed over a shared Lock alias to it.
 - ``KL003 env-read`` — ``os.environ``/``os.getenv`` outside config.py:
   env knobs are read once at config import, never on hot paths.
-- ``KL004 resolve-once`` — ``active_plan()``/``active_tracer()`` called
-  inside a loop body: resolve once per stream/solve/service.
+- ``KL004 resolve-once`` — ``active_plan()``/``active_tracer()``/
+  ``active_profile()`` called inside a loop body: resolve once per
+  stream/solve/service/execution walk.
 - ``KL005 wall-clock-timing`` — ``time.time()`` in library code: spans
   and latencies use ``perf_counter``; wall-clock survivors carry a tag.
 - ``KL006 broad-except`` — an ``except Exception/BaseException`` must
@@ -78,7 +79,7 @@ DISPATCH_METHODS = {"submit", "_loop", "_dispatch", "_pick_slot_locked",
 #: name so lock discipline covers them from day one — a watchdog that
 #: mutates service state outside the lock must be a finding, not a blind
 #: spot behind an indirect spawn.
-KNOWN_THREAD_TARGETS = {"_watchdog_loop", "_watch_loop"}
+KNOWN_THREAD_TARGETS = {"_watchdog_loop", "_watch_loop", "_solve_watch_loop"}
 HOST_SYNC_CALLS = {"block_until_ready", "device_get", "asarray", "array"}
 
 #: Mutating method names treated as writes for KL001 (deque/list/set/dict
@@ -571,7 +572,8 @@ def _check_file_rules(tree: ast.Module, path: str, lines: List[str],
                         "os.getenv outside config.py",
                         hint="route through config.py",
                     ))
-            if leaf in ("active_plan", "active_tracer") and self.loop_depth:
+            if leaf in ("active_plan", "active_tracer",
+                        "active_profile") and self.loop_depth:
                 if not _suppressed(lines, node.lineno, "KL004"):
                     findings.append(Finding(
                         "KL004", path, node.lineno,

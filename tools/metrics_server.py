@@ -11,7 +11,12 @@ threaded HTTP server exposing
 - ``/healthz`` — JSON health backed by ``PipelineService.stats()``:
   HTTP 200 while the dispatcher is alive and the service is open, 503
   once the worker died or the service closed — the load-balancer /
-  kubelet probe shape.
+  kubelet probe shape;
+- ``/solves`` — the streaming-solve health surface
+  (``utils.flight_recorder.solver_stats``): per-solve units/rows done,
+  rows/s, ETA, checkpoint age, and stall counts for every in-flight
+  ``solve_least_squares_chunked`` / ``block_coordinate_descent_streamed``
+  journey, so an hour-scale fit is pollable mid-flight.
 
 Port comes from ``KEYSTONE_METRICS_PORT`` (``config.metrics_port``);
 0 binds an ephemeral port (the smoke default — the chosen port is
@@ -72,6 +77,14 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if self.path.split("?")[0] == "/solves":
+            body = json.dumps(owner.solves()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         self.send_response(404)
         self.end_headers()
 
@@ -107,6 +120,14 @@ class MetricsServer:
 
     def render_metrics(self) -> str:
         return self.registry.prometheus()
+
+    def solves(self) -> dict:
+        """The streaming-solve health surface for /solves: every
+        in-flight solve's progress (units, rows/s, ETA, checkpoint age,
+        stalls) plus the solver flight recorder's ring/dump summary."""
+        from keystone_tpu.utils.flight_recorder import solver_stats
+
+        return solver_stats()
 
     def health(self):
         """(healthy, body) for /healthz. Never raises: a health endpoint
@@ -228,6 +249,8 @@ def run_smoke(port: Optional[int] = None, requests: int = 24) -> dict:
         )
         h_status, h_body = _fetch(server.url("/healthz"))
         health = json.loads(h_body)
+        s_status, s_body = _fetch(server.url("/solves"))
+        solves = json.loads(s_body)
         svc.close()
         h2_status, h2_body = _fetch(server.url("/healthz"))
         health_closed = json.loads(h2_body)
@@ -242,6 +265,7 @@ def run_smoke(port: Optional[int] = None, requests: int = 24) -> dict:
             "ok_count_snapshot": ok_snap,
             "healthz_status": h_status,
             "healthz_closed_status": h2_status,
+            "solves_status": s_status,
             "pass": {
                 "metrics_200": m_status == 200,
                 "prometheus_valid": not prom_errors,
@@ -251,6 +275,8 @@ def run_smoke(port: Optional[int] = None, requests: int = 24) -> dict:
                 and health.get("healthy") is True,
                 "healthz_503_after_close": h2_status == 503
                 and health_closed.get("healthy") is False,
+                "solves_200_json": s_status == 200
+                and "active_solves" in solves,
             },
         }
         result["ok"] = all(result["pass"].values())

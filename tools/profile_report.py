@@ -1,0 +1,247 @@
+"""Render / demo the per-node resource-attribution profile.
+
+The training-side half of observability (the serving half is PR-7's
+trace_report + metrics server): ``utils.metrics.ResourceProfile``
+attributes wall time, device wait, cost-model FLOPs/bytes (from the
+memoized compiled ``cost_analysis``/``memory_analysis``), output nbytes,
+and HBM high-water deltas to every pipeline node an executor walk runs.
+This CLI renders a profile export as the trace_report-style attribution
+table — the SAME renderer ``tools/trace_report.py --fit`` uses over a
+Chrome trace, so a live profile and a trace of the same fit read
+identically.
+
+Modes:
+
+    python tools/profile_report.py PROFILE.json [--top N]
+        Render a ``ResourceProfile.export()`` JSON file. Exit 1 on a
+        schema-valid-but-empty profile (a dead profiler must fail
+        loudly, not print a clean empty table — the trace_report rule).
+
+    python tools/profile_report.py --demo [--out PROFILE.json]
+        The ``make profile-demo`` smoke, also run in-process by tier-1
+        (tests/test_profile.py): a small fit + apply of a canonical
+        fused pipeline under the profiler, gated on
+
+        - every executed node producing an attribution row with nonzero
+          wall time;
+        - the solve node's cost-model FLOPs within 2x of the
+          ``achieved_tflops`` oracle for the same computation;
+        - KEYSTONE_PROFILE=0 outputs bit-identical to profiled ones
+          (the profiler measures, never perturbs);
+        - a kill-mid-solve chaos run auto-dumping a flight-recorder
+          journey that names the last completed chunk;
+        - the registry's Prometheus exposition (now carrying the
+          keystone_profile_node_* families) still validating.
+
+Exit status: 0 = rendered / all demo gates green, 1 = failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def render(doc: dict, top: int = 0) -> str:
+    """The attribution table of an exported profile document."""
+    from keystone_tpu.utils.metrics import render_attribution_table
+
+    rows = doc.get("rows", [])
+    if top > 0:
+        rows = rows[:top]
+    return render_attribution_table(rows)
+
+
+def run_demo(out_path: str | None = None) -> dict:
+    """The profile-demo flow; returns the verdict dict (``ok`` + every
+    gate). Uses fresh PipelineEnvs so both runs really execute."""
+    import glob
+    import tempfile
+
+    import numpy as np
+
+    from keystone_tpu.config import config
+    from keystone_tpu.linalg import solve_least_squares_chunked
+    from keystone_tpu.nodes.learning.linear_mapper import LinearMapEstimator
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+    from keystone_tpu.nodes.stats.scalers import StandardScaler
+    from keystone_tpu.utils import flight_recorder
+    from keystone_tpu.utils.metrics import (
+        achieved_tflops,
+        metrics_registry,
+        profile_scope,
+        resource_profile,
+        validate_prometheus_text,
+    )
+    from keystone_tpu.workflow.executor import PipelineEnv
+    from keystone_tpu.workflow.pipeline import FusedTransformer
+
+    rng = np.random.default_rng(0)
+    n, d, k = 256, 32, 4
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    Y = (X @ rng.normal(size=(d, k))).astype(np.float32)
+
+    def build():
+        feats = StandardScaler().with_data(X).and_then(L2Normalizer())
+        return feats.and_then(LinearMapEstimator(lam=1e-3), X, Y)
+
+    # Reference run, profiler OFF (KEYSTONE_PROFILE=0 semantics).
+    PipelineEnv.reset()
+    baseline = build().fit().apply(X).get()
+    baseline_bytes = np.asarray(baseline).tobytes()
+
+    # Profiled run: fresh env so every node really executes.
+    PipelineEnv.reset()
+    resource_profile.reset()
+    with profile_scope():
+        fitted = build().fit()
+        profiled = fitted.apply(X).get()
+    profiled_bytes = np.asarray(profiled).tobytes()
+
+    rows = resource_profile.rows()
+    by_node = {r["node"]: r for r in rows}
+    executed = [r for r in rows if r["executed"] > 0]
+
+    # The solve node: the (possibly fused) transformer program containing
+    # the fitted LinearMapper, executed by the apply.
+    solve_rows = [r for r in rows if "LinearMapper" in r["node"]
+                  and r["executed"] > 0 and r["flops"]]
+    flops_ratio = None
+    if solve_rows:
+        solve_row = solve_rows[0]
+        chain = fitted.transformers()
+        fused = chain[0] if len(chain) == 1 else FusedTransformer(chain)
+        oracle = achieved_tflops(fused.apply_batch, X)
+        per_call = solve_row["flops"] / max(1, solve_row["executed"])
+        if oracle["flops"] > 0:
+            flops_ratio = per_call / oracle["flops"]
+
+    # Kill-mid-solve chaos: a producer that dies at chunk 3 must leave a
+    # solver flight-recorder dump naming the last completed chunk.
+    tmp = tempfile.mkdtemp(prefix="keystone_profile_demo_")
+    prior_dir = config.flight_dir
+    died_at = 3
+
+    def dying_stream():
+        for i in range(8):
+            if i == died_at:
+                raise RuntimeError("injected mid-solve death")
+            yield (X[i * 32:(i + 1) * 32], Y[i * 32:(i + 1) * 32])
+
+    death_seen = False
+    last_chunk = None
+    dump_outcome = None
+    # try/finally: the demo runs in-process under tier-1 — a leaked
+    # flight_dir override would contaminate every later test's dumps.
+    try:
+        config.flight_dir = tmp
+        flight_recorder.reset_solver_recorder()
+        try:
+            solve_least_squares_chunked(dying_stream(), lam=1e-3,
+                                        prefetch_depth=0)
+        except RuntimeError:
+            death_seen = True
+        dumps = sorted(
+            glob.glob(os.path.join(tmp, "keystone_flight_solver_*"))
+        )
+        if dumps:
+            dump_doc = json.load(open(dumps[-1]))
+            for rec in dump_doc.get("records", []):
+                if rec.get("kind") == "lsq_chunked":
+                    last_chunk = rec.get("units_done")
+                    dump_outcome = rec.get("outcome")
+    finally:
+        config.flight_dir = prior_dir
+        flight_recorder.reset_solver_recorder()
+
+    prom_errors = validate_prometheus_text(metrics_registry.prometheus())
+
+    result = {
+        "metric": "profile_demo",
+        "nodes": len(rows),
+        "executed_nodes": len(executed),
+        "node_labels": sorted(by_node),
+        "solve_node": solve_rows[0]["node"] if solve_rows else None,
+        "flops_ratio_vs_oracle": (
+            round(flops_ratio, 4) if flops_ratio is not None else None
+        ),
+        "chaos_dump": dumps[-1] if dumps else None,
+        "chaos_last_chunk": last_chunk,
+        "pass": {
+            "every_executed_node_has_nonzero_wall": bool(executed) and all(
+                r["wall_ms"] > 0 for r in executed
+            ),
+            "fit_and_apply_nodes_covered": any(
+                r["node"].endswith(".fit") for r in rows
+            ) and bool(solve_rows) and "Dataset" in by_node,
+            "solve_flops_within_2x_oracle": (
+                flops_ratio is not None and 0.5 <= flops_ratio <= 2.0
+            ),
+            "profile_off_bit_identical": profiled_bytes == baseline_bytes,
+            "chaos_dump_names_last_chunk": (
+                death_seen and last_chunk == died_at
+                and dump_outcome == "error:RuntimeError"
+            ),
+            "prometheus_valid": not prom_errors,
+        },
+    }
+    result["ok"] = all(result["pass"].values())
+    if out_path:
+        resource_profile.export(out_path)
+        result["profile_out"] = out_path
+    return result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("profile", nargs="?", default=None,
+                    help="ResourceProfile.export() JSON to render")
+    ap.add_argument("--top", type=int, default=0,
+                    help="only the N heaviest-wall rows")
+    ap.add_argument("--demo", action="store_true",
+                    help="run the gated profile-demo instead of rendering")
+    ap.add_argument("--out", default=None,
+                    help="demo: also export the profile JSON here")
+    args = ap.parse_args(argv)
+
+    if args.demo:
+        result = run_demo(args.out)
+        print(json.dumps(result))
+        if result["ok"]:
+            from keystone_tpu.utils.metrics import resource_profile
+
+            print("\n" + resource_profile.table(), file=sys.stderr)
+            print("\nprofile-demo: PASS", file=sys.stderr)
+        else:
+            failed = [k for k, v in result["pass"].items() if not v]
+            print(f"profile-demo: FAIL ({', '.join(failed)})",
+                  file=sys.stderr)
+        return 0 if result["ok"] else 1
+
+    if not args.profile:
+        print("profile_report: a PROFILE.json path or --demo is required",
+              file=sys.stderr)
+        return 1
+    with open(args.profile) as f:
+        doc = json.load(f)
+    rows = doc.get("rows")
+    if not isinstance(rows, list) or not rows:
+        # A dead profiler must fail loudly, not render a clean empty
+        # table (the trace_report zero-span rule).
+        print(
+            f"EMPTY: {args.profile} contains no attribution rows — was "
+            "KEYSTONE_PROFILE=1 (or fit(profile=True)) set for the run?",
+            file=sys.stderr,
+        )
+        return 1
+    print(json.dumps({"profile": args.profile, "rows": len(rows)}))
+    print(render(doc, top=args.top), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,13 @@ A schema-valid trace with ZERO spans is treated as an ERROR, not an empty
 table: it means the tracer was disabled (or never recorded), and a tool
 that prints a clean empty summary over a dead tracer is a false green.
 
+``--fit`` switches to the fit-attribution view: the executor's per-node
+spans (``node:<label>``, cat ``executor``) aggregate into the SAME
+attribution-table format ``tools/profile_report.py`` renders over a live
+``ResourceProfile`` — wall time and cache tallies from the trace, the
+cost-model columns printed as ``-`` (a trace carries no cost model) — so
+a Chrome trace of a fit and a live profile of it read identically.
+
 ``--request <id>`` switches to the per-request critical-path view: every
 span carrying that request id (``req_id`` on single-request spans,
 membership in ``req_ids`` on group spans — serve.flush / serve.device),
@@ -62,6 +69,48 @@ def summarize(doc: dict) -> dict:
         }
         for (cat, name), r in sorted(rows.items())
     }
+
+
+def fit_rows(doc: dict) -> list:
+    """Executor node spans aggregated into profile-row shape (the
+    ``ResourceProfile.rows()`` schema, measured columns only), heaviest
+    wall first — the input ``render_attribution_table`` shares with the
+    live profiler."""
+    agg: dict = {}
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") != "X" or ev.get("cat") != "executor":
+            continue
+        name = ev.get("name", "")
+        if not name.startswith("node:"):
+            continue
+        label = name[len("node:"):]
+        r = agg.setdefault(label, {"calls": 0, "wall_us": 0.0,
+                                   "hits": 0, "executed": 0})
+        r["calls"] += 1
+        r["wall_us"] += float(ev.get("dur", 0.0))
+        cache = (ev.get("args") or {}).get("cache")
+        if cache in ("hit", "memo"):
+            r["hits"] += 1
+        else:
+            r["executed"] += 1
+    rows = [
+        {
+            "node": label,
+            "calls": r["calls"],
+            "wall_ms": round(r["wall_us"] / 1e3, 4),
+            "device_wait_ms": None,
+            "flops": None,
+            "bytes_accessed": None,
+            "output_bytes": None,
+            "hbm_delta_bytes": None,
+            "cache_hits": r["hits"],
+            "executed": r["executed"],
+            "provenance": "measured",
+        }
+        for label, r in agg.items()
+    ]
+    rows.sort(key=lambda r: -r["wall_ms"])
+    return rows
 
 
 def _mentions(ev: dict, rid: int) -> bool:
@@ -149,6 +198,9 @@ def main(argv=None) -> int:
     ap.add_argument("--request", type=int, default=None, metavar="ID",
                     help="critical-path view of one request id instead of "
                          "the aggregate table")
+    ap.add_argument("--fit", action="store_true",
+                    help="aggregate executor node spans into the "
+                         "profile_report attribution-table format")
     args = ap.parse_args(argv)
 
     from keystone_tpu.utils.metrics import validate_chrome_trace
@@ -178,6 +230,23 @@ def main(argv=None) -> int:
             "trace": args.trace, "valid": True,
             "events": len(doc["traceEvents"]),
         }))
+        return 0
+
+    if args.fit:
+        rows = fit_rows(doc)
+        if not rows:
+            # Same loud-failure rule as the zero-span gate: a trace with
+            # no executor node spans cannot attribute a fit.
+            print(
+                f"NOT FOUND: {args.trace} contains no executor node spans "
+                "— was the traced run a fit/apply?",
+                file=sys.stderr,
+            )
+            return 1
+        from keystone_tpu.utils.metrics import render_attribution_table
+
+        print(json.dumps({"trace": args.trace, "nodes": rows}))
+        print("\n" + render_attribution_table(rows), file=sys.stderr)
         return 0
 
     if args.request is not None:
