@@ -1,6 +1,6 @@
 # Developer entry points. Pipelines launch via bin/run-pipeline.sh.
 
-.PHONY: test t1 chaos native bench bench-serve bench-serve-overload bench-serve-replicas trace-demo obs-serve profile-demo bench-watch lint dryrun clean tpu-checkride sentinel northstar acceptance
+.PHONY: test t1 chaos native bench bench-serve bench-serve-overload bench-serve-replicas bench-fit trace-demo obs-serve profile-demo bench-watch lint dryrun clean tpu-checkride sentinel northstar acceptance
 
 # The canonical tier-1 verify (ROADMAP.md), verbatim at the defaults —
 # builders and CI invoke this one entry point instead of hand-copying the
@@ -101,9 +101,20 @@ obs-serve:
 profile-demo:
 	JAX_PLATFORMS=cpu python tools/profile_report.py --demo
 
-# Bench regression sentinel: parse every BENCH_*/MULTICHIP_*/BENCH_serve
-# history row, fit per-metric noise bands from fingerprint-compatible
-# runs, exit nonzero naming any metric whose latest row regresses.
+# Stage-parallel executor walk: a two-branch host-featurize -> solve
+# pipeline fitted under the legacy serial walk (KEYSTONE_EXEC_WORKERS=0)
+# vs the ready-set scheduler (=4). Gates: predictions bit-identical,
+# >=1.3x wall-clock speedup (hard only on >=2-core hosts — one core
+# cannot overlap two host branches; there the gate is "no worse than
+# 0.75x", the replica-bench precedent). APPENDS the fingerprinted row to
+# the BENCH_fit.json history `make bench-watch` regresses against.
+bench-fit:
+	JAX_PLATFORMS=cpu python tools/bench_fit.py --out BENCH_fit.json
+
+# Bench regression sentinel: parse every BENCH_*/MULTICHIP_*/BENCH_serve/
+# BENCH_fit history row, fit per-metric noise bands from
+# fingerprint-compatible runs, exit nonzero naming any metric whose
+# latest row regresses.
 # Tier-1 runs the same gate in-process (tests/test_bench_watch.py).
 bench-watch:
 	python tools/bench_watch.py

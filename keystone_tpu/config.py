@@ -201,6 +201,21 @@ class Config:
     serve_inflight: int = field(
         default_factory=lambda: _env_int("KEYSTONE_SERVE_INFLIGHT", 2)
     )
+    # Host worker threads for the executor's stage-parallel DAG walk
+    # (workflow/executor.py): when > 0, nodes whose inputs are resolved
+    # dispatch concurrently onto a bounded pool — independent branches
+    # (the two-branch ImageNet featurizer, parallel text encoders) run
+    # side by side, and a host-bound node (native SIFT, JPEG decode,
+    # tokenize) no longer blocks device work on a sibling branch.
+    # Jittable device nodes keep riding JAX async dispatch (launch
+    # without materializing); only estimator fits and host consumers
+    # block. 0 (default) = the byte-identical legacy serial topological
+    # walk — nothing changes until opted in. Outputs are bit-identical
+    # at any worker count: the scheduler reorders only provably
+    # independent nodes. Env: KEYSTONE_EXEC_WORKERS.
+    exec_workers: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_EXEC_WORKERS", 0)
+    )
     # Whole-pipeline auto-caching (profile a sample run, persist the best
     # time-saved-per-byte intermediates under a budget). Opt-in: profiling
     # costs a sample execution per optimization.

@@ -1231,8 +1231,18 @@ class ResourceProfile:
         out_nbytes: Optional[int] = None,
         hbm_delta: Optional[int] = None,
         cache: str = "miss",
+        queue_wait_ns: Optional[int] = None,
+        worker: Optional[str] = None,
     ) -> None:
-        """Fold one node execution into the label's aggregate row."""
+        """Fold one node execution into the label's aggregate row.
+
+        Safe under concurrent callers: the parallel executor walk records
+        from every pool thread, and each fold is one atomic
+        read-modify-write under the profile lock — call counts and wall
+        sums stay exact at any worker count. ``queue_wait_ns`` (ready →
+        picked up by a worker) and ``worker`` (pool thread name) are the
+        parallel walk's scheduling attribution; the serial walk passes
+        neither."""
         with self._lock:
             agg = self._nodes.get(label)
             if agg is None:
@@ -1240,7 +1250,8 @@ class ResourceProfile:
                     "calls": 0, "wall_ns": 0, "dispatch_ns": 0,
                     "flops": 0.0, "bytes_accessed": 0.0, "output_bytes": 0,
                     "hbm_delta_bytes": 0, "cost_modeled": 0,
-                    "hbm_known": False,
+                    "hbm_known": False, "queue_wait_ns": 0,
+                    "workers": set(),
                     "cache": {"hit": 0, "memo": 0, "miss": 0},
                 }
             agg["calls"] += 1
@@ -1257,12 +1268,16 @@ class ResourceProfile:
             if hbm_delta is not None:
                 agg["hbm_delta_bytes"] += int(hbm_delta)
                 agg["hbm_known"] = True
+            if queue_wait_ns is not None:
+                agg["queue_wait_ns"] += int(queue_wait_ns)
+            if worker is not None:
+                agg["workers"].add(str(worker))
             agg["cache"][cache] = agg["cache"].get(cache, 0) + 1
 
     #: Numeric aggregate fields a ``mark()`` delta subtracts.
     _DELTA_FIELDS = ("calls", "wall_ns", "dispatch_ns", "flops",
                      "bytes_accessed", "output_bytes", "hbm_delta_bytes",
-                     "cost_modeled")
+                     "cost_modeled", "queue_wait_ns")
 
     def mark(self) -> Dict[str, dict]:
         """Opaque snapshot of the per-label aggregates, for delta views:
@@ -1272,7 +1287,8 @@ class ResourceProfile:
         profile other readers (Prometheus) are watching."""
         with self._lock:
             return {
-                label: dict(agg, cache=dict(agg["cache"]))
+                label: dict(agg, cache=dict(agg["cache"]),
+                            workers=set(agg["workers"]))
                 for label, agg in self._nodes.items()
             }
 
@@ -1286,7 +1302,11 @@ class ResourceProfile:
         measured. ``since`` (a ``mark()``) restricts to the delta —
         labels untouched after the mark are dropped."""
         with self._lock:
-            items = [(label, dict(agg), dict(agg["cache"]))
+            # workers is copied under the lock (like mark()): the live set
+            # keeps mutating under concurrent record_node calls, and
+            # sorting it outside the lock would iterate a changing set.
+            items = [(label, dict(agg, workers=set(agg["workers"])),
+                      dict(agg["cache"]))
                      for label, agg in self._nodes.items()]
         if since is not None:
             delta_items = []
@@ -1296,6 +1316,11 @@ class ResourceProfile:
                     agg = dict(agg)
                     for f in self._DELTA_FIELDS:
                         agg[f] = agg[f] - base[f]
+                    # workers is a set, not a counter: the delta view
+                    # names only pool threads first seen AFTER the mark.
+                    agg["workers"] = agg["workers"] - base.get(
+                        "workers", set()
+                    )
                     cache = {
                         k: v - base["cache"].get(k, 0)
                         for k, v in cache.items()
@@ -1324,6 +1349,14 @@ class ResourceProfile:
                 ),
                 "cache_hits": cache.get("hit", 0) + cache.get("memo", 0),
                 "executed": executed,
+                # Parallel-walk scheduling attribution: time spent ready
+                # but unclaimed, and which pool threads ran the label.
+                # None/empty under the serial walk.
+                "queue_wait_ms": (
+                    round(agg["queue_wait_ns"] / 1e6, 4)
+                    if agg["queue_wait_ns"] else None
+                ),
+                "workers": sorted(agg["workers"]) or None,
                 "provenance": (
                     "cost-model" if agg["cost_modeled"] else "measured"
                 ),
@@ -1342,7 +1375,8 @@ class ResourceProfile:
             "node_calls": {}, "node_wall_seconds": {},
             "node_device_wait_seconds": {}, "node_flops": {},
             "node_bytes_accessed": {}, "node_output_bytes": {},
-            "node_hbm_delta_bytes": {},
+            "node_hbm_delta_bytes": {}, "node_queue_wait_seconds": {},
+            "node_workers": {},
         }
         for label, agg in items:
             snap["node_calls"][label] = agg["calls"]
@@ -1350,6 +1384,12 @@ class ResourceProfile:
             snap["node_device_wait_seconds"][label] = (
                 max(0, agg["wall_ns"] - agg["dispatch_ns"]) / 1e9
             )
+            if agg["queue_wait_ns"]:
+                snap["node_queue_wait_seconds"][label] = (
+                    agg["queue_wait_ns"] / 1e9
+                )
+            if agg["workers"]:
+                snap["node_workers"][label] = len(agg["workers"])
             if agg["cost_modeled"]:
                 snap["node_flops"][label] = agg["flops"]
                 snap["node_bytes_accessed"][label] = agg["bytes_accessed"]

@@ -17,6 +17,7 @@ the optimizer.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 from keystone_tpu.workflow.graph import (
@@ -59,23 +60,34 @@ def _no_sources(sid: SourceId):
     )
 
 
-def _observed_execute(op, deps, tracer, profile):
+def _observed_execute(op, deps, tracer, profile, worker=None,
+                      queue_wait_ns=None):
     """Execute one node under the tracer and/or the resource profile.
 
     The profiled path blocks on array outputs so wall time covers device
     completion (dispatch vs wait attributed separately) and attributes
     cost-model FLOPs/bytes via the memoized abstract AOT compile — the
     node's VALUES are untouched, which is what keeps KEYSTONE_PROFILE=0
-    and =1 fits bit-identical."""
+    and =1 fits bit-identical.
+
+    ``worker`` / ``queue_wait_ns`` come from the parallel walk: which pool
+    thread ran the node and how long it sat ready before a worker picked
+    it up. The serial walk passes neither, so its spans and profile rows
+    are unchanged."""
     import time
 
     label = op.label()
+    extra = {}
+    if worker is not None:
+        extra["worker"] = worker
+    if queue_wait_ns is not None:
+        extra["queue_wait_ms"] = round(queue_wait_ns / 1e6, 4)
     if profile is None:
         t0 = tracer.now()
         out = op.execute(deps)
         tracer.record(
             "node:" + label, "executor", t0,
-            cache="miss", shape=_span_shape(out),
+            cache="miss", shape=_span_shape(out), **extra,
         )
         return out
 
@@ -110,13 +122,275 @@ def _observed_execute(op, deps, tracer, profile):
             hbm1 - hbm0 if hbm0 is not None and hbm1 is not None else None
         ),
         cache="miss",
+        queue_wait_ns=queue_wait_ns,
+        worker=worker,
     )
     if tracer is not None:
         tracer.record(
             "node:" + label, "executor", t0, end,
-            cache="miss", shape=_span_shape(out), profiled=True,
+            cache="miss", shape=_span_shape(out), profiled=True, **extra,
         )
     return out
+
+
+#: Thread-local flag marking "this thread is a parallel-walk worker": an
+#: estimator fit that internally applies pipelines (fisher featurizers,
+#: auto-cache profiling) re-enters ``execute_many`` on a pool thread, and
+#: a nested walk must take the serial path instead of spawning a second
+#: pool under the first (bounded concurrency stays bounded).
+_walk_tls = threading.local()
+
+_pool_lock = threading.Lock()
+_shared_pool = None
+_shared_pool_workers = 0
+
+
+def _exec_pool(workers: int):
+    """The process-wide executor worker pool, built lazily and reused
+    across walks (the ``active_tracer()`` memo idiom): a streamed
+    per-batch apply loop must not pay thread spawn/join on every walk.
+    Rebuilt when the requested width changes; the old pool's threads
+    drain without blocking the caller."""
+    global _shared_pool, _shared_pool_workers
+    from concurrent.futures import ThreadPoolExecutor
+
+    with _pool_lock:
+        if _shared_pool is None or _shared_pool_workers != workers:
+            if _shared_pool is not None:
+                _shared_pool.shutdown(wait=False)
+            _shared_pool = ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="keystone-exec"
+            )
+            _shared_pool_workers = workers
+        return _shared_pool
+
+
+class _ParallelWalk:
+    """Dependency-counting ready-set scheduler over one executor walk.
+
+    The serial walk's execution loop, parallelized: every node of the
+    (already cache-cut) ``order`` becomes a task; a node dispatches onto
+    a bounded ``ThreadPoolExecutor`` the moment its inputs are resolved,
+    so independent branches — the ImageNet SIFT|LCS featurizer's two
+    fisher fronts, parallel text encoders — run concurrently, and a
+    host-bound node (native SIFT, JPEG decode, tokenize) stops blocking
+    sibling-branch device work. Jittable device nodes stay non-blocking:
+    ``op.execute`` rides JAX async dispatch, returning array futures the
+    workers never materialize — a value is only consumed host-side at
+    estimator fits and host transformers, exactly where the serial walk
+    would block too.
+
+    Semantics preserved bit-identically (the scheduler reorders only
+    provably independent nodes; per-node math is untouched):
+
+    - cache cuts: persistent-cache hits were already resolved as leaves
+      by the discovery pass — this walk never sees their subgraphs;
+    - structural dedup: the FIRST node (in topological order) with a
+      given prefix hash is the hash's owner and executes; same-hash
+      duplicates become memo tasks that wait for the owner and copy its
+      value — two duplicates can never compute concurrently;
+    - fit/persist cache writes happen under the walk lock, on the same
+      paths the serial loop uses;
+    - a fault on a worker thread cancels the remaining schedule and
+      re-raises on the calling thread (chaos parity with serial).
+
+    Shared state (``values``/``by_hash``/``pend`` and the session cache
+    writes) is guarded by ``self._lock``; mutation outside it lives only
+    in ``*_locked`` methods, and ``_run_node_worker`` is registered in
+    keystone-lint's ``KNOWN_THREAD_TARGETS`` so KL001 covers the pool
+    threads.
+    """
+
+    def __init__(self, executor, graph, order, values, by_hash, hmemo,
+                 d_of, tracer, profile, workers):
+        self.ex = executor
+        self.graph = graph
+        self.values = values
+        self.by_hash = by_hash
+        self.hashes = hmemo
+        self.tracer = tracer
+        self.profile = profile
+        self.workers = workers
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._pool = None
+        self._error: Optional[BaseException] = None
+        self._stop = False
+        self._inflight = 0
+        self._remaining = len(order)
+        self._ready_ns: Dict[NodeId, int] = {}
+        # Build phase (single-threaded): hash ownership, per-node pending
+        # counts, and the dependent edges the completions will decrement.
+        # Estimator disk-cache digests are precomputed HERE so the shared
+        # digest memo is never touched from a worker thread.
+        self.is_memo: set = set()
+        self.dks: Dict[NodeId, Any] = {}
+        self.pend: Dict[NodeId, int] = {}
+        self.dependents: Dict[NodeId, List[NodeId]] = {}
+        self.initial: List[NodeId] = []
+        owner_of_hash: Dict[int, NodeId] = {}
+        for nid in order:
+            h = hmemo[nid]
+            if h in by_hash:
+                # Produced by a discovery-phase cache hit: a memo task
+                # with no prerequisites (the value already exists).
+                self.is_memo.add(nid)
+                deps = set()
+            elif h in owner_of_hash:
+                # Duplicate: wait for the hash owner, then copy its
+                # value off by_hash (the dependency edge IS the link —
+                # no separate owner lookup exists at execute time).
+                self.is_memo.add(nid)
+                deps = {owner_of_hash[h]}
+            else:
+                owner_of_hash[h] = nid
+                deps = {
+                    d for d in graph.dependencies[nid]
+                    if isinstance(d, NodeId) and d not in values
+                }
+                op = graph.operators[nid]
+                if (
+                    isinstance(op, EstimatorOperator)
+                    and executor.env.disk_cache is not None
+                ):
+                    self.dks[nid] = d_of(nid)
+            self.pend[nid] = len(deps)
+            for d in deps:
+                self.dependents.setdefault(d, []).append(nid)
+            if not deps:
+                self.initial.append(nid)
+
+    def run(self) -> None:
+        """Drive the schedule to completion on the shared bounded pool;
+        block the caller until every node resolved (or re-raise the
+        first worker fault once in-flight tasks drained). The exit wait
+        covers BOTH completion shapes — every submitted task retires
+        through ``_finish_locked`` before the loop can exit, so no task
+        of this walk can still be running when run() returns."""
+        pool = _exec_pool(self.workers)
+        with self._lock:
+            self._pool = pool
+            for nid in self.initial:
+                self._submit_locked(nid)
+            while self._remaining and not (
+                self._stop and self._inflight == 0
+            ):
+                self._cv.wait()
+            self._pool = None
+        if self._error is not None:
+            raise self._error
+
+    def _submit_locked(self, nid: NodeId) -> None:
+        """Hand one ready node to the pool (caller holds the lock)."""
+        import time
+
+        if self._stop or self._pool is None:
+            return
+        self._ready_ns[nid] = time.perf_counter_ns()
+        # submit BEFORE the in-flight increment: if the shared pool was
+        # rebuilt under this walk (a width change from another thread),
+        # submit raises without leaking a phantom in-flight count — the
+        # raise surfaces as the walk's error instead of wedging run()'s
+        # drain wait forever. The spawned task cannot observe the
+        # bookkeeping early: its first action takes this same lock.
+        self._pool.submit(self._run_node_worker, nid)
+        self._inflight += 1
+
+    def _run_node_worker(self, nid: NodeId) -> None:
+        """One pool task (a keystone-lint KNOWN_THREAD_TARGETS entry):
+        execute one ready node outside the lock, publish its value, and
+        schedule dependents that became ready. Any exception cancels the
+        remaining schedule and surfaces on the calling thread."""
+        import time
+
+        with self._lock:
+            if self._stop:
+                # A sibling already faulted: tasks queued behind it must
+                # not burn work (estimator fits, disk writes) on a walk
+                # that is already doomed — the serial loop stops at the
+                # first fault, so the parallel walk does too.
+                self._finish_locked()
+                return
+        _walk_tls.active = True
+        try:
+            queue_wait_ns = time.perf_counter_ns() - self._ready_ns[nid]
+            out = self._execute(nid, queue_wait_ns)
+            with self._lock:
+                self._publish_locked(nid, out)
+                self._finish_locked()
+        except BaseException as e:  # lint: broad-ok re-raised on the caller by run()
+            with self._lock:
+                if self._error is None:
+                    self._error = e
+                self._stop = True
+                self._finish_locked()
+        finally:
+            _walk_tls.active = False
+
+    def _finish_locked(self) -> None:
+        """Retire this task from the in-flight count and wake the caller
+        (caller holds the lock). ONE place decrements, so the
+        publish-succeeded and fault paths can never double-count."""
+        self._inflight -= 1
+        self._cv.notify_all()
+
+    def _execute(self, nid: NodeId, queue_wait_ns: int):
+        """The per-node body of the serial loop, minus the shared-state
+        writes (those happen in ``_publish_locked``). Runs on a pool
+        thread with every dependency value already published."""
+        graph = self.graph
+        op = graph.operators[nid]
+        h = self.hashes[nid]
+        if nid in self.is_memo:
+            out = self.by_hash[h]
+            if self.tracer is not None:
+                self.tracer.instant(
+                    "node:" + op.label(), "executor", cache="memo"
+                )
+            if self.profile is not None:
+                self.profile.record_node(op.label(), cache="memo")
+            return out
+        deps = [self.values[d] for d in graph.dependencies[nid]]
+        if self.tracer is None and self.profile is None:
+            out = op.execute(deps)
+        else:
+            out = _observed_execute(
+                op, deps, self.tracer, self.profile,
+                worker=threading.current_thread().name,
+                queue_wait_ns=queue_wait_ns,
+            )
+        if isinstance(op, EstimatorOperator):
+            # Cross-process store: content-addressed, atomic put — safe
+            # off the lock (hash ownership makes the key unique per walk).
+            dk = self.dks.get(nid)
+            if dk is not None:
+                self.ex.env.disk_cache.put(dk, out)
+        return out
+
+    def _publish_locked(self, nid: NodeId, out) -> None:
+        """Store one node's value, run the session-cache writes the
+        serial loop does at this point, and wake newly-ready dependents
+        (caller holds the lock)."""
+        graph = self.graph
+        op = graph.operators[nid]
+        h = self.hashes[nid]
+        self.values[nid] = out
+        env = self.ex.env
+        if nid not in self.is_memo:
+            self.by_hash[h] = out
+            if isinstance(op, EstimatorOperator):
+                self.ex._cache_fit(graph, nid, h, op, out)
+            if getattr(op, "persist", False):
+                env.node_cache[h] = (out, self.ex._prefix_pins(graph, nid))
+        elif getattr(op, "persist", False) and h not in env.node_cache:
+            # A cache node hashes identically to its dependency (it's an
+            # identity), so it lands on the memo path — still persist.
+            env.node_cache[h] = (out, self.ex._prefix_pins(graph, nid))
+        self._remaining -= 1
+        for dep in self.dependents.get(nid, ()):
+            self.pend[dep] -= 1
+            if self.pend[dep] == 0:
+                self._submit_locked(dep)
 
 
 class GraphExecutor:
@@ -213,6 +487,25 @@ class GraphExecutor:
             for dep in graph.dependencies[gid]:
                 if dep not in seen and isinstance(dep, NodeId):
                     stack.append((dep, False))
+
+        # Stage-parallel walk (KEYSTONE_EXEC_WORKERS / config.exec_workers,
+        # resolved once per walk like the tracer): > 0 dispatches the
+        # execution loop below onto a bounded worker pool instead —
+        # identical per-node work, identical cache writes, bit-identical
+        # values; only provably independent nodes reorder. 0 (default)
+        # falls through to the legacy serial loop, byte for byte. A walk
+        # re-entered from a pool thread (an estimator fitting sub-pipelines)
+        # always runs serial so concurrency stays bounded by ONE pool.
+        if len(order) > 1 and not getattr(_walk_tls, "active", False):
+            from keystone_tpu.config import config
+
+            workers = config.exec_workers
+            if workers and workers > 0:
+                _ParallelWalk(
+                    self, graph, order, values, by_hash, hmemo, d_of,
+                    tracer, profile, workers,
+                ).run()
+                return values
 
         for nid in order:
             h = h_of(nid)

@@ -110,6 +110,26 @@ def test_throughput_drop_and_bool_flip_regress(history):
     assert any(s.endswith("pass.zero_post_warmup_compiles") for s in names)
 
 
+def test_fit_family_loaded_and_regression_flagged(history):
+    """ISSUE-10: the BENCH_fit.json JSONL history is a gated family —
+    wall-like leaves regress upward, the speedup value downward, and the
+    bit-identity flag flipping false regresses by definition."""
+    path = os.path.join(str(history), "BENCH_fit.json")
+    rows = [json.loads(line) for line in open(path)]
+    row = json.loads(json.dumps(rows[-1]))
+    row["value"] *= 0.3  # speedup collapses
+    row["detail"]["parallel_wall_s"] *= 4.0  # wall-like, up = regress
+    row["detail"]["bit_identical"] = False
+    with open(path, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    result = bench_watch.run(str(history))
+    assert not result["ok"]
+    names = {v["series"] for v in result["regressions"]}
+    assert "fit:fit_parallel_walk:value" in names
+    assert "fit:fit_parallel_walk:detail.parallel_wall_s" in names
+    assert "fit:fit_parallel_walk:detail.bit_identical" in names
+
+
 def test_unjudged_leaves_never_gate(history):
     def mutate(row):
         row["features"] = row.get("features", 512) * 100  # config, not perf

@@ -469,6 +469,99 @@ def test_known_thread_targets_are_kl001_roots_without_visible_spawn(
     assert "KL001" not in rules_of(lint_snippet(tmp_path, good))
 
 
+def test_parallel_walk_worker_is_a_registered_kl001_root(tmp_path):
+    """ISSUE-10 satellite: the executor's pool-worker entry point
+    (`_run_node_worker`) is a KNOWN_THREAD_TARGETS root, so an unlocked
+    write to scheduler-shared state (the values/pend/inflight dicts both
+    the caller and the workers touch) is a KL001 finding — even though
+    the spawn is a ``ThreadPoolExecutor.submit`` no ``Thread(target=)``
+    makes statically visible."""
+    assert "_run_node_worker" in keystone_lint.KNOWN_THREAD_TARGETS
+    bad = """
+    import threading
+
+    class Walk:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.values = {}
+            self.inflight = 0
+
+        def run(self, sources):
+            with self._lock:
+                for s in sources:
+                    self.values[s] = s
+                    self.inflight += 1
+
+        def _run_node_worker(self, nid):
+            out = nid * 2
+            self.values[nid] = out
+            self.inflight -= 1
+    """
+    findings = [f for f in lint_snippet(tmp_path, bad) if f.rule == "KL001"]
+    assert findings, "_run_node_worker not treated as a KL001 root"
+    assert any(
+        "_run_node_worker" in f.message and "values" in f.message
+        for f in findings
+    )
+    assert any("inflight" in f.message for f in findings)
+    # The fix shape the live scheduler uses: the worker publishes through
+    # a *_locked helper (caller-holds-the-lock convention) — clean.
+    good = """
+    import threading
+
+    class Walk:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.values = {}
+            self.inflight = 0
+
+        def run(self, sources):
+            with self._lock:
+                for s in sources:
+                    self.values[s] = s
+                    self.inflight += 1
+
+        def _run_node_worker(self, nid):
+            out = nid * 2
+            with self._lock:
+                self._publish_locked(nid, out)
+
+        def _publish_locked(self, nid, out):
+            self.values[nid] = out
+            self.inflight -= 1
+    """
+    assert "KL001" not in rules_of(lint_snippet(tmp_path, good))
+
+
+def test_live_executor_module_has_zero_concurrency_findings():
+    """workflow/executor.py now hosts the parallel walk: it must carry
+    no lock-discipline, lock-order, or lost-wakeup findings, and the
+    worker method the lint registry names must actually exist on
+    _ParallelWalk (a rename that silently unregisters the root is a
+    failure here, not a blind spot)."""
+    findings, _ = keystone_lint.scan(
+        ["keystone_tpu/workflow/executor.py"], root=REPO_ROOT
+    )
+    concurrency = [
+        f for f in findings if f.rule in ("KL001", "KL002", "KL007", "KL008")
+    ]
+    assert not concurrency, [(f.rule, f.line, f.message) for f in concurrency]
+    import ast
+
+    src_path = os.path.join(
+        REPO_ROOT, "keystone_tpu", "workflow", "executor.py"
+    )
+    with open(src_path) as f:
+        tree = ast.parse(f.read())
+    walk = next(
+        n for n in ast.walk(tree)
+        if isinstance(n, ast.ClassDef) and n.name == "_ParallelWalk"
+    )
+    methods = {m.name for m in walk.body if isinstance(m, ast.FunctionDef)}
+    assert "_run_node_worker" in methods
+    assert "_run_node_worker" in keystone_lint.KNOWN_THREAD_TARGETS & methods
+
+
 def test_watchdog_and_flight_recorder_lint_clean_live():
     """The new observability modules lint clean from day one: zero
     findings in utils/flight_recorder.py, zero NEW findings in the

@@ -1,0 +1,250 @@
+"""Stage-parallel executor bench: serial vs parallel fit wall clock.
+
+The ISSUE-10 tentpole claim, measured: a two-branch host-featurize →
+solve pipeline (the ImageNet SIFT|LCS shape — two independent
+non-jittable featurizer branches gathered into one least-squares fit)
+is fitted twice, once under the legacy serial walk
+(``config.exec_workers = 0``) and once under the dependency-counting
+ready-set scheduler (``= N`` workers), and the wall clocks are compared.
+
+The host featurizer is deliberately GIL-friendly single-threaded numpy
+(FFT + elementwise chains, no BLAS that might multi-thread underneath):
+the serial walk runs the two branches back to back on one core, the
+parallel walk overlaps them on the worker pool — exactly the win the
+scheduler exists for. Work is a FIXED iteration count, so outputs are
+deterministic and the bit-identity gate is exact.
+
+Gates:
+
+- outputs bit-identical: the fitted pipeline applied to held-out rows
+  must produce byte-equal predictions under both walks (hard, always);
+- wall-clock speedup >= 1.3x (hard only when the fingerprint shows >= 2
+  host cores AND >= 2 workers — on a 1-core container the pool
+  time-slices one core, so the gate there is merely "no worse than
+  0.75x", the PR-5 replica-bench precedent).
+
+The result row APPENDS to ``--out`` (BENCH_fit.json) as fingerprinted
+JSONL history — ``make bench-watch`` fits noise bands over prior rows
+and flags a wall-clock/speedup regression in any later run.
+
+Usage: python tools/bench_fit.py [--branches 2] [--workers 4]
+           [--reps 3] [--quick] [--out BENCH_fit.json]
+Prints one JSON line; exit 1 on a failed hard gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from keystone_tpu.workflow.pipeline import Pipeline, Transformer  # noqa: E402
+
+
+class HostFFTFeaturizer(Transformer):
+    """A deterministic host-bound featurizer branch (the SIFT/LCS
+    stand-in): ``iters`` rounds of rFFT -> spectral filter -> irFFT ->
+    tanh. Pure single-threaded numpy that releases the GIL, so two
+    branches genuinely overlap on the worker pool; a fixed iteration
+    count keeps the output (and thus the bit-identity gate) exact."""
+
+    jittable = False
+
+    def __init__(self, seed: int, iters: int):
+        self.seed = int(seed)
+        self.iters = int(iters)
+
+    def signature(self):
+        return self.stable_signature(self.seed, self.iters)
+
+    def apply_batch(self, X):
+        Y = np.asarray(X, dtype=np.float32)
+        rng = np.random.default_rng(self.seed)
+        filt = (1.0 + rng.uniform(size=Y.shape[1] // 2 + 1)).astype(
+            np.complex64
+        )
+        for _ in range(self.iters):
+            spec = np.fft.rfft(Y, axis=1) * filt
+            Y = np.tanh(
+                Y + np.fft.irfft(spec, n=Y.shape[1], axis=1).astype(
+                    np.float32
+                )
+            )
+        return Y
+
+
+def build_fit_pipeline(X, y, branches: int, work_iters: int) -> Pipeline:
+    """``branches`` independent host featurizers gathered into one
+    block-least-squares solve — the two-branch ImageNet featurizer
+    shape at bench scale."""
+    from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
+
+    fronts = [
+        HostFFTFeaturizer(seed=i + 1, iters=work_iters).to_pipeline()
+        for i in range(branches)
+    ]
+    feat = fronts[0] if branches == 1 else Pipeline.gather(fronts)
+    return feat.and_then(
+        BlockLeastSquaresEstimator(
+            block_size=max(32, X.shape[1]), num_iters=1, lam=1e-3
+        ),
+        X,
+        y,
+    )
+
+
+def _timed_fit(X, y, X_test, branches, work_iters, workers):
+    """One cold fit under ``workers`` executor threads: fresh session
+    caches (no fit-cache hit can short-circuit the measured walk),
+    returns (wall seconds, held-out predictions)."""
+    from keystone_tpu.config import config
+    from keystone_tpu.workflow.executor import PipelineEnv
+
+    PipelineEnv.reset()
+    prev = config.exec_workers
+    config.exec_workers = workers
+    try:
+        pipe = build_fit_pipeline(X, y, branches, work_iters)
+        t0 = time.perf_counter()
+        fitted = pipe.fit()
+        wall = time.perf_counter() - t0
+        preds = np.asarray(fitted.apply(X_test).get())
+    finally:
+        config.exec_workers = prev
+        PipelineEnv.reset()
+    return wall, preds
+
+
+def run_bench(args) -> dict:
+    rng = np.random.default_rng(0)
+    n, d, k = args.rows, args.dim, args.classes
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    W_true = rng.normal(size=(d, k)).astype(np.float32)
+    y = (X @ W_true + 0.01 * rng.normal(size=(n, k))).astype(np.float32)
+    X_test = rng.normal(size=(64, d)).astype(np.float32)
+
+    # Untimed warmup: the first fit in the process pays the solver's XLA
+    # compiles (jit caches are process-wide, not session-scoped); without
+    # this the serial rep eats the compile cost and the "speedup" lies.
+    _timed_fit(X, y, X_test, args.branches, args.work_iters, 0)
+
+    serial_walls, parallel_walls = [], []
+    serial_preds = parallel_preds = None
+    for _ in range(args.reps):
+        wall, serial_preds = _timed_fit(
+            X, y, X_test, args.branches, args.work_iters, 0
+        )
+        serial_walls.append(wall)
+        wall, parallel_preds = _timed_fit(
+            X, y, X_test, args.branches, args.work_iters, args.workers
+        )
+        parallel_walls.append(wall)
+
+    serial_s = statistics.median(serial_walls)
+    parallel_s = statistics.median(parallel_walls)
+    speedup = serial_s / parallel_s if parallel_s > 0 else float("inf")
+    bit_identical = bool(
+        serial_preds.shape == parallel_preds.shape
+        and np.array_equal(serial_preds, parallel_preds)
+    )
+
+    import jax
+
+    from keystone_tpu.utils.metrics import environment_fingerprint
+
+    cores = os.cpu_count() or 1
+    # One core cannot run two host branches at once: the 1.3x gate is
+    # hard only where the hardware can express the overlap (the PR-5
+    # replica-bench precedent); a 1-core container must merely not get
+    # meaningfully SLOWER from scheduler overhead.
+    gate_is_hard = cores >= 2 and args.workers >= 2
+    speedup_gate = speedup >= (1.3 if gate_is_hard else 0.75)
+    row = {
+        "metric": "fit_parallel_walk",
+        "value": round(speedup, 3),
+        "unit": "x speedup (serial fit wall / parallel fit wall)",
+        "backend": jax.default_backend(),
+        "host_cores": cores,
+        "env": environment_fingerprint(),
+        "detail": {
+            "branches": args.branches,
+            "exec_workers": args.workers,
+            "reps": args.reps,
+            "work_iters": args.work_iters,
+            "rows": n,
+            "dim": d,
+            "classes": k,
+            "serial_wall_s": round(serial_s, 4),
+            "parallel_wall_s": round(parallel_s, 4),
+            "bit_identical": bit_identical,
+            "speedup_gate": speedup_gate,
+            "speedup_gate_is_hard": gate_is_hard,
+        },
+    }
+    # --quick is harness validation: the tiny problem is all scheduler
+    # overhead, so only bit-identity is judged there.
+    row["ok"] = bool(
+        bit_identical and (speedup_gate or getattr(args, "quick", False))
+    )
+    return row
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="serial-vs-parallel executor walk fit bench"
+    )
+    ap.add_argument("--branches", type=int, default=2,
+                    help="independent host featurizer branches")
+    ap.add_argument("--workers", type=int, default=4,
+                    help="KEYSTONE_EXEC_WORKERS for the parallel walk")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="cold fits per mode; the median wall is reported")
+    ap.add_argument("--rows", type=int, default=384)
+    ap.add_argument("--dim", type=int, default=256)
+    ap.add_argument("--classes", type=int, default=8)
+    ap.add_argument("--work-iters", type=int, default=60,
+                    help="FFT/tanh rounds per host branch (fixed count: "
+                         "deterministic outputs)")
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny problem, 1 rep — harness validation only, "
+                         "no row is written and gates are soft")
+    ap.add_argument("--out", default=None,
+                    help="append the fingerprinted JSONL row here")
+    args = ap.parse_args(argv)
+
+    if args.quick:
+        args.rows, args.dim, args.classes = 96, 64, 4
+        args.work_iters, args.reps = 8, 1
+
+    row = run_bench(args)
+    print(json.dumps(row), flush=True)
+
+    if args.out and not args.quick:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(row) + "\n")
+
+    if not row["detail"]["bit_identical"]:
+        print("GATE FAILED: parallel fit outputs differ from serial",
+              file=sys.stderr)
+        return 1
+    if not row["detail"]["speedup_gate"] and not args.quick:
+        bound = 1.3 if row["detail"]["speedup_gate_is_hard"] else 0.75
+        print(
+            f"GATE FAILED: speedup {row['value']}x < {bound}x "
+            f"({'hard' if row['detail']['speedup_gate_is_hard'] else 'soft'}"
+            f" gate at {row['host_cores']} cores)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

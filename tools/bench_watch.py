@@ -2,9 +2,9 @@
 """bench-watch: the bench regression sentinel (``make bench-watch``).
 
 The repo's bench history — ``BENCH_r*.json`` round snapshots,
-``MULTICHIP_r*.json`` dryrun verdicts, and the ``BENCH_serve.json`` JSONL
-rows — has so far been an archive: every PR appends fingerprinted
-evidence, nothing reads it back. This tool turns the trajectory into a
+``MULTICHIP_r*.json`` dryrun verdicts, and the ``BENCH_serve.json`` /
+``BENCH_fit.json`` JSONL rows — has so far been an archive: every PR
+appends fingerprinted evidence, nothing reads it back. This tool turns the trajectory into a
 GATE: it parses every history row, fits a per-metric noise band from the
 recorded runs, and exits nonzero with a named-metric report when the
 LATEST row of any series regresses outside its band.
@@ -236,9 +236,17 @@ def load_series(
         row = {k: doc.get(k) for k in ("ok", "rc", "n_devices")}
         add("multichip", "dryrun", rnd, row, os.path.basename(path))
 
-    serve_path = os.path.join(root, "BENCH_serve.json")
-    if os.path.exists(serve_path):
-        with open(serve_path) as f:
+    # JSONL histories: one fingerprinted row per line, chronological.
+    # BENCH_serve.json keeps one latest row per serving metric;
+    # BENCH_fit.json accumulates every `make bench-fit` run of the
+    # stage-parallel executor bench (wall-like leaves up = regress,
+    # speedup down = regress, bit_identical true->false = regress).
+    for family, fname in (("serve", "BENCH_serve.json"),
+                          ("fit", "BENCH_fit.json")):
+        jsonl_path = os.path.join(root, fname)
+        if not os.path.exists(jsonl_path):
+            continue
+        with open(jsonl_path) as f:
             for i, line in enumerate(f):
                 line = line.strip()
                 if not line:
@@ -247,10 +255,10 @@ def load_series(
                     row = json.loads(line)
                 except json.JSONDecodeError as e:
                     raise RuntimeError(
-                        f"unreadable history row {serve_path}:{i + 1}: {e}"
+                        f"unreadable history row {jsonl_path}:{i + 1}: {e}"
                     )
-                add("serve", str(row.get("metric", "unknown")), i, row,
-                    f"BENCH_serve.json:{i + 1}")
+                add(family, str(row.get("metric", "unknown")), i, row,
+                    f"{fname}:{i + 1}", unit=row.get("unit"))
 
     return series, units
 

@@ -79,7 +79,8 @@ DISPATCH_METHODS = {"submit", "_loop", "_dispatch", "_pick_slot_locked",
 #: name so lock discipline covers them from day one — a watchdog that
 #: mutates service state outside the lock must be a finding, not a blind
 #: spot behind an indirect spawn.
-KNOWN_THREAD_TARGETS = {"_watchdog_loop", "_watch_loop", "_solve_watch_loop"}
+KNOWN_THREAD_TARGETS = {"_watchdog_loop", "_watch_loop", "_solve_watch_loop",
+                        "_run_node_worker"}
 HOST_SYNC_CALLS = {"block_until_ready", "device_get", "asarray", "array"}
 
 #: Mutating method names treated as writes for KL001 (deque/list/set/dict
