@@ -1,6 +1,6 @@
 # Developer entry points. Pipelines launch via bin/run-pipeline.sh.
 
-.PHONY: test t1 chaos native bench bench-serve bench-serve-overload bench-serve-replicas bench-fit trace-demo obs-serve profile-demo bench-watch lint dryrun clean tpu-checkride sentinel northstar acceptance
+.PHONY: test t1 chaos native bench bench-serve bench-serve-overload bench-serve-replicas bench-serve-daemon bench-fit trace-demo obs-serve serve-daemon profile-demo bench-watch lint dryrun clean tpu-checkride sentinel northstar acceptance
 
 # The canonical tier-1 verify (ROADMAP.md), verbatim at the defaults —
 # builders and CI invoke this one entry point instead of hand-copying the
@@ -13,11 +13,14 @@ t1:
 	set -o pipefail; rm -f $(T1_LOG); timeout -k 10 870 env JAX_PLATFORMS=cpu $(T1_ENV) python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee $(T1_LOG); rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' $(T1_LOG) | tr -cd . | wc -c); exit $$rc
 
 # Tier-1 under the standard fault plan (utils/reliability.py): transient
-# IOErrors at 5% of record boundaries plus one injected device OOM, seeded
-# and deterministic. The suite must pass UNCHANGED — every injected fault
-# is recovered (retry/backoff, quarantine, chunk downshift) invisibly.
+# IOErrors at 5% of record boundaries, one injected device OOM, and 5% of
+# daemon client connections dropping before the response write — seeded
+# and deterministic. The suite must pass UNCHANGED: every injected fault
+# is recovered (retry/backoff, quarantine, chunk downshift) invisibly,
+# and a dropped connection's request still resolves (journey outcome
+# conn_drop, zero unresolved futures; clients simply retry).
 chaos:
-	$(MAKE) t1 T1_ENV="KEYSTONE_FAULTS=io:0.05,oom:1 KEYSTONE_FAULTS_SEED=0" T1_LOG=/tmp/_chaos.log
+	$(MAKE) t1 T1_ENV="KEYSTONE_FAULTS=io:0.05,oom:1,conn_drop:0.05 KEYSTONE_FAULTS_SEED=0" T1_LOG=/tmp/_chaos.log
 
 # One-command resumable live-chip evidence harness: probes the TPU, runs
 # bench f32/bf16 + MFU sweep + Pallas Mosaic compile + streamed-overlap +
@@ -71,6 +74,24 @@ bench-serve-overload:
 bench-serve-replicas:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	  python tools/bench_serve.py --devices 4 --out BENCH_serve.json
+
+# Networked serving daemon smoke: export two demo artifacts, stand up a
+# live daemon (HTTP/JSON + framed-socket ingress, tenant admission),
+# drive both wires, verify 403/429 admission, /healthz generation
+# identity, and a hot-swap UNDER TRAFFIC with zero dropped requests and
+# per-generation bit-identity. Tier-1 runs the same smoke in-process
+# (tests/test_daemon.py).
+serve-daemon:
+	JAX_PLATFORMS=cpu python tools/serve_daemon.py --smoke
+
+# Daemon overload + swap-under-load bench through the REAL socket: flood
+# at 2x the admitted best-effort concurrency — the excess must fast-fail
+# 429 at admission (zero device cost) while the gold tenant's p99 stays
+# within 2x its deadline across TWO mid-flood hot-swaps. APPENDS the
+# fingerprinted serve_daemon row to the BENCH_serve.json history that
+# `make bench-watch` regresses against.
+bench-serve-daemon:
+	JAX_PLATFORMS=cpu python tools/bench_serve.py --daemon --out BENCH_serve.json
 
 # Observability smoke: a small fit + streamed solve + serve under
 # KEYSTONE_TRACE=1, Chrome-trace exported to /tmp/keystone_trace.json,

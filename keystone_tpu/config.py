@@ -384,6 +384,82 @@ class Config:
     solve_progress_every: int = field(
         default_factory=lambda: _env_int("KEYSTONE_SOLVE_PROGRESS_EVERY", 1)
     )
+    # Network serving daemon (workflow/daemon.py) — bind address for
+    # BOTH ingresses. Default loopback (safe: nothing is exposed until
+    # the operator says so); set 0.0.0.0 to serve real external traffic
+    # behind a load balancer. Env: KEYSTONE_SERVE_HOST.
+    serve_host: str = field(
+        default_factory=lambda: os.environ.get("KEYSTONE_SERVE_HOST",
+                                               "127.0.0.1")
+    )
+    # HTTP/JSON ingress port. 0 = bind an ephemeral port (tests/smokes;
+    # the chosen port is reported on the daemon object).
+    # Env: KEYSTONE_SERVE_PORT.
+    serve_port: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_SERVE_PORT", 0)
+    )
+    # Length-prefixed socket ingress port for the daemon (the low-overhead
+    # wire: 4-byte big-endian frame length + JSON payload, persistent
+    # connections). 0 = ephemeral. Env: KEYSTONE_SERVE_SOCKET_PORT.
+    serve_socket_port: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_SERVE_SOCKET_PORT", 0)
+    )
+    # Tenant/quota/SLA table for daemon admission control:
+    # 'name:api_key:qps:tier,...' entries — qps is the token-bucket refill
+    # rate (0 = unlimited), tier is 'gold' or 'best_effort'. Empty = open
+    # mode (no API keys; every request is an anonymous best-effort
+    # tenant). Env: KEYSTONE_TENANTS.
+    tenants: str = field(
+        default_factory=lambda: os.environ.get("KEYSTONE_TENANTS", "")
+    )
+    # Global admission budget: the most requests the daemon holds admitted
+    # (accepted but not yet responded) across every tenant before
+    # fast-failing with 429. Best-effort tenants are refused earlier (at
+    # BE_BUDGET_FRAC of this) so gold always has reserved headroom — the
+    # queue-priority half of the SLA tiers.
+    # Env: KEYSTONE_SERVE_PENDING_BUDGET.
+    serve_pending_budget: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_SERVE_PENDING_BUDGET", 256)
+    )
+    # Per-tier default deadlines (ms) the daemon stamps on each admitted
+    # request: gold = the latency SLA (0 = none); best_effort usually
+    # runs without one. An explicit per-request deadline overrides.
+    # Env: KEYSTONE_SERVE_GOLD_DEADLINE_MS / KEYSTONE_SERVE_BE_DEADLINE_MS.
+    serve_gold_deadline_ms: float = field(
+        default_factory=lambda: _env_float(
+            "KEYSTONE_SERVE_GOLD_DEADLINE_MS", 500.0
+        )
+    )
+    serve_be_deadline_ms: float = field(
+        default_factory=lambda: _env_float("KEYSTONE_SERVE_BE_DEADLINE_MS",
+                                           0.0)
+    )
+    # Hot-swap drain bound (ms): how long the generation flip waits for
+    # the OLD generation's service to drain its queued + in-flight
+    # requests before failing the stragglers with ServiceClosed (the
+    # daemon then transparently re-submits them on the new generation).
+    # Env: KEYSTONE_SWAP_DRAIN_MS.
+    swap_drain_ms: float = field(
+        default_factory=lambda: _env_float("KEYSTONE_SWAP_DRAIN_MS", 30000.0)
+    )
+    # Upper bound (ms) a synchronous /swap request waits for the swap
+    # worker before reporting 504 (the swap itself keeps running).
+    # Env: KEYSTONE_SWAP_TIMEOUT_MS.
+    swap_timeout_ms: float = field(
+        default_factory=lambda: _env_float("KEYSTONE_SWAP_TIMEOUT_MS",
+                                           120000.0)
+    )
+    # Control-plane credential: when set, POST /swap requires a matching
+    # X-Swap-Token header and /stats serves its full (tenant-naming)
+    # payload only to token holders. When UNSET while KEYSTONE_TENANTS
+    # is configured, /swap over HTTP is refused outright (403) — a
+    # data-plane key must never be able to replace the model, and an
+    # admission-controlled daemon must not ship with an open control
+    # plane. Open dev mode (no tenants, no token) leaves /swap open.
+    # Env: KEYSTONE_SWAP_TOKEN.
+    swap_token: str = field(
+        default_factory=lambda: os.environ.get("KEYSTONE_SWAP_TOKEN", "")
+    )
     # Pipeline-graph lint gate (workflow/analysis.py): run the static
     # graph linter before every fit()/compiled(). "off" (default) = never;
     # "warn" = log findings at their severity; "error" = additionally

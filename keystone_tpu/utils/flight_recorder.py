@@ -63,22 +63,55 @@ def next_request_id() -> int:
     return next(_req_seq)
 
 
+def derive_health(stats: Dict[str, Any]) -> Tuple[bool, Dict[str, Any]]:
+    """(healthy, /healthz body) from a health-source stats dict — THE
+    one health rule, applied identically by the serving daemon's own
+    /healthz (workflow/daemon.py) and a ``tools/metrics_server.py``
+    pointed at ``daemon.health_stats``, so the two surfaces can never
+    disagree about the same service. Unhealthy when the worker died,
+    the service closed, OR a hot-swap is mid-drain (``draining: true``
+    tells load balancers to stop sending traffic early). Generation
+    identity fields surface at the top level. Lives here, next to the
+    journey machinery, because health derivation is pure dict logic
+    that both the daemon (workflow/daemon.py) and the metrics sidecar
+    (tools/metrics_server.py) must share — one source, no drift."""
+    healthy = (
+        bool(stats.get("worker_alive", True))
+        and not bool(stats.get("closed", False))
+        and not bool(stats.get("draining", False))
+    )
+    doc: Dict[str, Any] = {"healthy": healthy}
+    for key in ("generation", "artifact_fingerprint", "draining"):
+        if key in stats:
+            doc[key] = stats[key]
+    doc["stats"] = stats
+    return healthy, doc
+
+
 class FlightRecord:
     """One request's journey: phase stamps appended in flight, serialized
     whole at dump time. Single-writer by ownership handoff (see module
-    docstring) — no lock of its own."""
+    docstring) — no lock of its own.
 
-    __slots__ = ("rid", "rows", "bucket", "replicas", "phases", "outcome")
+    ``first_phase`` names the journey's opening stamp: ``submitted`` for
+    in-process service requests (the default), ``accepted`` for daemon
+    ingress journeys whose network leg starts at the socket. ``meta``
+    (via :meth:`note`) carries transport attributes — tenant, SLA tier,
+    generation, HTTP status — without widening the stamp schema."""
 
-    def __init__(self, rid: int, rows: int):
+    __slots__ = ("rid", "rows", "bucket", "replicas", "phases", "outcome",
+                 "meta")
+
+    def __init__(self, rid: int, rows: int, first_phase: str = "submitted"):
         self.rid = rid
         self.rows = rows
         self.bucket: Optional[int] = None
         self.replicas: List[int] = []
         self.phases: List[Tuple[str, int]] = [
-            ("submitted", time.perf_counter_ns())
+            (first_phase, time.perf_counter_ns())
         ]
         self.outcome: Optional[str] = None
+        self.meta: Optional[Dict[str, Any]] = None
 
     def stamp(self, phase: str) -> None:
         """Append a (phase, perf_counter_ns) stamp. Phases repeat when a
@@ -97,8 +130,19 @@ class FlightRecord:
         self.outcome = outcome
         self.stamp("resolved")
 
+    def note(self, **attrs: Any) -> None:
+        """Attach transport metadata (tenant, tier, generation, status)
+        to the journey; repeat calls merge. Copy-on-write: a concurrent
+        ``snapshot()``/``dump()`` copies ``meta``, and inserting a key
+        into the dict it is iterating would raise RuntimeError mid-dump
+        — the lock-light torn-read contract covers append-only lists,
+        so the dict must be swapped atomically instead of mutated."""
+        merged = dict(self.meta) if self.meta else {}
+        merged.update(attrs)
+        self.meta = merged
+
     def as_dict(self) -> Dict[str, Any]:
-        return {
+        d = {
             "id": self.rid,
             "rows": self.rows,
             "bucket": self.bucket,
@@ -108,6 +152,10 @@ class FlightRecord:
             ],
             "outcome": self.outcome,
         }
+        meta = self.meta  # one read: note() swaps the reference
+        if meta:
+            d["meta"] = dict(meta)
+        return d
 
 
 class FlightRecorder:
@@ -168,12 +216,14 @@ class FlightRecorder:
 
     # -- recording (the hot path) ------------------------------------------
 
-    def start(self, rid: int, rows: int) -> FlightRecord:
+    def start(self, rid: int, rows: int,
+              first_phase: str = "submitted") -> FlightRecord:
         """Open one request's journey record and enter it in the ring.
         The record is mutated in place as the request progresses; the
         ring holds the reference, so in-flight requests are visible to a
-        dump exactly as far as they got."""
-        rec = FlightRecord(rid, rows)
+        dump exactly as far as they got. ``first_phase`` names the
+        opening stamp (daemon ingress journeys start at ``accepted``)."""
+        rec = FlightRecord(rid, rows, first_phase=first_phase)
         self.add(rec)
         return rec
 

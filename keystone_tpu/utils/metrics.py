@@ -960,12 +960,30 @@ def environment_fingerprint(devices: bool = True) -> Dict[str, Any]:
     initialization, not just fail it."""
     import platform as _platform
 
+    def _redact(name: str, value: str) -> str:
+        # Secrets must never ride the fingerprint into committed bench
+        # JSON: KEYSTONE_SWAP_TOKEN is the control-plane credential
+        # (fully masked), KEYSTONE_TENANTS carries tenant API KEYS
+        # ('name:api_key:qps[:tier[:burst]]' — the key field is masked,
+        # the name/qps/tier provenance survives).
+        if name == "KEYSTONE_SWAP_TOKEN" and value:
+            return "****"
+        if name != "KEYSTONE_TENANTS" or not value.strip():
+            return value
+        masked = []
+        for token in value.split(","):
+            parts = token.split(":")
+            if len(parts) >= 2:
+                parts[1] = "****"
+            masked.append(":".join(parts))
+        return ",".join(masked)
+
     fp: Dict[str, Any] = {
         "jax": getattr(jax, "__version__", None),
         "python": _platform.python_version(),
         "cpu_count": os.cpu_count(),
         "keystone_env": {
-            k: v for k, v in sorted(os.environ.items())
+            k: _redact(k, v) for k, v in sorted(os.environ.items())
             if k.startswith("KEYSTONE_")
         },
     }

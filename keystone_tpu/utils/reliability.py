@@ -34,6 +34,15 @@ Sites (consumed where the seam lives):
 - ``replica_death`` — one serving replica's completion thread dies; its
   in-flight flush groups re-queue and re-dispatch to the surviving
   replicas (a fully dead pool revives itself). Zero stranded futures.
+- ``conn_drop`` — the serving daemon's client connection drops before
+  the response is written (workflow/daemon.py). The request WAS served
+  (the future resolved — never stranded); only the response write is
+  lost, and the journey records outcome ``conn_drop``.
+- ``swap_abort`` — a model hot-swap dies mid-handoff (after the new
+  artifact loaded, before the generation flip). The daemon rolls back:
+  the old generation keeps serving, the half-warmed successor is
+  discarded, and the flight recorder force-dumps naming the generation
+  and every in-flight request id.
 
 Counts (``oom:1``) fire on the first N checks of the site; probabilities
 (``io:0.05``) draw from a per-site ``random.Random`` seeded from
@@ -75,6 +84,29 @@ class ServiceClosed(RuntimeError):
 class WorkerDiedError(RuntimeError):
     """The serving worker died while this request was in flight; the
     request may or may not have executed. Safe to retry idempotent work."""
+
+
+class QuotaExceeded(QueueFullError):
+    """Fast-fail admission: the tenant's token-bucket QPS quota is
+    exhausted. A subclass of QueueFullError so one 429 mapping covers
+    both over-quota and over-budget rejections."""
+
+
+class AuthError(PermissionError):
+    """The request named no tenant the daemon knows (missing or unknown
+    API key while tenant admission is configured)."""
+
+
+class ConnectionDropped(ConnectionError):
+    """The client connection dropped before the daemon could write the
+    response (real broken pipe, or the harness's ``conn_drop`` site).
+    The serve itself completed; only the answer was lost."""
+
+
+class SwapAborted(RuntimeError):
+    """A model hot-swap failed mid-handoff (the harness's ``swap_abort``
+    site, or a real warmup/load failure). The daemon rolls back to the
+    old generation — an aborted swap is a rollback, never an outage."""
 
 
 class RecordCorruptError(ValueError):
@@ -138,6 +170,12 @@ class FaultPlan:
         ),
         "oom": lambda: InjectedOOM(
             "RESOURCE_EXHAUSTED: injected device OOM (KEYSTONE_FAULTS oom)"
+        ),
+        "conn_drop": lambda: ConnectionDropped(
+            "injected client connection drop (KEYSTONE_FAULTS conn_drop)"
+        ),
+        "swap_abort": lambda: SwapAborted(
+            "injected mid-swap abort (KEYSTONE_FAULTS swap_abort)"
         ),
     }
 

@@ -22,7 +22,14 @@ from keystone_tpu.workflow.optimizer import (
     Rule,
     default_optimizer,
 )
-from keystone_tpu.workflow.serialization import load_pipeline, save_pipeline
+from keystone_tpu.workflow.serialization import (
+    ArtifactVersionError,
+    ModelArtifact,
+    load_artifact,
+    load_pipeline,
+    save_artifact,
+    save_pipeline,
+)
 from keystone_tpu.workflow.serving import (
     CompiledPipeline,
     DeadlineExceeded,
@@ -54,6 +61,10 @@ __all__ = [
     "default_optimizer",
     "save_pipeline",
     "load_pipeline",
+    "save_artifact",
+    "load_artifact",
+    "ModelArtifact",
+    "ArtifactVersionError",
     "Diagnostic",
     "LintError",
     "LintReport",

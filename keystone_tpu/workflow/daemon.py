@@ -1,0 +1,1415 @@
+"""Networked serving daemon: admission control, SLA tiers, hot-swap.
+
+``PipelineService`` coalesces and serves — but only in-process. The
+north-star workload ("millions of users", ROADMAP item 3) needs a wire,
+per-tenant protection, and the ability to replace the model under load
+without dropping a request. This module is that layer, extending the
+stdlib ``tools/metrics_server.py`` server pattern into a data-plane
+ingress over the existing replica-pool service:
+
+- **Two ingresses, one core.** An HTTP/JSON ingress
+  (``POST /predict``; stdlib ``ThreadingHTTPServer``) for
+  compatibility, and a length-prefixed socket ingress (4-byte
+  big-endian frame length + JSON payload, persistent connections) for
+  cheap high-rate clients. Both feed ``serve_request`` — the shared
+  admit→submit→await core — so semantics can never drift between wires.
+
+- **Admission control** (fast-fail philosophy of arXiv:2206.14148 —
+  refuse work you cannot finish instead of degrading everyone): tenants
+  are named API keys (``KEYSTONE_TENANTS`` /
+  :func:`parse_tenants`) carrying a token-bucket QPS quota and an SLA
+  tier. An over-quota tenant gets HTTP 429 BEFORE any device work
+  (``QuotaExceeded``, a ``QueueFullError``); a global pending budget
+  (``KEYSTONE_SERVE_PENDING_BUDGET``) caps admitted-but-unanswered
+  requests across all tenants, with **best-effort refused at
+  ``BE_BUDGET_FRAC`` of the budget** so gold always has reserved
+  headroom — the queue-priority half of the SLA. Tiers also select the
+  per-request deadline (``KEYSTONE_SERVE_GOLD_DEADLINE_MS`` /
+  ``KEYSTONE_SERVE_BE_DEADLINE_MS``); a breached deadline surfaces as
+  HTTP 504 (``DeadlineExceeded``), a full service queue as 429, a
+  closed/mid-flip service as 503.
+
+- **Fit→serve handoff + zero-downtime hot-swap.** The daemon serves one
+  :class:`~keystone_tpu.workflow.serialization.ModelArtifact` at a time,
+  tagged with an atomic generation counter. ``request_swap(path)`` (or
+  ``POST /swap``) loads + verifies the new artifact, AOT-warms the
+  successor engine's bucket ladder **replica-by-replica** — after each
+  new replica warms, the outgoing generation's matching replica is
+  drained via the PR-5 replica-death re-queue machinery
+  (``PipelineService.retire_replica``: its in-flight groups re-dispatch
+  to the surviving old replicas), so the old generation keeps answering
+  on the devices not yet handed over — then flips the generation
+  atomically and drains the old service (``close(drain=True)``). Zero
+  dropped requests: a request caught on the closing generation is
+  transparently re-submitted to its successor (the serve chain is
+  pure). Every response carries the generation that served it. A
+  mid-swap failure (the ``swap_abort`` fault site, a bad artifact, a
+  warmup error) rolls back — retired replicas revive, the old
+  generation keeps serving — and force-dumps the flight recorder naming
+  the generation and every in-flight request id.
+
+- **Failure semantics on the wire.** Journeys now carry the network
+  leg: every data-plane request gets an always-on flight-recorder
+  record with ``accepted → parsed → admitted → submitted → resolved``
+  stamps plus tenant/tier/generation/status metadata (the HTTP path
+  pre-admits on the header key before reading the body, so its order is
+  ``accepted → admitted → parsed → …``; the framed socket — and a
+  body-carried key — parses first). The ``conn_drop``
+  fault site (and any real broken pipe at response-write time) marks
+  the journey outcome ``conn_drop`` — the future itself resolved;
+  nothing is stranded. Accepted connections carry read timeouts
+  (``CONN_TIMEOUT_S``) so a stalled client cannot pin a handler thread
+  forever.
+"""
+
+from __future__ import annotations
+
+import hmac
+import json
+import logging
+import os
+import queue
+import socket
+import struct
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+from concurrent.futures import TimeoutError as FutureTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from keystone_tpu.config import config
+from keystone_tpu.utils.flight_recorder import (
+    FlightRecord,
+    FlightRecorder,
+    derive_health,
+    next_request_id,
+)
+from keystone_tpu.utils.metrics import metrics_registry
+from keystone_tpu.utils.reliability import (
+    AuthError,
+    DeadlineExceeded,
+    QueueFullError,
+    QuotaExceeded,
+    ServiceClosed,
+    WorkerDiedError,
+    active_plan,
+)
+from keystone_tpu.workflow.serialization import (
+    ModelArtifact,
+    load_artifact,
+)
+from keystone_tpu.workflow.serving import (
+    CompiledPipeline,
+    PipelineService,
+    resolve_serve_devices,
+)
+
+logger = logging.getLogger("keystone_tpu")
+
+#: Fraction of the global pending budget best-effort tenants may fill;
+#: the remainder is gold's reserved headroom.
+BE_BUDGET_FRAC = 0.8
+
+#: Read/write timeout on accepted data-plane connections (and the HTTP
+#: handler's request-read timeout): a stalled client must not pin a
+#: handler thread forever.
+CONN_TIMEOUT_S = 30.0
+
+#: Largest accepted request body / socket frame.
+MAX_FRAME_BYTES = 64 << 20
+
+#: Bound on waiting for one submitted future when no deadline applies.
+RESULT_TIMEOUT_S = 60.0
+
+#: How many generations a request will chase across a concurrent swap
+#: before giving up with 503 (2 swaps back-to-back + margin).
+SUBMIT_ATTEMPTS = 4
+
+VALID_TIERS = ("gold", "best_effort")
+
+#: HTTP status → journey/counter outcome for data-plane responses.
+STATUS_OUTCOMES = {
+    200: "ok",
+    400: "bad_request",
+    403: "auth",
+    429: "rejected",
+    503: "closed",
+    504: "expired",
+    500: "error",
+}
+
+
+class Tenant:
+    """One admission-control principal: API key, token-bucket QPS quota,
+    and SLA tier."""
+
+    __slots__ = ("name", "key", "qps", "burst", "tier")
+
+    def __init__(self, name: str, key: Optional[str], qps: float = 0.0,
+                 tier: str = "best_effort", burst: Optional[float] = None):
+        if tier not in VALID_TIERS:
+            raise ValueError(
+                f"tenant {name!r}: tier must be one of {VALID_TIERS}, "
+                f"got {tier!r}"
+            )
+        self.name = name
+        self.key = key
+        self.qps = float(qps)
+        self.tier = tier
+        # Default burst: one second of rate (classic token bucket), at
+        # least 1 so a tiny-qps tenant can ever send.
+        self.burst = float(burst) if burst is not None else max(1.0, self.qps)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "qps": self.qps, "burst": self.burst,
+                "tier": self.tier}
+
+
+def parse_tenants(spec: str) -> Dict[str, Tenant]:
+    """Parse the ``KEYSTONE_TENANTS`` table: comma-separated
+    ``name:api_key:qps[:tier[:burst]]`` entries, keyed by API key.
+    Empty/blank = open mode (no keys; anonymous best-effort). Bad
+    entries fail loudly naming the token — a silently dropped tenant is
+    an auth hole."""
+    tenants: Dict[str, Tenant] = {}
+    for token in (spec or "").split(","):
+        token = token.strip()
+        if not token:
+            continue
+        parts = [p.strip() for p in token.split(":")]
+        if len(parts) < 2 or not parts[0] or not parts[1]:
+            raise ValueError(
+                f"KEYSTONE_TENANTS entry {token!r}: expected "
+                "'name:api_key:qps[:tier[:burst]]'"
+            )
+        name, key = parts[0], parts[1]
+        try:
+            qps = float(parts[2]) if len(parts) > 2 and parts[2] else 0.0
+            burst = (
+                float(parts[4]) if len(parts) > 4 and parts[4] else None
+            )
+        except ValueError:
+            raise ValueError(
+                f"KEYSTONE_TENANTS entry {token!r}: qps/burst must be "
+                "numbers"
+            ) from None
+        tier = parts[3] if len(parts) > 3 and parts[3] else "best_effort"
+        if key in tenants:
+            raise ValueError(
+                f"KEYSTONE_TENANTS: duplicate api key for tenant {name!r}"
+            )
+        tenants[key] = Tenant(name, key, qps=qps, tier=tier, burst=burst)
+    return tenants
+
+
+class TokenBucket:
+    """Thread-safe token bucket: ``rate`` tokens/s up to ``burst``.
+    ``rate <= 0`` = unlimited."""
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self._lock = threading.Lock()
+        self._tokens = self.burst
+        self._t_last = time.perf_counter()
+
+    def try_acquire(self) -> bool:
+        if self.rate <= 0:
+            return True
+        with self._lock:
+            now = time.perf_counter()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._t_last) * self.rate
+            )
+            self._t_last = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+
+class AdmissionController:
+    """Per-tenant quota + global pending-budget gate, evaluated BEFORE a
+    request costs any queueing or device work.
+
+    Order matters: auth first (403), then the tenant's token bucket
+    (429 ``QuotaExceeded`` — an over-quota tenant is rejected by ITS
+    quota even when the daemon is idle), then the global budget (429
+    ``QueueFullError`` — best-effort refused at ``be_frac`` of the
+    budget so gold keeps reserved headroom)."""
+
+    def __init__(self, tenants: Dict[str, Tenant], pending_budget: int,
+                 be_frac: float = BE_BUDGET_FRAC):
+        self.tenants = dict(tenants)
+        self.open_mode = not self.tenants
+        self.pending_budget = int(pending_budget)
+        if self.pending_budget < 1:
+            raise ValueError(
+                f"pending budget must be >= 1, got {self.pending_budget}"
+            )
+        self.be_frac = float(be_frac)
+        self._anonymous = Tenant("anonymous", None, qps=0.0,
+                                 tier="best_effort")
+        self._buckets = {
+            key: TokenBucket(t.qps, t.burst)
+            for key, t in self.tenants.items()
+        }
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self.admitted = 0
+        self.rejected_auth = 0
+        self.rejected_quota = 0
+        self.rejected_budget = 0
+
+    def admit(self, key: Optional[str]) -> Tenant:
+        if self.open_mode:
+            tenant = self._anonymous
+        else:
+            tenant = self.tenants.get(key) if key else None
+            if tenant is None:
+                with self._lock:
+                    self.rejected_auth += 1
+                raise AuthError(
+                    "unknown or missing API key (daemon tenants are "
+                    "configured; see KEYSTONE_TENANTS)"
+                )
+            if not self._buckets[tenant.key].try_acquire():
+                with self._lock:
+                    self.rejected_quota += 1
+                raise QuotaExceeded(
+                    f"tenant {tenant.name!r}: QPS quota "
+                    f"({tenant.qps:g}/s, burst {tenant.burst:g}) exhausted; "
+                    "request rejected fast"
+                )
+        limit = (
+            self.pending_budget if tenant.tier == "gold"
+            else max(1, int(self.pending_budget * self.be_frac))
+        )
+        with self._lock:
+            if self._inflight >= limit:
+                self.rejected_budget += 1
+                raise QueueFullError(
+                    f"admission budget full ({self._inflight} in flight, "
+                    f"{tenant.tier} limit {limit} of "
+                    f"{self.pending_budget}); request rejected fast"
+                )
+            self._inflight += 1
+            self.admitted += 1
+        return tenant
+
+    def release(self) -> None:
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "open_mode": self.open_mode,
+                "tenants": [t.as_dict() for t in self.tenants.values()],
+                "pending_budget": self.pending_budget,
+                "be_frac": self.be_frac,
+                "inflight": self._inflight,
+                "admitted": self.admitted,
+                "rejected_auth": self.rejected_auth,
+                "rejected_quota": self.rejected_quota,
+                "rejected_budget": self.rejected_budget,
+            }
+
+
+class Generation:
+    """One serving generation: the artifact identity plus the live
+    engine/service pair answering under that identity."""
+
+    __slots__ = ("number", "fingerprint", "engine", "service",
+                 "artifact_header")
+
+    def __init__(self, number: int, fingerprint: str,
+                 engine: CompiledPipeline, service: PipelineService,
+                 artifact_header: Dict[str, Any]):
+        self.number = number
+        self.fingerprint = fingerprint
+        self.engine = engine
+        self.service = service
+        self.artifact_header = artifact_header
+
+
+def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly n bytes, or None on a clean/raggedy disconnect.
+    Chunks accumulate in a list (one join at the end): ``buf += chunk``
+    would memcpy the whole accumulated buffer per ~64KB recv — quadratic
+    cost an adversary could lever with frames near MAX_FRAME_BYTES."""
+    chunks: List[bytes] = []
+    got = 0
+    while got < n:
+        try:
+            chunk = conn.recv(n - got)
+        except (ConnectionError, socket.timeout, OSError):
+            return None
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+class _IngressHandler(BaseHTTPRequestHandler):
+    """HTTP/JSON ingress routes. Data plane: ``POST /predict``.
+    Control plane: ``POST /swap``, ``GET /healthz|/metrics|/stats``
+    (control responses are exempt from the ``conn_drop`` site — it
+    models client data traffic, and a dropped swap ack must not make a
+    retried swap run twice)."""
+
+    #: Connection-level read timeout (satellite: a stalled client must
+    #: not pin a handler thread).
+    timeout = CONN_TIMEOUT_S
+
+    @property
+    def owner(self) -> "ServingDaemon":
+        return self.server.owner  # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):  # quiet: journeys are the log
+        pass
+
+    def _write_json(self, status: int, doc: Dict[str, Any]) -> bool:
+        body = json.dumps(doc).encode()
+        try:
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            if "generation" in doc:
+                self.send_header("X-Generation", str(doc["generation"]))
+            self.end_headers()
+            self.wfile.write(body)
+            return True
+        except (ConnectionError, TimeoutError, OSError):
+            # The client went away mid-write: a real conn_drop.
+            self.close_connection = True
+            return False
+
+    def _read_body(self, deadline: Optional[float] = None) -> Optional[bytes]:
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            return None
+        if length <= 0 or length > MAX_FRAME_BYTES:
+            return None
+        return self._read_deadlined(length, deadline)
+
+    def _read_deadlined(self, length: int,
+                        deadline: Optional[float] = None) -> Optional[bytes]:
+        """Read exactly ``length`` body bytes under ONE total deadline.
+
+        The per-recv socket timeout alone cannot bound this: the HTTP
+        path pre-admits on the header key BEFORE the body arrives, so a
+        client trickling one byte per 29s would hold its admission slot
+        (a global-budget unit) indefinitely while every individual recv
+        still beats ``CONN_TIMEOUT_S`` — pinned slots would starve all
+        tenants, gold included. ``read1`` (at most one underlying recv
+        per call, buffered data first) lets the deadline be re-checked
+        between recvs, bounding the slot hold to ~CONN_TIMEOUT_S total.
+        ``_predict`` passes ONE deadline shared by its body read AND the
+        post-rejection drain — two fresh deadlines would double the
+        window a trickler can hold its slot.
+        """
+        if deadline is None:
+            deadline = time.monotonic() + CONN_TIMEOUT_S
+        read1 = getattr(self.rfile, "read1", self.rfile.read)
+        chunks: List[bytes] = []
+        remaining = length
+        try:
+            while remaining > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return None
+                self.connection.settimeout(min(left, CONN_TIMEOUT_S))
+                chunk = read1(min(65536, remaining))
+                if not chunk:
+                    return None
+                chunks.append(chunk)
+                remaining -= len(chunk)
+        except (ConnectionError, TimeoutError, OSError):
+            return None
+        finally:
+            try:
+                self.connection.settimeout(CONN_TIMEOUT_S)
+            except OSError:
+                pass
+        return b"".join(chunks)
+
+    def _drain_body(self, cap: int = 4 << 20,
+                    deadline: Optional[float] = None) -> None:
+        """Read (and discard) up to ``cap`` bytes of an unread request
+        body before responding to an early rejection: closing a socket
+        with unread received data makes Linux RST the connection, which
+        can destroy the in-flight 429/400 before the client reads it —
+        and a retrying client would then re-send the whole body (the Go
+        net/http drain idiom). The cap covers realistic prediction
+        payloads; it stays bounded (rather than draining the full 64MB
+        frame limit) and rides ``_read_deadlined``'s total deadline, so
+        a slow sender can pin a rejected handler for at most
+        ~``CONN_TIMEOUT_S`` — not ``cap`` bytes' worth of trickle."""
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            return
+        if length > 0:
+            self._read_deadlined(min(length, cap), deadline)
+
+    def _control_denied(self) -> Optional[str]:
+        """None when this caller may use the control plane (POST /swap,
+        full /stats); else the refusal message. A data-plane tenant key
+        is NOT control-plane credit — swapping the model is operator
+        privilege, so it takes the dedicated ``KEYSTONE_SWAP_TOKEN``
+        (constant-time compare). With tenants configured but no token
+        set, the control plane is locked rather than open: admission
+        control would otherwise guard /predict while any anonymous peer
+        could replace the model behind it. Open dev mode (no tenants,
+        no token) stays open."""
+        owner = self.owner
+        token = owner.swap_token
+        if token:
+            supplied = self.headers.get("X-Swap-Token") or ""
+            if hmac.compare_digest(supplied.encode(), token.encode()):
+                return None
+            return "bad or missing X-Swap-Token"
+        if owner.admission_open:
+            return None
+        return ("control plane locked: tenants are configured but "
+                "KEYSTONE_SWAP_TOKEN is not set")
+
+    # -- data plane --------------------------------------------------------
+
+    def _predict(self) -> None:
+        owner = self.owner
+        rec = owner.open_record()
+        # Pre-admission on the HEADER key (and in open mode) BEFORE the
+        # body is read: a rejected multi-MB request must not cost the
+        # daemon its socket read + JSON parse — that read would be an
+        # amplification lever during exactly the overload admission
+        # exists for. A body-carried key still works; it just pays the
+        # read first.
+        tenant = None
+        # ONE deadline for everything this request reads off the wire
+        # (body, or the post-rejection drain): an admitted slot is held
+        # for at most ~CONN_TIMEOUT_S of client trickling, total.
+        body_deadline = time.monotonic() + CONN_TIMEOUT_S
+        key_hdr = self.headers.get("X-API-Key")
+        if key_hdr is not None or owner.admission_open:
+            tenant, rejection = owner.admit_request(rec, key_hdr)
+            if rejection is not None:
+                status, doc, outcome = rejection
+                # unread body would RST the response
+                self._drain_body(deadline=body_deadline)
+                wrote = self._write_json(status, doc)
+                owner.finish_request(
+                    rec, outcome if wrote else "conn_drop", None, status
+                )
+                return
+        body = self._read_body(deadline=body_deadline)
+        payload: Optional[dict] = None
+        if body is not None:
+            try:
+                parsed = json.loads(body)
+                if isinstance(parsed, dict):
+                    payload = parsed
+            except ValueError:
+                payload = None
+        if payload is None or "x" not in payload:
+            doc = {"error": "bad_request",
+                   "message": "expected a JSON object body with an 'x' "
+                              "array", "request_id": rec.rid}
+            if body is None:
+                # over-bound/unread body: same RST risk
+                self._drain_body(deadline=body_deadline)
+            wrote = self._write_json(400, doc)
+            # tenant rides along: a pre-admitted slot must release.
+            owner.finish_request(
+                rec, "bad_request" if wrote else "conn_drop", tenant, 400
+            )
+            return
+        rec.stamp("parsed")
+        key = key_hdr or payload.get("key")
+        deadline_ms = payload.get("deadline_ms")
+        hdr_deadline = self.headers.get("X-Deadline-Ms")
+        if hdr_deadline is not None:
+            try:
+                deadline_ms = float(hdr_deadline)
+            except ValueError:
+                # Same contract as a garbage body deadline: an explicit
+                # but unreadable override is a 400, not a silent
+                # fallback to the tier default.
+                doc = {"error": "bad_request",
+                       "message": f"X-Deadline-Ms must be a number, got "
+                                  f"{hdr_deadline!r}",
+                       "request_id": rec.rid}
+                wrote = self._write_json(400, doc)
+                owner.finish_request(
+                    rec, "bad_request" if wrote else "conn_drop", tenant, 400
+                )
+                return
+        status, doc, tenant, outcome = owner.serve_request(
+            rec, key, payload["x"], deadline_ms, tenant=tenant
+        )
+        if owner.maybe_drop_connection():
+            # Injected client-side drop: the serve completed (the future
+            # resolved — nothing stranded); only the answer is lost.
+            self.close_connection = True
+            owner.finish_request(rec, "conn_drop", tenant, status)
+            return
+        wrote = self._write_json(status, doc)
+        owner.finish_request(
+            rec, outcome if wrote else "conn_drop", tenant, status
+        )
+
+    # -- control plane -----------------------------------------------------
+
+    def _swap(self) -> None:
+        owner = self.owner
+        denied = self._control_denied()
+        if denied is not None:
+            self._drain_body()
+            self._write_json(403, {"error": "forbidden", "message": denied})
+            return
+        body = self._read_body()
+        try:
+            payload = json.loads(body) if body else {}
+        except ValueError:
+            payload = None
+        if not isinstance(payload, dict) or not payload.get("artifact"):
+            self._write_json(400, {
+                "error": "bad_request",
+                "message": "expected {'artifact': <path>}",
+            })
+            return
+        try:
+            generation = owner.request_swap(
+                payload["artifact"],
+                expect_fingerprint=payload.get("expect_fingerprint"),
+            )
+        except FutureTimeout:
+            self._write_json(504, {
+                "error": "swap_timeout",
+                "message": "swap still running past KEYSTONE_SWAP_TIMEOUT_MS",
+            })
+            return
+        except Exception as e:  # lint: broad-ok any swap failure becomes the control response; the ingress must survive
+            self._write_json(409, {
+                "error": type(e).__name__,
+                "message": str(e)[:500],
+            })
+            return
+        self._write_json(200, {"generation": generation})
+
+    def do_POST(self):  # noqa: N802 (http.server API)
+        path = self.path.split("?")[0]
+        if path == "/predict":
+            self._predict()
+        elif path == "/swap":
+            self._swap()
+        else:
+            self._write_json(404, {"error": "not_found"})
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        path = self.path.split("?")[0]
+        owner = self.owner
+        if path == "/healthz":
+            healthy, doc = derive_health(owner.health_stats())
+            self._write_json(200 if healthy else 503, doc)
+        elif path == "/metrics":
+            body = metrics_registry.prometheus().encode()
+            try:
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            except (ConnectionError, TimeoutError, OSError):
+                self.close_connection = True
+        elif path == "/stats":
+            # Anonymous callers get operational stats with the tenant
+            # table reduced to a count — names/quotas/tiers are control
+            # plane (healthz/metrics stay fully open for LBs/scrapers).
+            self._write_json(
+                200, owner.stats(redact_tenants=self._control_denied()
+                                 is not None)
+            )
+        else:
+            self._write_json(404, {"error": "not_found"})
+
+
+class ServingDaemon:
+    """The networked serving frontend over a hot-swappable generation of
+    ``CompiledPipeline`` + ``PipelineService`` (module docstring has the
+    architecture). Construct from a saved artifact path (the fit→serve
+    handoff) or directly from a fitted pipeline/transformer (tests,
+    demos)."""
+
+    #: Per-thread bound on waiting for the ingress/swap threads at
+    #: close() (class attr so tests can shrink it to exercise the
+    #: close-outlives-a-long-swap path without the full wait).
+    CLOSE_JOIN_S = 10.0
+
+    def __init__(
+        self,
+        artifact: Optional[Any] = None,
+        *,
+        pipeline: Any = None,
+        host: Optional[str] = None,
+        http_port: Optional[int] = None,
+        socket_port: Optional[int] = None,
+        enable_socket: bool = True,
+        tenants: Optional[Dict[str, Tenant]] = None,
+        pending_budget: Optional[int] = None,
+        buckets=None,
+        max_batch: Optional[int] = None,
+        devices=None,
+        inflight: Optional[int] = None,
+        max_delay_ms: float = 2.0,
+        max_rows: Optional[int] = None,
+        max_pending: Optional[int] = None,
+        feature_shape: Optional[Tuple[int, ...]] = None,
+        dtype=None,
+        gold_deadline_ms: Optional[float] = None,
+        be_deadline_ms: Optional[float] = None,
+        name: Optional[str] = None,
+        flight_dir: Optional[str] = None,
+        swap_hook: Optional[Callable[["ServingDaemon"], None]] = None,
+        swap_token: Optional[str] = None,
+        result_timeout_s: float = RESULT_TIMEOUT_S,
+    ):
+        if (artifact is None) == (pipeline is None):
+            raise ValueError(
+                "construct with exactly one of artifact= (a saved "
+                "ModelArtifact path or object) or pipeline="
+            )
+        self.name = name or "daemon"
+        self.host = host if host is not None else config.serve_host
+        self._swap_hook = swap_hook
+        self.swap_token = (
+            config.swap_token if swap_token is None else str(swap_token)
+        )
+        self._result_timeout_s = float(result_timeout_s)
+        # Resolved ONCE per daemon (the active_plan discipline).
+        self._plan = active_plan()
+        # Engine/service construction knobs, reused for every successor
+        # generation so a swap never silently changes serving shape.
+        self._buckets = buckets
+        self._max_batch = max_batch
+        self._devices = resolve_serve_devices(devices)
+        self._inflight_opt = inflight
+        self._max_delay_ms = float(max_delay_ms)
+        self._max_rows = max_rows
+        self._max_pending = max_pending
+        self._flight_dir = flight_dir
+        tier_deadlines = {
+            "gold": (
+                config.serve_gold_deadline_ms
+                if gold_deadline_ms is None else float(gold_deadline_ms)
+            ),
+            "best_effort": (
+                config.serve_be_deadline_ms
+                if be_deadline_ms is None else float(be_deadline_ms)
+            ),
+        }
+        self._tier_deadline_ms = tier_deadlines
+        self._admission = AdmissionController(
+            parse_tenants(config.tenants) if tenants is None else tenants,
+            config.serve_pending_budget
+            if pending_budget is None else pending_budget,
+        )
+        self._outcomes = metrics_registry.counters(
+            f"daemon.requests[{self.name}]"
+        )
+        self._inflight_gauge = metrics_registry.gauge(
+            f"daemon.inflight[{self.name}]"
+        )
+        self._tier_hist = {
+            tier: metrics_registry.histogram(
+                f"daemon.e2e[{self.name}:{tier}]"
+            )
+            for tier in VALID_TIERS
+        }
+        # The daemon's OWN black box: network-leg journeys (accepted →
+        # parsed → admitted → submitted → resolved) with tenant / tier /
+        # generation / status metadata; dump context = self.stats (runs
+        # from unlocked poll points only).
+        self._flight = FlightRecorder(
+            f"daemon-{self.name}", directory=flight_dir, context=self.stats
+        )
+        self._lock = threading.Lock()
+        self._active: set = set()
+        self._draining = False
+        self._closed = False
+        self.swaps = 0
+        self.swap_failures = 0
+        # Generation 0: load/verify the artifact (or wrap the given
+        # pipeline), AOT-warm the whole ladder, stand up the service.
+        if artifact is not None and not isinstance(artifact, ModelArtifact):
+            artifact = load_artifact(str(artifact))
+        if artifact is not None:
+            target = artifact.pipeline
+            fingerprint = artifact.fingerprint
+            header = artifact.header()
+            serve_hints = artifact.serve
+        else:
+            target = pipeline
+            fingerprint = "unversioned"
+            header = {"schema_version": None, "fingerprint": fingerprint}
+            serve_hints = {}
+        if feature_shape is None and serve_hints.get("feature_shape"):
+            feature_shape = tuple(serve_hints["feature_shape"])
+        if feature_shape is None:
+            raise ValueError(
+                "feature_shape is required (pass it, or save the artifact "
+                "with serve hints: save_artifact(..., feature_shape=...))"
+            )
+        self._feature_shape = tuple(int(d) for d in feature_shape)
+        self._dtype = dtype if dtype is not None else serve_hints.get("dtype")
+        engine = self._build_engine(target, 0)
+        engine.warmup(self._feature_shape, dtype=self._dtype)
+        service = self._build_service(engine, 0)
+        self._gen = Generation(0, fingerprint, engine, service, header)
+        # Ingress last: no traffic before the ladder is warm.
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self.http_port: Optional[int] = None
+        self._sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self.socket_port: Optional[int] = None
+        self._swap_q: "queue.Queue" = queue.Queue()
+        self._swap_thread = threading.Thread(
+            target=self._swap_loop, name=f"keystone-daemon-swap-{self.name}",
+            daemon=True,
+        )
+        self._swap_thread.start()
+        try:
+            self._start_http(
+                config.serve_port if http_port is None else int(http_port)
+            )
+            if enable_socket:
+                self._start_socket(
+                    config.serve_socket_port if socket_port is None
+                    else int(socket_port)
+                )
+        except BaseException:
+            # An ingress bind failure (occupied port) must not leak the
+            # already-running generation service, swap worker, or a
+            # half-bound HTTP server — a retrying operator process would
+            # otherwise accumulate thread pools and keep the HTTP port
+            # wedged forever.
+            self.close()
+            raise
+
+    # -- construction helpers ----------------------------------------------
+
+    def _build_engine(self, target, number: int) -> CompiledPipeline:
+        return CompiledPipeline(
+            target,
+            buckets=self._buckets,
+            max_batch=self._max_batch,
+            devices=self._devices,
+            inflight=self._inflight_opt,
+            name=f"{self.name}-g{number}",
+        )
+
+    def _build_service(self, engine: CompiledPipeline,
+                       number: int) -> PipelineService:
+        return PipelineService(
+            engine,
+            max_delay_ms=self._max_delay_ms,
+            max_rows=self._max_rows,
+            max_pending=self._max_pending,
+            deadline_ms=0.0,  # deadlines come per-request from the tiers
+            inflight=self._inflight_opt,
+            name=f"{self.name}-g{number}",
+            flight_dir=self._flight_dir,
+        )
+
+    def _start_http(self, port: int) -> None:
+        self._httpd = ThreadingHTTPServer((self.host, port),
+                                          _IngressHandler)
+        self._httpd.owner = self  # type: ignore[attr-defined]
+        self._httpd.daemon_threads = True
+        self.http_port = self._httpd.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"keystone-daemon-http-{self.name}", daemon=True,
+        )
+        self._http_thread.start()
+
+    def _start_socket(self, port: int) -> None:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, port))
+        self._sock.listen(128)
+        # Timed accept: a blocked accept() is NOT interrupted by another
+        # thread closing the socket on Linux — the accept loop must poll
+        # the closed flag or close() would hang on the join.
+        self._sock.settimeout(0.5)
+        self.socket_port = self._sock.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop,
+            name=f"keystone-daemon-accept-{self.name}", daemon=True,
+        )
+        self._accept_thread.start()
+
+    # -- socket ingress (thread targets registered in keystone-lint) -------
+
+    def _accept_loop(self) -> None:
+        """Socket-ingress accept thread: one handler thread per
+        connection (persistent framed connections, so the per-conn spawn
+        amortizes over many requests)."""
+        sock = self._sock
+        while True:
+            try:
+                conn, _addr = sock.accept()
+            except socket.timeout:
+                with self._lock:
+                    if self._closed:
+                        return
+                continue
+            except OSError:
+                return  # listening socket closed: daemon shutdown
+            with self._lock:
+                closed = self._closed
+            if closed:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            conn.settimeout(CONN_TIMEOUT_S)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name=f"keystone-daemon-conn-{self.name}", daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        """One framed connection: 4-byte big-endian length + JSON
+        ``{"x": ..., "key": ..., "deadline_ms": ...}`` per request,
+        response framed the same with a ``status`` field. Loops until
+        the client closes (or a frame violates the protocol)."""
+        try:
+            while True:
+                header = _recv_exact(conn, 4)
+                if header is None:
+                    return
+                # Journey opens at the frame header — even a
+                # bounds-violating or truncated frame leaves a record
+                # (the open_record contract), mirroring the HTTP path.
+                (length,) = struct.unpack(">I", header)
+                rec = self.open_record()
+                if length == 0 or length > MAX_FRAME_BYTES:
+                    sent = self._send_frame(conn, {
+                        "status": 400, "error": "bad_request",
+                        "message": f"frame length {length} out of bounds",
+                        "request_id": rec.rid,
+                    })
+                    self.finish_request(
+                        rec, "bad_request" if sent else "conn_drop",
+                        None, 400,
+                    )
+                    return
+                data = _recv_exact(conn, length)
+                if data is None:
+                    # Client vanished mid-frame: the journey records the
+                    # drop instead of silently evaporating.
+                    self.finish_request(rec, "conn_drop", None, None)
+                    return
+                try:
+                    payload = json.loads(data)
+                    if not isinstance(payload, dict) or "x" not in payload:
+                        raise ValueError("expected an object with 'x'")
+                except ValueError as e:
+                    sent = self._send_frame(conn, {
+                        "status": 400, "error": "bad_request",
+                        "message": str(e)[:200], "request_id": rec.rid,
+                    })
+                    self.finish_request(
+                        rec, "bad_request" if sent else "conn_drop",
+                        None, 400,
+                    )
+                    continue
+                rec.stamp("parsed")
+                status, doc, tenant, outcome = self.serve_request(
+                    rec, payload.get("key"), payload["x"],
+                    payload.get("deadline_ms"),
+                )
+                if self.maybe_drop_connection():
+                    self.finish_request(rec, "conn_drop", tenant, status)
+                    return
+                sent = self._send_frame(conn, {"status": status, **doc})
+                self.finish_request(
+                    rec, outcome if sent else "conn_drop", tenant, status
+                )
+                if not sent:
+                    return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _send_frame(conn: socket.socket, doc: Dict[str, Any]) -> bool:
+        frame = json.dumps(doc).encode()
+        try:
+            conn.sendall(struct.pack(">I", len(frame)) + frame)
+            return True
+        except (ConnectionError, socket.timeout, OSError):
+            return False
+
+    # -- the shared data-plane core -----------------------------------------
+
+    def open_record(self) -> FlightRecord:
+        """Open one network-leg journey at connection-accept time, before
+        parsing — even an unparseable request leaves a record."""
+        rec = self._flight.start(
+            next_request_id(), 0, first_phase="accepted"
+        )
+        with self._lock:
+            self._active.add(rec.rid)
+            self._inflight_gauge.set(len(self._active))
+        return rec
+
+    def maybe_drop_connection(self) -> bool:
+        """The ``conn_drop`` fault site: True = pretend the client went
+        away before the response write (data plane only)."""
+        plan = self._plan
+        return plan is not None and plan.check("conn_drop")
+
+    def admit_request(
+        self, rec: FlightRecord, key: Optional[str]
+    ) -> Tuple[Optional[Tenant], Optional[Tuple[int, Dict[str, Any], str]]]:
+        """Admission for one journey: ``(tenant, None)`` on success —
+        journey stamped ``admitted``, slot taken — or
+        ``(None, (status, doc, outcome))`` on rejection. Side-effect-ful
+        (quota token + budget slot), so call exactly once per request."""
+        rid = rec.rid
+
+        def rej(status: int, kind: str, message: str):
+            return None, (status, {
+                "error": kind, "message": str(message)[:500],
+                "request_id": rid,
+            }, STATUS_OUTCOMES.get(status, "error"))
+
+        try:
+            tenant = self._admission.admit(key)
+        except AuthError as e:
+            return rej(403, "auth", str(e))
+        except QuotaExceeded as e:
+            return rej(429, "quota", str(e))
+        except QueueFullError as e:
+            return rej(429, "budget", str(e))
+        rec.note(tenant=tenant.name, tier=tenant.tier)
+        rec.stamp("admitted")
+        return tenant, None
+
+    def serve_request(
+        self, rec: FlightRecord, key: Optional[str], x_payload: Any,
+        deadline_ms: Optional[float] = None,
+        tenant: Optional[Tenant] = None,
+    ) -> Tuple[int, Dict[str, Any], Optional[Tenant], str]:
+        """Admit → submit → await, transport-agnostic. Returns
+        ``(status, response doc, admitted tenant or None, outcome)``;
+        the caller writes the response, applies the conn_drop site, and
+        closes the journey via :meth:`finish_request`. A caller that
+        already holds an admitted ``tenant`` (the HTTP pre-admission
+        path) passes it in; admission then does NOT run again."""
+        # Admission FIRST — before the (possibly multi-MB) payload is
+        # even converted to an array: a rejected request must cost the
+        # daemon as close to nothing as the transport allows. The HTTP
+        # ingress pre-admits on the header key before even READING the
+        # body and passes the tenant in; the framed-socket ingress must
+        # read its frame regardless (to stay in sync) and admits here.
+        if tenant is None:
+            tenant, rejection = self.admit_request(rec, key)
+            if rejection is not None:
+                status, doc, outcome = rejection
+                return status, doc, None, outcome
+        rid = rec.rid
+
+        def terr(status: int, kind: str, message: str):
+            # Post-admission failure: tenant rides along so
+            # finish_request releases the admission slot.
+            return status, {
+                "error": kind, "message": message[:500], "request_id": rid,
+                "tenant": tenant.name, "tier": tenant.tier,
+            }, tenant, STATUS_OUTCOMES.get(status, "error")
+
+        # Everything after admission runs inside ONE boundary: any
+        # exception — enumerated or not (MemoryError on a huge payload,
+        # a bug) — must return through terr so finish_request releases
+        # the admitted slot. An escape here is a permanent slot leak.
+        try:
+            return self._serve_admitted(rec, tenant, x_payload,
+                                        deadline_ms, terr)
+        except Exception as e:  # lint: broad-ok any post-admission failure becomes this request's 500; the slot must release via terr
+            return terr(500, "error", f"{type(e).__name__}: {e}")
+
+    def _serve_admitted(self, rec: FlightRecord, tenant: Tenant,
+                        x_payload: Any, deadline_ms: Optional[float],
+                        terr) -> Tuple[int, Dict[str, Any],
+                                       Optional[Tenant], str]:
+        """The post-admission half of serve_request (caller owns the
+        slot-releasing exception boundary)."""
+        rid = rec.rid
+        if deadline_ms is None:
+            deadline_ms = float(self._tier_deadline_ms[tenant.tier])
+        else:
+            # Validated on the slot-releasing path: a garbage deadline
+            # is a 400, never an exception that leaks the admitted slot.
+            try:
+                deadline_ms = float(deadline_ms)
+            except (TypeError, ValueError):
+                return terr(400, "bad_request",
+                            f"deadline_ms must be a number, got "
+                            f"{deadline_ms!r}")
+        g = self._gen
+        try:
+            x = np.asarray(x_payload, dtype=g.engine.dtype)
+        except (TypeError, ValueError) as e:
+            return terr(400, "bad_request", f"unparseable payload: {e}")
+        rows = int(x.shape[0]) if x.ndim > len(self._feature_shape) else 1
+        rec.rows = rows
+
+        # ONE absolute deadline across generation-chase replays: a
+        # straggler replayed onto the swap successor keeps its REMAINING
+        # budget, not a fresh window — the client's SLA does not reset
+        # because we swapped, and a breached deadline must surface as
+        # 504, never as a late 200 stacked SUBMIT_ATTEMPTS windows deep.
+        deadline_abs = (
+            time.monotonic() + deadline_ms / 1e3 if deadline_ms > 0
+            else None
+        )
+        last_exc: Optional[BaseException] = None
+        for _attempt in range(SUBMIT_ATTEMPTS):
+            remaining_ms = 0.0
+            if deadline_abs is not None:
+                remaining_ms = (deadline_abs - time.monotonic()) * 1e3
+                if remaining_ms <= 0:
+                    return terr(504, "expired",
+                                f"deadline {deadline_ms:.0f}ms passed "
+                                "while landing on a live generation")
+            with self._lock:
+                if self._closed:
+                    return terr(503, "closed", "daemon is closed")
+            g = self._gen
+            try:
+                fut = g.service.submit(x, deadline_ms=remaining_ms)
+            except QueueFullError as e:
+                return terr(429, "queue_full", str(e))
+            except DeadlineExceeded as e:
+                return terr(504, "expired", str(e))
+            except ValueError as e:
+                return terr(400, "bad_request", str(e))
+            except ServiceClosed as e:
+                # Generation flip race: the service closed between the
+                # self._gen read and the submit. Chase the successor.
+                last_exc = e
+                continue
+            rec.stamp("submitted")
+            timeout_s = (
+                max(remaining_ms / 1e3 * 4, 1.0) if remaining_ms > 0
+                else self._result_timeout_s
+            )
+            try:
+                y = fut.result(timeout=timeout_s)
+            except DeadlineExceeded as e:
+                return terr(504, "expired", str(e))
+            except (ServiceClosed, WorkerDiedError) as e:
+                # Drained-out straggler of a closing generation (or a
+                # restarted worker): the serve chain is pure, so replay
+                # on the current generation — zero dropped requests
+                # across a swap.
+                last_exc = e
+                continue
+            except FutureTimeout:
+                return terr(504, "timeout",
+                            f"no result within {timeout_s:.1f}s")
+            except Exception as e:  # lint: broad-ok device/serve failure of any kind becomes this request's 500; the ingress must survive
+                return terr(500, "error", f"{type(e).__name__}: {e}")
+            rec.note(generation=g.number)
+            doc = {
+                "y": np.asarray(y).tolist(),
+                "generation": g.number,
+                "request_id": rid,
+                "tenant": tenant.name,
+                "tier": tenant.tier,
+            }
+            return 200, doc, tenant, "ok"
+        return terr(
+            503, "closed",
+            f"request could not land on a live generation after "
+            f"{SUBMIT_ATTEMPTS} attempts: {last_exc}",
+        )
+
+    def finish_request(self, rec: FlightRecord, outcome: str,
+                       tenant: Optional[Tenant], status: Optional[int] = None
+                       ) -> None:
+        """Close one journey exactly once per request: outcome + status
+        onto the record, outcome counter, tier latency (ok only),
+        admission slot release, and the unlocked flight-recorder poll."""
+        if status is not None:
+            rec.note(status=status)
+        rec.finish(outcome)
+        self._outcomes.bump(outcome)
+        if tenant is not None:
+            self._admission.release()
+            if outcome == "ok":
+                t0 = rec.phases[0][1]
+                self._tier_hist[tenant.tier].record(
+                    max((time.perf_counter_ns() - t0) / 1e9, 1e-9)
+                )
+        with self._lock:
+            self._active.discard(rec.rid)
+            self._inflight_gauge.set(len(self._active))
+        self._flight.poll()
+
+    # -- hot swap ------------------------------------------------------------
+
+    def request_swap(self, artifact_path: str, wait: bool = True,
+                     timeout_s: Optional[float] = None,
+                     expect_fingerprint: Optional[str] = None):
+        """Queue a hot swap to the artifact at ``artifact_path``.
+        ``wait=True`` (default) blocks for the result — the new
+        generation number — re-raising the swap's failure;
+        ``wait=False`` returns the Future. Swaps serialize on the swap
+        worker thread: one at a time, in request order."""
+        fut: Future = Future()
+        with self._lock:
+            # Check AND enqueue under the one lock close() takes: a put
+            # landing after close() drained the queue would leave this
+            # future unresolved forever (put never blocks — unbounded
+            # queue — so holding the lock here is safe).
+            if self._closed:
+                raise ServiceClosed("daemon is closed")
+            self._swap_q.put((str(artifact_path), expect_fingerprint, fut))
+        if not wait:
+            return fut
+        if timeout_s is None:
+            timeout_s = config.swap_timeout_ms / 1e3
+        return fut.result(timeout=timeout_s)
+
+    def _swap_loop(self) -> None:
+        """Swap worker thread: serializes hot swaps; a failed swap
+        becomes the requester's exception, never this thread's death."""
+        while True:
+            item = self._swap_q.get()
+            if item is None:
+                return
+            path, expect_fp, fut = item
+            try:
+                fut.set_result(self._do_swap(path, expect_fp))
+            except BaseException as e:  # lint: broad-ok any swap failure becomes the requester's exception; the swap worker must survive
+                fut.set_exception(e)
+
+    def _do_swap(self, path: str,
+                 expect_fingerprint: Optional[str] = None) -> int:
+        old = self._gen
+        with self._lock:
+            if self._closed:
+                raise ServiceClosed("daemon closed; swap abandoned")
+            self._draining = True
+        retired: List[int] = []
+        try:
+            art = load_artifact(path, expect_fingerprint=expect_fingerprint)
+            number = old.number + 1
+            engine = self._build_engine(art.pipeline, number)
+            for i in range(len(engine.replicas)):
+                if self._plan is not None:
+                    self._plan.maybe_raise("swap_abort")
+                # Warm the successor's replica i, then drain the
+                # outgoing generation's replica i (re-queue machinery;
+                # refused for the last live replica — the old
+                # generation answers until the flip).
+                engine.warmup(self._feature_shape, dtype=self._dtype,
+                              replica=i)
+                if old.service.retire_replica(i):
+                    retired.append(i)
+            if self._swap_hook is not None:
+                self._swap_hook(self)
+            service = self._build_service(engine, number)
+            new = Generation(number, art.fingerprint, engine, service,
+                             art.header())
+            with self._lock:
+                closed = self._closed
+                if not closed:
+                    self._gen = new
+                    self._draining = False
+                    self.swaps += 1
+            if closed:
+                # close() raced this swap: never flip onto a closed
+                # daemon — the successor's threads would live forever
+                # behind a service nothing will ever close.
+                service.close(drain=False)
+                raise ServiceClosed("daemon closed mid-swap; rolled back")
+            # The drain primitive: the old generation serves everything
+            # already queued/in flight, then dies. Stragglers it fails
+            # (drain bound exceeded) replay onto the new generation in
+            # serve_request's ServiceClosed retry.
+            old.service.close(drain=True,
+                              join_s=config.swap_drain_ms / 1e3)
+            logger.info(
+                "daemon %s: hot-swapped generation %d -> %d "
+                "(artifact %s, %d replica(s) handed over incrementally)",
+                self.name, old.number, number, art.fingerprint[:12],
+                len(retired),
+            )
+            return number
+        except BaseException as e:
+            with self._lock:
+                self._draining = False
+                self.swap_failures += 1
+                inflight_ids = sorted(self._active)
+            # Rollback, not outage: retired replicas revive, the old
+            # generation keeps serving, and the black box records who
+            # was in flight when the swap died.
+            old.service.unretire_replicas(retired)
+            self._flight.error(
+                "swap_abort",
+                f"swap to {os.path.basename(path)} failed; generation "
+                f"{old.number} keeps serving; in-flight request ids "
+                f"{inflight_ids}: {type(e).__name__}: {e}",
+            )
+            self._flight.dump("swap_abort", force=True)
+            logger.warning(
+                "daemon %s: swap to %s FAILED (%s); rolled back to "
+                "generation %d (%d in-flight request(s) unaffected)",
+                self.name, path, type(e).__name__, old.number,
+                len(inflight_ids),
+            )
+            raise
+
+    # -- surfaces ------------------------------------------------------------
+
+    @property
+    def admission_open(self) -> bool:
+        """True in open mode (no tenants configured): every request is
+        the anonymous best-effort tenant, so the HTTP ingress can
+        pre-admit before reading the body even without a header key."""
+        return self._admission.open_mode
+
+    @property
+    def generation(self) -> int:
+        return self._gen.number
+
+    @property
+    def artifact_fingerprint(self) -> str:
+        return self._gen.fingerprint
+
+    def health_stats(self) -> Dict[str, Any]:
+        """The /healthz source (also pluggable into
+        ``tools/metrics_server.py`` as ``health_source``): the live
+        generation's service stats plus the daemon's generation /
+        artifact / draining identity."""
+        g = self._gen
+        with self._lock:
+            draining = self._draining
+            closed = self._closed
+        s = g.service.stats()
+        s["generation"] = g.number
+        s["artifact_fingerprint"] = g.fingerprint
+        s["draining"] = draining
+        if closed:
+            s["closed"] = True
+        return s
+
+    def stats(self, redact_tenants: bool = False) -> Dict[str, Any]:
+        g = self._gen
+        with self._lock:
+            active = len(self._active)
+            draining = self._draining
+            closed = self._closed
+            swaps = self.swaps
+            swap_failures = self.swap_failures
+        admission = self._admission.stats()
+        if redact_tenants:
+            admission["tenants"] = len(admission["tenants"])
+        return {
+            "name": self.name,
+            "generation": g.number,
+            "artifact_fingerprint": g.fingerprint,
+            "artifact": dict(g.artifact_header),
+            "draining": draining,
+            "closed": closed,
+            "swaps": swaps,
+            "swap_failures": swap_failures,
+            "active_requests": active,
+            "http_port": self.http_port,
+            "socket_port": self.socket_port,
+            "feature_shape": list(self._feature_shape),
+            "tier_deadline_ms": dict(self._tier_deadline_ms),
+            "admission": admission,
+            "outcomes": self._outcomes.snapshot(),
+            "flight": self._flight.stats(),
+            "service": g.service.stats(),
+        }
+
+    def debug_dump(self, path: Optional[str] = None) -> Optional[str]:
+        """Dump the daemon's network-leg black box NOW (no rate limit)."""
+        return self._flight.dump("debug", path=path, force=True)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        """Stop ingress, the swap worker, and the live generation's
+        service (drained — no future stranded). Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._swap_q.put(None)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=self.CLOSE_JOIN_S)
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=self.CLOSE_JOIN_S)
+        self._swap_thread.join(timeout=self.CLOSE_JOIN_S)
+        # A swap enqueued between the closed check and our sentinel
+        # landed BEHIND the sentinel and will never run: fail its
+        # future instead of leaving the requester blocked.
+        while True:
+            try:
+                item = self._swap_q.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                continue
+            _path, _fp, fut = item
+            try:
+                fut.set_exception(
+                    ServiceClosed("daemon closed; swap abandoned")
+                )
+            except InvalidStateError:
+                pass  # a racing _swap_loop already resolved it
+        # If a long in-progress swap outlived the join above, the drain
+        # loop just consumed ITS shutdown sentinel: re-seed it so the
+        # swap worker's next get() exits instead of parking forever on
+        # an empty queue (a stale sentinel in an already-exited worker's
+        # queue is harmless).
+        self._swap_q.put(None)
+        self._gen.service.close(drain=True)
+
+    def __enter__(self) -> "ServingDaemon":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

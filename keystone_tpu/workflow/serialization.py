@@ -1,18 +1,54 @@
-"""Fitted-pipeline serialization.
+"""Fitted-pipeline serialization and versioned model artifacts.
 
 Ref: the reference exports models by plain serialization of fitted
 transformers (SURVEY.md §5 checkpoint/resume row) [unverified]. A fitted
 pipeline here is transformer objects holding array pytrees; pickling works
 once per-instance jit caches are stripped (they rebuild lazily on first
 use after load).
+
+Two layers:
+
+- ``save_pipeline`` / ``load_pipeline`` — the bare pickle round-trip
+  (kept for in-process checkpoints and the existing round-trip tests).
+- ``save_artifact`` / ``load_artifact`` — the **fit→serve handoff**
+  format the serving daemon (workflow/daemon.py) consumes: one file
+  holding a JSON header (schema version, a blake2b fingerprint covering
+  the header itself plus the payload, content-stable pipeline digest
+  where available, the ``environment_fingerprint()`` backend subset,
+  optional serve hints) followed by the pickled pipeline.
+  ``load_artifact`` verifies the schema version and the fingerprint
+  BEFORE unpickling — a truncated upload, a bit-rotted file (payload OR
+  header: a flipped serve hint fails as loudly as a flipped weight), or
+  a format from a different release raises a typed
+  :class:`ArtifactVersionError` at load time instead of failing deep
+  inside ``apply`` under traffic.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
+import os
 import pickle
-from typing import Any
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
 
 from keystone_tpu.workflow.pipeline import Pipeline, Transformer
+
+#: Bump when the on-disk artifact layout changes incompatibly. A loader
+#: refuses any other version by name — never by crashing mid-unpickle.
+ARTIFACT_SCHEMA_VERSION = 1
+
+_MAGIC = b"KEYSTONE_ARTIFACT\n"
+
+
+class ArtifactVersionError(ValueError):
+    """The artifact cannot be served: wrong schema version, payload bytes
+    that do not match the recorded pipeline fingerprint (corruption or
+    tampering), or a fingerprint pin the caller required that the file
+    does not carry."""
 
 
 def _strip_jit(obj: Any) -> None:
@@ -22,8 +58,7 @@ def _strip_jit(obj: Any) -> None:
             _strip_jit(sub)
 
 
-def save_pipeline(pipeline: Pipeline, path: str) -> None:
-    """Persist a fitted (transformer-only) pipeline. Call .fit() first."""
+def _check_fitted(pipeline: Pipeline) -> None:
     from keystone_tpu.workflow.operators import (
         EstimatorOperator,
         TransformerOperator,
@@ -37,6 +72,11 @@ def save_pipeline(pipeline: Pipeline, path: str) -> None:
             )
         if isinstance(op, TransformerOperator):
             _strip_jit(op.transformer)
+
+
+def save_pipeline(pipeline: Pipeline, path: str) -> None:
+    """Persist a fitted (transformer-only) pipeline. Call .fit() first."""
+    _check_fitted(pipeline)
     with open(path, "wb") as f:
         pickle.dump(pipeline, f)
 
@@ -44,3 +84,211 @@ def save_pipeline(pipeline: Pipeline, path: str) -> None:
 def load_pipeline(path: str) -> Pipeline:
     with open(path, "rb") as f:
         return pickle.load(f)
+
+
+def pipeline_digest(pipeline: Pipeline) -> Optional[str]:
+    """Content-stable digest of the fitted pipeline TEMPLATE (the free
+    serve input tokenized), via ``workflow.graph.structural_digest`` —
+    the same identity the cross-process fit cache keys on. None when any
+    operator lacks content identity; the artifact then relies on the
+    artifact fingerprint alone."""
+    from keystone_tpu.workflow.graph import structural_digest
+
+    return structural_digest(
+        pipeline.graph, pipeline.sink, source_token="serve-input"
+    )
+
+
+def _artifact_environment() -> Dict[str, Any]:
+    """The ``environment_fingerprint()`` subset an artifact records:
+    enough to explain "trained where", small enough to live in every
+    header."""
+    import platform as _platform
+
+    from keystone_tpu.utils.metrics import runtime_fingerprint
+
+    env = dict(runtime_fingerprint())
+    env["python"] = _platform.python_version()
+    try:
+        import numpy as _np
+
+        env["numpy"] = _np.__version__
+    except ImportError:  # header stays useful without numpy
+        pass
+    return env
+
+
+@dataclass
+class ModelArtifact:
+    """One versioned, fingerprinted fit→serve handoff unit."""
+
+    schema_version: int
+    fingerprint: str  # blake2b hex of canonical-header-sans-fp + payload
+    pipeline_digest: Optional[str]
+    environment: Dict[str, Any]
+    created_unix: float
+    serve: Dict[str, Any] = field(default_factory=dict)
+    pipeline: Optional[Pipeline] = None
+    path: Optional[str] = None
+
+    def header(self) -> Dict[str, Any]:
+        return {
+            "schema_version": self.schema_version,
+            "fingerprint": self.fingerprint,
+            "pipeline_digest": self.pipeline_digest,
+            "environment": self.environment,
+            "created_unix": self.created_unix,
+            "serve": dict(self.serve),
+        }
+
+
+def _artifact_fingerprint(header_sans_fp: Dict[str, Any],
+                          payload: bytes) -> str:
+    """Integrity fingerprint over the WHOLE artifact: the canonical
+    (sorted-key JSON) header minus the fingerprint field itself, plus
+    the pickled payload. Covering the header means a flipped serve hint
+    (feature_shape/dtype) or digest fails verification loudly at load,
+    instead of a daemon warming a wrong-shaped ladder and 400ing every
+    request. Canonical re-serialization is stable across a JSON
+    round-trip (sort_keys + default ensure_ascii on both sides)."""
+    h = hashlib.blake2b(digest_size=20)
+    h.update(json.dumps(header_sans_fp, sort_keys=True).encode())
+    h.update(payload)
+    return h.hexdigest()
+
+
+def save_artifact(
+    pipeline: Pipeline,
+    path: str,
+    feature_shape: Optional[tuple] = None,
+    dtype: Optional[str] = None,
+    extra: Optional[Dict[str, Any]] = None,
+) -> ModelArtifact:
+    """Serialize a fitted pipeline into a versioned, fingerprinted
+    artifact file the serving daemon can load and hot-swap.
+
+    ``feature_shape``/``dtype`` are optional serve hints (the per-row
+    traffic signature) recorded in the header so a daemon can AOT-warm
+    the successor's ladder without being told the shape again. Written
+    atomically (tmp + ``os.replace``): a crash mid-save never leaves a
+    half-artifact where a swap could pick it up."""
+    _check_fitted(pipeline)
+    payload = pickle.dumps(pipeline)
+    serve: Dict[str, Any] = {}
+    if feature_shape is not None:
+        serve["feature_shape"] = [int(d) for d in feature_shape]
+    if dtype is not None:
+        serve["dtype"] = str(dtype)
+    if extra:
+        serve.update(extra)
+    art = ModelArtifact(
+        schema_version=ARTIFACT_SCHEMA_VERSION,
+        fingerprint="",
+        pipeline_digest=pipeline_digest(pipeline),
+        environment=_artifact_environment(),
+        # lint: ok(KL005) artifact provenance carries a real wall-clock timestamp
+        created_unix=time.time(),
+        serve=serve,
+        pipeline=pipeline,
+        path=path,
+    )
+    sans_fp = art.header()
+    del sans_fp["fingerprint"]
+    art.fingerprint = _artifact_fingerprint(sans_fp, payload)
+    # Unique tmp name (not a fixed path+".tmp"): two concurrent saves to
+    # the same destination must not interleave bytes into one tmp file,
+    # and a failed write must not litter a stale tmp next to the target.
+    fd, tmp = tempfile.mkstemp(
+        prefix=os.path.basename(path) + ".", suffix=".tmp",
+        dir=os.path.dirname(os.path.abspath(path)),
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(_MAGIC)
+            f.write(
+                json.dumps(art.header(), sort_keys=True).encode() + b"\n"
+            )
+            f.write(payload)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return art
+
+
+def _read_header(f, path: str) -> Dict[str, Any]:
+    """Magic + header-line parse + validation, shared by the header-only
+    reader and the full loader (one set of error messages; the file
+    cursor is left at the payload)."""
+    magic = f.read(len(_MAGIC))
+    if magic != _MAGIC:
+        raise ArtifactVersionError(
+            f"{path}: not a keystone model artifact (bad magic; a bare "
+            "save_pipeline pickle loads via load_pipeline instead)"
+        )
+    header_line = f.readline()
+    try:
+        header = json.loads(header_line)
+    except ValueError as e:
+        raise ArtifactVersionError(
+            f"{path}: unreadable artifact header: {e}"
+        ) from None
+    if not isinstance(header, dict):
+        raise ArtifactVersionError(f"{path}: artifact header is not a dict")
+    return header
+
+
+def read_artifact_header(path: str) -> Dict[str, Any]:
+    """The artifact's JSON header alone — no unpickling, so an operator
+    (or /healthz) can name a file's fingerprint without loading the
+    model. Raises ArtifactVersionError on a non-artifact file."""
+    with open(path, "rb") as f:
+        return _read_header(f, path)
+
+
+def load_artifact(
+    path: str, expect_fingerprint: Optional[str] = None
+) -> ModelArtifact:
+    """Load + verify one artifact: schema version first, then the
+    whole-artifact fingerprint (header + payload, before unpickling a
+    single byte of the model), then the optional caller pin. Every
+    mismatch is an ArtifactVersionError naming what disagreed."""
+    with open(path, "rb") as f:
+        header = _read_header(f, path)
+        payload = f.read()
+    version = header.get("schema_version")
+    if version != ARTIFACT_SCHEMA_VERSION:
+        raise ArtifactVersionError(
+            f"{path}: artifact schema version {version!r} != supported "
+            f"{ARTIFACT_SCHEMA_VERSION}; re-export the model with this "
+            "release's save_artifact"
+        )
+    recorded = header.get("fingerprint")
+    sans_fp = dict(header)
+    sans_fp.pop("fingerprint", None)
+    actual = _artifact_fingerprint(sans_fp, payload)
+    if recorded != actual:
+        raise ArtifactVersionError(
+            f"{path}: artifact fingerprint {actual} does not match the "
+            f"recorded {recorded!r} — the header or payload is corrupt "
+            "or was modified after export"
+        )
+    if expect_fingerprint is not None and expect_fingerprint != recorded:
+        raise ArtifactVersionError(
+            f"{path}: artifact fingerprint {recorded} != required "
+            f"{expect_fingerprint}"
+        )
+    pipeline = pickle.loads(payload)
+    return ModelArtifact(
+        schema_version=int(version),
+        fingerprint=str(recorded),
+        pipeline_digest=header.get("pipeline_digest"),
+        environment=dict(header.get("environment") or {}),
+        created_unix=float(header.get("created_unix") or 0.0),
+        serve=dict(header.get("serve") or {}),
+        pipeline=pipeline,
+        path=path,
+    )

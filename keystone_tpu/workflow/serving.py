@@ -576,7 +576,8 @@ class CompiledPipeline:
     # -- warmup ------------------------------------------------------------
 
     def warmup(
-        self, example: Union[Tuple[int, ...], Any], dtype=None
+        self, example: Union[Tuple[int, ...], Any], dtype=None,
+        replica: Optional[int] = None,
     ) -> "CompiledPipeline":
         """AOT-compile every bucket, on every replica, before first
         traffic.
@@ -584,7 +585,10 @@ class CompiledPipeline:
         ``example`` is either the per-row feature shape (a tuple of ints)
         or a sample batch (leading axis = rows) whose ``shape[1:]``/dtype
         are taken. Idempotent per (shape, dtype): re-warming compiles only
-        missing buckets.
+        missing buckets. ``replica=i`` warms ONE replica's ladder — the
+        hot-swap handoff warms a successor engine replica-by-replica so
+        the outgoing generation keeps answering on the devices not yet
+        handed over.
         """
         if isinstance(example, tuple) and all(
             isinstance(d, int) for d in example
@@ -613,11 +617,19 @@ class CompiledPipeline:
                     r.executables.clear()
             self.feature_shape, self._dtype = feature_shape, dt
             t0 = time.perf_counter()
-            for r in self.replicas:
+            targets = (
+                self.replicas if replica is None
+                else [self.replicas[replica]]
+            )
+            for r in targets:
                 for b in self.ladder:
                     if b not in r.executables:
                         self._compile_bucket_locked(r, b)
-            self.warmup_seconds = time.perf_counter() - t0
+            elapsed = time.perf_counter() - t0
+            if replica is None:
+                self.warmup_seconds = elapsed
+            else:  # per-replica warms accumulate into the total
+                self.warmup_seconds = (self.warmup_seconds or 0.0) + elapsed
         return self
 
     def _compile_bucket_locked(self, replica: _Replica, b: int):
@@ -1040,6 +1052,11 @@ class PipelineService:
         self._rr = 0
         self._outstanding = [0] * self._n_replicas
         self._dead = [False] * self._n_replicas
+        # Planned drains (the hot-swap handoff): a retired replica is
+        # dead-by-design — its in-flight groups re-queued to survivors
+        # via the replica-death machinery — and stays down (revival
+        # skips it) until unretire_replicas() or close().
+        self._retired = [False] * self._n_replicas
         # One lock, TWO wait-sets: the dispatcher waits on self._cv
         # (pending work / free slots), each replica's completion thread on
         # its own condition — a submit's notify() must never be consumed
@@ -1643,12 +1660,15 @@ class PipelineService:
             # Group boundary = a safe unlocked point for pending dumps.
             self._flight.poll()
 
-    def _kill_replica_locked(self, r: int) -> None:
+    def _kill_replica_locked(self, r: int, retire: bool = False) -> None:
         """Mark replica r dead and re-queue its in-flight groups at the
         FRONT of the pending queue, order-preserved, so the surviving
         replicas re-dispatch them — zero stranded futures (caller holds
         the lock; the launched device work is abandoned, which is safe:
-        the serve chain is pure)."""
+        the serve chain is pure). ``retire=True`` is the PLANNED variant
+        (hot-swap drain): same re-queue machinery, but accounted as a
+        retirement — no death counters, no forensic dump over a healthy
+        handoff."""
         self._dead[r] = True
         recs = list(self._cqueues[r])
         self._cqueues[r].clear()
@@ -1666,35 +1686,85 @@ class PipelineService:
             rq.rec.stamp("requeued")
             self._pending.appendleft(rq)
         self._outstanding[r] = 0
-        self.replica_deaths += 1
-        reliability_counters.bump("replica_deaths")
-        self._flight.error(
-            "replica_death",
-            f"replica {r} died; {len(entries)} request(s) re-queued",
-            rid=entries[0].rid if entries else None,
-        )
-        self._flight.note_dump("replica_death")
+        if retire:
+            reliability_counters.bump("serve_replicas_retired")
+            logger.info(
+                "PipelineService %s: replica %d retired for handoff; %d "
+                "in-flight group(s) (%d request(s)) re-dispatched to "
+                "survivors", self.name, r, len(recs), len(entries),
+            )
+        else:
+            self.replica_deaths += 1
+            reliability_counters.bump("replica_deaths")
+            self._flight.error(
+                "replica_death",
+                f"replica {r} died; {len(entries)} request(s) re-queued",
+                rid=entries[0].rid if entries else None,
+            )
+            self._flight.note_dump("replica_death")
+            logger.warning(
+                "PipelineService %s: replica %d died; %d in-flight "
+                "group(s) (%d request(s)) re-dispatched to survivors",
+                self.name, r, len(recs), len(entries),
+            )
         if recs:
             reliability_counters.bump(
                 "serve_groups_redispatched", len(recs)
             )
         self._queue_gauge.set(len(self._pending))
         self._inflight_gauge.set(sum(self._outstanding))
-        logger.warning(
-            "PipelineService %s: replica %d died; %d in-flight group(s) "
-            "(%d request(s)) re-dispatched to survivors",
-            self.name, r, len(recs), len(entries),
-        )
         self._cv.notify_all()
+
+    def retire_replica(self, r: int) -> bool:
+        """Planned drain of one replica — the hot-swap handoff primitive.
+
+        Re-queues the replica's in-flight groups onto the survivors (the
+        replica-death machinery, accounted as a retirement) and keeps it
+        down until :meth:`unretire_replicas`. Refuses (returns False) on
+        the serial path, on an already-retired replica, or when it would
+        take down the LAST live replica — the outgoing generation must
+        keep answering until its successor takes over."""
+        if not self._pipelined:
+            return False
+        if not 0 <= r < self._n_replicas:
+            raise ValueError(
+                f"replica {r} out of range for a {self._n_replicas}-replica "
+                "service"
+            )
+        with self._cv:
+            if self._closed or self._retired[r]:
+                return False
+            live = [
+                i for i in range(self._n_replicas)
+                if not self._dead[i] and not self._retired[i]
+            ]
+            if live == [r] or not live:
+                return False  # never retire the last live replica
+            self._retired[r] = True
+            if not self._dead[r]:
+                self._kill_replica_locked(r, retire=True)
+        # Safe unlocked point for any dump marked while the lock was held.
+        self._flight.poll()
+        return True
+
+    def unretire_replicas(self, indices) -> None:
+        """Roll back planned drains (an aborted hot-swap): the named
+        replicas become revivable again and are revived immediately."""
+        with self._cv:
+            for i in indices:
+                self._retired[i] = False
+            if not self._closed:
+                self._revive_dead_locked()
 
     def _revive_dead_locked(self) -> None:
         """Restart any dead replica (caller holds the lock): executables
         are intact — death is a thread-level condition — so a fresh
         completion thread restores it. Called at the next ``submit`` (the
         same detection point as worker death), so a partially dead pool
-        heals instead of serving at reduced capacity forever."""
+        heals instead of serving at reduced capacity forever. Retired
+        replicas stay down — their drain was deliberate."""
         for i in range(self._n_replicas):
-            if not self._dead[i]:
+            if not self._dead[i] or self._retired[i]:
                 continue
             self._dead[i] = False
             self._completers[i] = self._spawn_completer(i)
@@ -1707,7 +1777,9 @@ class PipelineService:
     def _revive_if_all_dead_locked(self) -> None:
         """The dispatcher's fallback when NO replica is eligible (caller
         holds the lock): with every replica dead and no submit arriving
-        to heal the pool, revive it here so already-queued work drains."""
+        to heal the pool, revive it here so already-queued work drains
+        (retired replicas stay down; at least one replica is always
+        unretired, by retire_replica's last-live guard)."""
         if not self._dead or not all(self._dead):
             return
         self._revive_dead_locked()
@@ -1762,7 +1834,7 @@ class PipelineService:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def close(self, drain: bool = True):
+    def close(self, drain: bool = True, join_s: Optional[float] = None):
         """Stop the service without stranding a single future.
 
         ``drain=True`` (default) lets the workers serve what is already
@@ -1771,7 +1843,17 @@ class PipelineService:
         any future still unresolved once the workers are gone — queued
         behind a dead worker, in flight when the join timed out — is
         failed with ``ServiceClosed`` rather than left for a caller to
-        block on forever. Idempotent."""
+        block on forever. An EXPLICIT ``join_s`` bounds the TOTAL drain
+        wait — one deadline shared across every thread join, not per
+        thread, so a wedged 8-replica drain still hands back control in
+        ``join_s`` (the hot-swap flip passes ``KEYSTONE_SWAP_DRAIN_MS``
+        and that contract is a total bound). The default (``join_s``
+        None) keeps the legacy generous per-thread bound: a plain
+        ``close(drain=True)`` promises to serve what is queued, and a
+        long tail draining in the background must not newly fail as
+        ``ServiceClosed`` under a shared cap. Idempotent."""
+        per_thread = join_s is None
+        join_s = self._CLOSE_JOIN_S if join_s is None else float(join_s)
         rejected: list = []
         with self._cv:
             self._closed = True
@@ -1782,11 +1864,19 @@ class PipelineService:
             for c in self._ccvs:
                 c.notify_all()
         self._wd_stop.set()
-        self._worker.join(timeout=self._CLOSE_JOIN_S)
+        deadline = time.monotonic() + join_s
+
+        def _join(t):
+            if per_thread:
+                t.join(timeout=join_s)
+            else:
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
+
+        _join(self._worker)
         for t in self._completers:
-            t.join(timeout=self._CLOSE_JOIN_S)
+            _join(t)
         if self._watchdog is not None:
-            self._watchdog.join(timeout=self._CLOSE_JOIN_S)
+            _join(self._watchdog)
         with self._cv:
             leftovers = list(self._pending) + list(self._inflight)
             for q in self._cqueues:
@@ -1844,6 +1934,7 @@ class PipelineService:
             alive = self._worker.is_alive()
             outstanding = list(self._outstanding)
             dead = list(self._dead)
+            retired = list(self._retired)
         return {
             "name": self.name,
             "requests": self.requests,
@@ -1868,6 +1959,7 @@ class PipelineService:
                 "count": self._n_replicas,
                 "outstanding": outstanding,
                 "dead": dead,
+                "retired": retired,
                 "deaths": self.replica_deaths,
                 "revivals": self.replica_revivals,
             },

@@ -41,10 +41,13 @@ def history(tmp_path):
     return tmp_path
 
 
-def _append_serve_row(root, mutate):
+def _append_serve_row(root, mutate, metric="serve_bucketed_vs_pershape"):
     path = os.path.join(root, "BENCH_serve.json")
     rows = [json.loads(line) for line in open(path)]
-    row = json.loads(json.dumps(rows[-1]))  # deep copy of the latest
+    # Latest row of the named family — the file interleaves families
+    # (main anchor, overload, replicas, daemon), one latest row each.
+    latest = [r for r in rows if r.get("metric") == metric][-1]
+    row = json.loads(json.dumps(latest))  # deep copy
     mutate(row)
     with open(path, "a") as f:
         f.write(json.dumps(row) + "\n")

@@ -1,0 +1,1004 @@
+"""Networked serving daemon: admission control, SLA tiers, hot-swap.
+
+Acceptance pins (ISSUE 11):
+
+- **Swap-under-load**: sustained concurrent traffic while hot-swapping
+  the artifact twice — zero dropped/unresolved requests, every response
+  attributable to exactly one generation (bit-identical to that
+  generation's model), and a mid-swap ``swap_abort`` fault leaves the
+  old generation serving (rollback, not outage) with a forensic dump
+  naming the generation and in-flight ids.
+- **Admission gate**: at 2x the admitted concurrency, over-quota /
+  over-budget tenants fast-fail with 429 BEFORE any device work while
+  gold-tier traffic keeps being served within its deadline; the
+  flight-recorder journeys cover the network leg end to end
+  (accepted → parsed → admitted → submitted → resolved; the HTTP path
+  pre-admits on the header key before the body read, so there admitted
+  precedes parsed).
+
+Clients here retry on dropped connections: under ``make chaos``
+(``conn_drop:0.05``) ~5% of data-plane responses are deliberately lost
+after serving, and re-sending a pure serve is exactly what a real
+client does — the tests must pass identically.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from keystone_tpu.config import config
+from keystone_tpu.utils import reliability
+from keystone_tpu.utils.metrics import reliability_counters
+from keystone_tpu.utils.reliability import (
+    AuthError,
+    QueueFullError,
+    QuotaExceeded,
+    ServiceClosed,
+    SwapAborted,
+)
+from keystone_tpu.workflow.daemon import (
+    BE_BUDGET_FRAC,
+    AdmissionController,
+    ServingDaemon,
+    Tenant,
+    TokenBucket,
+    derive_health,
+    parse_tenants,
+)
+from keystone_tpu.workflow.serialization import save_artifact
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+D = 6
+
+
+@pytest.fixture
+def faults():
+    """Arm a fault plan for the test; restores the prior plan after
+    (the test_reliability fixture pattern)."""
+    prior = (config.faults, config.faults_seed)
+
+    def arm(spec: str, seed: int = 0):
+        config.faults, config.faults_seed = spec, seed
+        reliability.reset_fault_plan()
+
+    yield arm
+    config.faults, config.faults_seed = prior
+    reliability.reset_fault_plan()
+
+
+def _serve_daemon_mod():
+    sys.path.insert(0, TOOLS)
+    try:
+        import serve_daemon
+    finally:
+        sys.path.pop(0)
+    return serve_daemon
+
+
+def _socket_client():
+    return _serve_daemon_mod().SocketClient
+
+
+def _build_pipeline(seed=0):
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+    from keystone_tpu.nodes.stats.random_features import CosineRandomFeatures
+
+    return (
+        CosineRandomFeatures.create(D, 12, seed=seed)
+        .and_then(L2Normalizer())
+        .fit()
+    )
+
+
+def _save(tmp_path, seed, tag):
+    pipe = _build_pipeline(seed)
+    path = str(tmp_path / f"model_{tag}.kart")
+    save_artifact(pipe, path, feature_shape=(D,), dtype="float32")
+    return pipe, path
+
+
+def _post(port, path, body, headers=None, timeout=60, retries=8):
+    """The SHIPPED retrying client (tools/serve_daemon.http_post), with
+    test-friendly defaults: an injected conn_drop loses only the
+    response of an already-served pure request; re-sending is the real
+    client behavior."""
+    return _serve_daemon_mod().http_post(
+        port, path, body, headers, timeout=timeout, retries=retries
+    )
+
+
+def _get(port, path, timeout=30):
+    status, body = _serve_daemon_mod().http_get(port, path, timeout=timeout)
+    return status, json.loads(body)
+
+
+def _settle(daemon, timeout=10.0):
+    """Wait for server-side bookkeeping to settle: finish_request runs
+    AFTER the response write, so a client can observe its answer a beat
+    before the journey closes. Returns the settled snapshot."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        snap = daemon._flight.snapshot()
+        if daemon.stats()["active_requests"] == 0 and all(
+            r["outcome"] is not None for r in snap["records"]
+        ):
+            return snap
+        time.sleep(0.01)
+    return daemon._flight.snapshot()
+
+
+def _socket_request(SocketClient, port, doc, retries=8):
+    last = None
+    for _ in range(retries):
+        sc = SocketClient(port)
+        try:
+            return sc.request(doc)
+        except (ConnectionError, OSError) as e:
+            last = e
+        finally:
+            sc.close()
+    raise last
+
+
+# ---------------------------------------------------------------------------
+# Admission units (no daemon)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_tenants_and_errors():
+    tenants = parse_tenants(
+        "acme:sk-1:100:gold,free:sk-2:5,bulk:sk-3:2.5:best_effort:9"
+    )
+    assert set(tenants) == {"sk-1", "sk-2", "sk-3"}
+    assert tenants["sk-1"].tier == "gold" and tenants["sk-1"].qps == 100
+    assert tenants["sk-2"].tier == "best_effort"
+    assert tenants["sk-3"].burst == 9
+    assert parse_tenants("") == {}
+    with pytest.raises(ValueError, match="expected"):
+        parse_tenants("nokey")
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_tenants("a:k:1,b:k:2")
+    with pytest.raises(ValueError, match="tier"):
+        Tenant("x", "k", tier="platinum")
+
+
+def test_token_bucket_rate_and_refill():
+    tb = TokenBucket(rate=50.0, burst=2.0)
+    assert tb.try_acquire() and tb.try_acquire()
+    assert not tb.try_acquire()  # burst exhausted
+    time.sleep(0.06)  # 50/s refills ~3 tokens (capped at burst 2)
+    assert tb.try_acquire()
+    assert TokenBucket(rate=0.0, burst=1.0).try_acquire()  # unlimited
+
+
+def test_admission_quota_budget_and_gold_headroom():
+    tenants = {
+        "g": Tenant("gold", "g", qps=0, tier="gold"),
+        "b": Tenant("be", "b", qps=0, tier="best_effort"),
+        "q": Tenant("capped", "q", qps=1, burst=1, tier="best_effort"),
+    }
+    adm = AdmissionController(tenants, pending_budget=10)
+    with pytest.raises(AuthError):
+        adm.admit("unknown-key")
+    with pytest.raises(AuthError):
+        adm.admit(None)
+    # Quota: burst 1 -> the second immediate request is over quota.
+    adm.admit("q")
+    with pytest.raises(QuotaExceeded):
+        adm.admit("q")
+    # Budget priority: best-effort refused at BE_BUDGET_FRAC of the
+    # budget, gold admitted up to the full budget.
+    be_limit = int(10 * BE_BUDGET_FRAC)
+    while adm.inflight() < be_limit:
+        adm.admit("b")
+    with pytest.raises(QueueFullError):
+        adm.admit("b")
+    while adm.inflight() < 10:
+        adm.admit("g")  # gold rides the reserved headroom
+    with pytest.raises(QueueFullError):
+        adm.admit("g")
+    # Releases reopen the gate.
+    adm.release()
+    assert adm.admit("g").tier == "gold"
+    stats = adm.stats()
+    assert stats["rejected_auth"] == 2
+    assert stats["rejected_quota"] == 1
+    assert stats["rejected_budget"] == 2
+
+
+def test_derive_health_draining_and_identity():
+    healthy, doc = derive_health({
+        "worker_alive": True, "closed": False, "draining": False,
+        "generation": 3, "artifact_fingerprint": "abc",
+    })
+    assert healthy and doc["generation"] == 3
+    assert doc["artifact_fingerprint"] == "abc"
+    healthy, doc = derive_health({
+        "worker_alive": True, "closed": False, "draining": True,
+        "generation": 3, "artifact_fingerprint": "abc",
+    })
+    assert not healthy and doc["draining"] is True
+
+
+def test_daemon_threads_registered_in_keystone_lint():
+    sys.path.insert(0, TOOLS)
+    try:
+        import keystone_lint
+    finally:
+        sys.path.pop(0)
+    assert {"_accept_loop", "_serve_conn", "_swap_loop"} <= (
+        keystone_lint.KNOWN_THREAD_TARGETS
+    )
+
+
+# ---------------------------------------------------------------------------
+# Live daemon: both wires, admission, healthz
+# ---------------------------------------------------------------------------
+
+
+def test_daemon_http_socket_and_network_leg_journeys(tmp_path):
+    pipe, art_path = _save(tmp_path, 0, "v1")
+    SocketClient = _socket_client()
+    tenants = {"sk-g": Tenant("acme", "sk-g", qps=0, tier="gold")}
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(3, D)).astype(np.float32)
+    ref = np.asarray(pipe.apply(X).get())
+    with ServingDaemon(
+        artifact=art_path, tenants=tenants, devices=1, buckets=(4,),
+        max_delay_ms=1.0, name="t-basic", gold_deadline_ms=60000,
+        flight_dir=str(tmp_path),
+    ) as daemon:
+        st, doc = _post(daemon.http_port, "/predict", {"x": X.tolist()},
+                        {"X-API-Key": "sk-g"})
+        assert st == 200
+        assert doc["generation"] == 0 and doc["tier"] == "gold"
+        np.testing.assert_array_equal(
+            np.asarray(doc["y"], np.float32), ref
+        )
+        # Single-datum request: feature-shaped in, feature-shaped out.
+        st, doc1 = _post(daemon.http_port, "/predict",
+                         {"x": X[0].tolist()}, {"X-API-Key": "sk-g"})
+        assert st == 200
+        np.testing.assert_array_equal(
+            np.asarray(doc1["y"], np.float32), ref[0]
+        )
+        # The framed socket wire answers bit-identically.
+        resp = _socket_request(
+            SocketClient, daemon.socket_port,
+            {"x": X.tolist(), "key": "sk-g"},
+        )
+        assert resp["status"] == 200 and resp["generation"] == 0
+        np.testing.assert_array_equal(
+            np.asarray(resp["y"], np.float32), ref
+        )
+        # Malformed payloads are 400s, not crashes.
+        assert _post(daemon.http_port, "/predict", {"nope": 1},
+                     {"X-API-Key": "sk-g"})[0] == 400
+        assert _post(daemon.http_port, "/predict",
+                     {"x": [[1.0] * (D + 1)]},
+                     {"X-API-Key": "sk-g"})[0] == 400
+        # Garbage deadline_ms: 400 BEFORE admission — a malformed field
+        # must never leak an admission slot (review-found DoS).
+        assert _post(daemon.http_port, "/predict",
+                     {"x": X.tolist(), "deadline_ms": "fast"},
+                     {"X-API-Key": "sk-g"})[0] == 400
+        # ...and the header spelling of the same mistake: explicit but
+        # unreadable overrides 400 too (no silent tier-default fallback).
+        assert _post(daemon.http_port, "/predict", {"x": X.tolist()},
+                     {"X-API-Key": "sk-g", "X-Deadline-Ms": "soon"},
+                     )[0] == 400
+        _settle(daemon)  # slot release runs just after the 400 write
+        assert daemon.stats()["admission"]["inflight"] == 0
+        # /healthz carries the generation identity.
+        st, health = _get(daemon.http_port, "/healthz")
+        assert st == 200 and health["healthy"] is True
+        assert health["generation"] == 0
+        assert health["artifact_fingerprint"] == daemon.artifact_fingerprint
+        assert health["draining"] is False
+        # The network leg is journaled end to end for every ok request.
+        snap = _settle(daemon)
+        ok = [r for r in snap["records"] if r["outcome"] == "ok"]
+        assert ok, "expected at least one ok journey"
+        for r in ok:
+            phases = [p["phase"] for p in r["phases"]]
+            assert phases[0] == "accepted"
+            for needed in ("parsed", "admitted", "submitted", "resolved"):
+                assert needed in phases
+            stamps = [p["t_ns"] for p in r["phases"]]
+            assert stamps == sorted(stamps), "journey stamps not monotone"
+            assert r["meta"]["tenant"] == "acme"
+            assert r["meta"]["generation"] == 0
+            assert r["meta"]["status"] == 200
+        assert daemon.stats()["active_requests"] == 0
+
+
+def test_daemon_auth_and_quota_fast_fail_before_device_work(tmp_path):
+    _, art_path = _save(tmp_path, 0, "v1")
+    tenants = {
+        "sk-g": Tenant("acme", "sk-g", qps=0, tier="gold"),
+        "sk-q": Tenant("capped", "sk-q", qps=1, burst=2,
+                       tier="best_effort"),
+    }
+    x = [[0.5] * D]
+    with ServingDaemon(
+        artifact=art_path, tenants=tenants, devices=1, buckets=(4,),
+        name="t-adm", gold_deadline_ms=60000, flight_dir=str(tmp_path),
+    ) as daemon:
+        assert _post(daemon.http_port, "/predict", x and {"x": x})[0] == 403
+        assert _post(daemon.http_port, "/predict", {"x": x},
+                     {"X-API-Key": "wrong"})[0] == 403
+        before = daemon.stats()
+        codes = [
+            _post(daemon.http_port, "/predict", {"x": x},
+                  {"X-API-Key": "sk-q"})[0]
+            for _ in range(8)
+        ]
+        assert codes.count(429) >= 4, codes
+        _settle(daemon)
+        after = daemon.stats()
+        # 429s never reached the device service: it saw exactly the
+        # ADMITTED requests, no more. (Client-visible 200 counts can
+        # run below the admitted delta under `make chaos` — a dropped
+        # response is retried, and the retry is a fresh admission.)
+        assert (
+            after["service"]["requests"] - before["service"]["requests"]
+            == after["admission"]["admitted"]
+            - before["admission"]["admitted"]
+        )
+        assert codes.count(200) <= (
+            after["admission"]["admitted"] - before["admission"]["admitted"]
+        )
+        adm = daemon.stats()["admission"]
+        assert adm["rejected_quota"] >= 4
+        assert adm["rejected_auth"] >= 2
+        # Rejected journeys carry the network leg too.
+        snap = _settle(daemon)
+        rejected = [r for r in snap["records"] if r["outcome"] == "rejected"]
+        assert rejected
+        assert all(
+            [p["phase"] for p in r["phases"]][0] == "accepted"
+            for r in rejected
+        )
+
+
+def test_daemon_admission_gate_under_2x_concurrency(tmp_path):
+    """Acceptance pin: flood at 2x the admitted best-effort concurrency
+    through the real socket — the excess fast-fails 429 at admission
+    (zero device work) while concurrent gold traffic is served in full
+    within its deadline."""
+    _, art_path = _save(tmp_path, 0, "v1")
+    SocketClient = _socket_client()
+    tenants = {
+        "sk-g": Tenant("acme", "sk-g", qps=0, tier="gold"),
+        "sk-b": Tenant("flood", "sk-b", qps=0, tier="best_effort"),
+    }
+    budget = 4
+    be_limit = max(1, int(budget * BE_BUDGET_FRAC))  # = 3
+    clients = 2 * be_limit
+    gold_deadline_ms = 30000.0
+    with ServingDaemon(
+        artifact=art_path, tenants=tenants, devices=1, buckets=(4,),
+        max_rows=4, max_delay_ms=0.5, pending_budget=budget,
+        gold_deadline_ms=gold_deadline_ms, name="t-gate",
+        flight_dir=str(tmp_path),
+    ) as daemon:
+        lock = threading.Lock()
+        flood_codes: list = []
+        gold_results: list = []
+        stop = threading.Event()
+
+        def flood():
+            end = time.perf_counter() + 1.5
+            while time.perf_counter() < end:
+                try:
+                    resp = _socket_request(
+                        SocketClient, daemon.socket_port,
+                        {"x": [[0.25] * D], "key": "sk-b"}, retries=2,
+                    )
+                    with lock:
+                        flood_codes.append(resp["status"])
+                except (ConnectionError, OSError):
+                    continue  # injected drop after serving; just go on
+
+        def gold():
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                st, _doc = _post(daemon.http_port, "/predict",
+                                 {"x": [[0.1] * D]}, {"X-API-Key": "sk-g"})
+                with lock:
+                    gold_results.append((st, time.perf_counter() - t0))
+                time.sleep(0.01)
+
+        threads = [threading.Thread(target=flood) for _ in range(clients)]
+        gold_t = threading.Thread(target=gold)
+        for t in threads:
+            t.start()
+        gold_t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        gold_t.join(timeout=30)
+
+        assert flood_codes.count(429) > 0, "backpressure never engaged"
+        assert all(c in (200, 429, 504) for c in flood_codes)
+        # Gold rode its reserved headroom: served in full, within SLA.
+        assert gold_results
+        gold_codes = [c for c, _ in gold_results]
+        assert all(c == 200 for c in gold_codes), gold_codes
+        gold_lat_ms = sorted(t * 1e3 for _, t in gold_results)
+        p99 = gold_lat_ms[min(len(gold_lat_ms) - 1,
+                              int(0.99 * len(gold_lat_ms)))]
+        assert p99 <= gold_deadline_ms
+        # Fast-fail happened at admission, not after device work: the
+        # service only ever saw admitted requests.
+        _settle(daemon)
+        stats = daemon.stats()
+        assert stats["admission"]["rejected_budget"] > 0
+        assert stats["service"]["requests"] == stats["admission"]["admitted"]
+        assert stats["active_requests"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Hot swap
+# ---------------------------------------------------------------------------
+
+
+def test_daemon_swap_under_load_two_swaps(tmp_path):
+    """Acceptance pin: sustained concurrent traffic across TWO hot
+    swaps — zero dropped/unresolved, every response attributable to
+    exactly one generation and bit-identical to that generation's
+    model."""
+    p1, a1 = _save(tmp_path, 0, "v1")
+    p2, a2 = _save(tmp_path, 1, "v2")
+    SocketClient = _socket_client()
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(2, D)).astype(np.float32)
+    refs = {
+        0: np.asarray(p1.apply(X).get()),
+        1: np.asarray(p2.apply(X).get()),
+        2: np.asarray(p1.apply(X).get()),  # swap back to v1
+    }
+    with ServingDaemon(
+        artifact=a1, devices=1, buckets=(4,), max_delay_ms=0.5,
+        name="t-swap", be_deadline_ms=0, flight_dir=str(tmp_path),
+    ) as daemon:
+        stop = threading.Event()
+        lock = threading.Lock()
+        responses: list = []
+        failures: list = []
+
+        def http_traffic():
+            while not stop.is_set():
+                st, doc = _post(daemon.http_port, "/predict",
+                                {"x": X.tolist()})
+                with lock:
+                    if st == 200:
+                        responses.append(
+                            (doc["generation"],
+                             np.asarray(doc["y"], np.float32))
+                        )
+                    else:
+                        failures.append((st, doc.get("error")))
+
+        def socket_traffic():
+            while not stop.is_set():
+                try:
+                    resp = _socket_request(
+                        SocketClient, daemon.socket_port, {"x": X.tolist()}
+                    )
+                except (ConnectionError, OSError):
+                    continue
+                with lock:
+                    if resp["status"] == 200:
+                        responses.append(
+                            (resp["generation"],
+                             np.asarray(resp["y"], np.float32))
+                        )
+                    else:
+                        failures.append(
+                            (resp["status"], resp.get("error"))
+                        )
+
+        threads = [
+            threading.Thread(target=http_traffic),
+            threading.Thread(target=http_traffic),
+            threading.Thread(target=socket_traffic),
+        ]
+        for t in threads:
+            t.start()
+        try:
+            time.sleep(0.2)
+            assert daemon.request_swap(a2, timeout_s=120) == 1
+            time.sleep(0.2)
+            assert daemon.request_swap(a1, timeout_s=120) == 2
+            time.sleep(0.2)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+        assert not failures, failures
+        assert len(responses) > 10
+        gens = sorted({g for g, _ in responses})
+        assert set(gens) <= {0, 1, 2} and 0 in gens and 2 in gens
+        # Exactly-one-generation attribution, verified by VALUE: each
+        # response matches precisely its tagged generation's model.
+        for gen, y in responses:
+            np.testing.assert_array_equal(y, refs[gen])
+        assert daemon.generation == 2
+        st, health = _get(daemon.http_port, "/healthz")
+        assert st == 200 and health["generation"] == 2
+        _settle(daemon)
+        stats = daemon.stats()
+        assert stats["swaps"] == 2 and stats["swap_failures"] == 0
+        assert stats["active_requests"] == 0
+        assert stats["service"]["pending"] == 0
+
+
+def test_daemon_replica_by_replica_handoff(tmp_path):
+    """devices=2: the successor warms replica-by-replica while the old
+    generation drains one replica at a time (never the last), and
+    /healthz reports 503 draining:true mid-swap."""
+    _, a1 = _save(tmp_path, 0, "v1")
+    _, a2 = _save(tmp_path, 1, "v2")
+    seen = {}
+
+    def hook(daemon):
+        # Between the successor's warmup and the flip: the OLD service
+        # must still be answering, one replica retired, one kept live.
+        old_stats = daemon._gen.service.stats()
+        seen["retired"] = old_stats["replicas"]["retired"]
+        seen["worker_alive"] = old_stats["worker_alive"]
+        healthy, doc = derive_health(daemon.health_stats())
+        seen["healthy_mid_swap"] = healthy
+        seen["draining_mid_swap"] = doc["draining"]
+        st, body = _get(daemon.http_port, "/healthz")
+        seen["healthz_status_mid_swap"] = st
+        # Traffic STILL lands on the old generation mid-drain.
+        st, resp = _post(daemon.http_port, "/predict",
+                         {"x": [[0.5] * D]})
+        seen["mid_swap_predict"] = (st, resp.get("generation"))
+
+    with ServingDaemon(
+        artifact=a1, devices=2, buckets=(4,), max_delay_ms=0.5,
+        name="t-handoff", swap_hook=hook, flight_dir=str(tmp_path),
+    ) as daemon:
+        assert daemon.request_swap(a2, timeout_s=180) == 1
+        assert seen["retired"] == [True, False]
+        assert seen["worker_alive"] is True
+        assert seen["healthy_mid_swap"] is False
+        assert seen["draining_mid_swap"] is True
+        assert seen["healthz_status_mid_swap"] == 503
+        assert seen["mid_swap_predict"] == (200, 0)
+        # Post-flip: healthy again on the new generation.
+        st, health = _get(daemon.http_port, "/healthz")
+        assert st == 200 and health["generation"] == 1
+        assert health["draining"] is False
+        st, doc = _post(daemon.http_port, "/predict", {"x": [[0.5] * D]})
+        assert st == 200 and doc["generation"] == 1
+
+
+def test_daemon_swap_abort_rolls_back(tmp_path, faults):
+    """Acceptance pin: a mid-swap swap_abort fault leaves the old
+    generation serving — rollback, not outage — and force-dumps
+    forensics naming the generation and the in-flight ids."""
+    _, a1 = _save(tmp_path, 0, "v1")
+    _, a2 = _save(tmp_path, 1, "v2")
+    faults("swap_abort:1")
+    flight_dir = str(tmp_path / "flight")
+    os.makedirs(flight_dir, exist_ok=True)
+    with ServingDaemon(
+        artifact=a1, devices=1, buckets=(4,), name="t-abort",
+        flight_dir=flight_dir,
+    ) as daemon:
+        st, doc = _post(daemon.http_port, "/predict", {"x": [[1.0] * D]})
+        assert st == 200 and doc["generation"] == 0
+        with pytest.raises(SwapAborted):
+            daemon.request_swap(a2, timeout_s=120)
+        # Rollback: generation unchanged, old model still answering.
+        assert daemon.generation == 0
+        st, doc = _post(daemon.http_port, "/predict", {"x": [[1.0] * D]})
+        assert st == 200 and doc["generation"] == 0
+        stats = daemon.stats()
+        assert stats["swap_failures"] == 1 and stats["swaps"] == 0
+        # Forensic dump: names the reason, the surviving generation, and
+        # the in-flight ids at abort time.
+        dumps = [f for f in os.listdir(flight_dir) if "swap_abort" in f]
+        assert dumps, os.listdir(flight_dir)
+        with open(os.path.join(flight_dir, dumps[0])) as f:
+            dump = json.load(f)
+        assert dump["reason"] == "swap_abort"
+        abort_events = [
+            e for e in dump["errors"] if e["kind"] == "swap_abort"
+        ]
+        assert abort_events
+        assert "generation 0 keeps serving" in abort_events[0]["message"]
+        assert "in-flight request ids" in abort_events[0]["message"]
+        assert dump["stats"]["generation"] == 0
+        # The fault is consumed: the NEXT swap succeeds (the abort left
+        # nothing wedged).
+        assert daemon.request_swap(a2, timeout_s=120) == 1
+        st, health = _get(daemon.http_port, "/healthz")
+        assert st == 200 and health["generation"] == 1
+
+
+def test_daemon_swap_rejects_bad_artifact(tmp_path):
+    _, a1 = _save(tmp_path, 0, "v1")
+    bad = str(tmp_path / "bad.kart")
+    with open(bad, "wb") as f:
+        f.write(b"not an artifact")
+    with ServingDaemon(
+        artifact=a1, devices=1, buckets=(4,), name="t-badswap",
+        flight_dir=str(tmp_path),
+    ) as daemon:
+        st, doc = _post(daemon.http_port, "/swap", {"artifact": bad},
+                        timeout=120)
+        assert st == 409
+        assert doc["error"] == "ArtifactVersionError"
+        assert daemon.generation == 0
+        # Wrong fingerprint pin refused the same way.
+        st, doc = _post(
+            daemon.http_port, "/swap",
+            {"artifact": a1, "expect_fingerprint": "feedface"}, timeout=120,
+        )
+        assert st == 409 and daemon.generation == 0
+
+
+# ---------------------------------------------------------------------------
+# conn_drop semantics
+# ---------------------------------------------------------------------------
+
+
+def test_daemon_conn_drop_journey_and_no_stranded_future(tmp_path, faults):
+    """A dropped client connection loses the RESPONSE, never the work:
+    the journey shows outcome conn_drop, the admission slot frees, and
+    a retried request succeeds."""
+    _, a1 = _save(tmp_path, 0, "v1")
+    faults("conn_drop:1")
+    reliability_counters.reset()
+    with ServingDaemon(
+        artifact=a1, devices=1, buckets=(4,), name="t-drop",
+        flight_dir=str(tmp_path),
+    ) as daemon:
+        x = [[1.0] * D]
+        # First data-plane response is dropped mid-write; the retry
+        # (a fresh request) is served.
+        st, doc = _post(daemon.http_port, "/predict", {"x": x})
+        assert st == 200
+        snap = _settle(daemon)
+        outcomes = [r["outcome"] for r in snap["records"]]
+        assert "conn_drop" in outcomes
+        assert "ok" in outcomes
+        dropped = [r for r in snap["records"]
+                   if r["outcome"] == "conn_drop"]
+        # The dropped request WAS served end to end: its journey has the
+        # full network leg (through submitted) before the drop.
+        phases = [p["phase"] for p in dropped[0]["phases"]]
+        assert "submitted" in phases and phases[0] == "accepted"
+        assert daemon._outcomes.snapshot().get("conn_drop", 0) >= 1
+        assert reliability_counters.get("faults_injected_conn_drop") >= 1
+        # Zero unresolved: no admission slot or active record leaked.
+        stats = daemon.stats()
+        assert stats["active_requests"] == 0
+        assert stats["admission"]["inflight"] == 0
+        assert stats["service"]["pending"] == 0
+
+
+def test_daemon_socket_conn_drop(tmp_path, faults):
+    _, a1 = _save(tmp_path, 0, "v1")
+    SocketClient = _socket_client()
+    faults("conn_drop:1")
+    with ServingDaemon(
+        artifact=a1, devices=1, buckets=(4,), name="t-sockdrop",
+        flight_dir=str(tmp_path),
+    ) as daemon:
+        resp = _socket_request(
+            SocketClient, daemon.socket_port, {"x": [[1.0] * D]}
+        )
+        assert resp["status"] == 200  # the retry after the dropped conn
+        snap = _settle(daemon)
+        assert any(
+            r["outcome"] == "conn_drop" for r in snap["records"]
+        )
+        assert daemon.stats()["active_requests"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Integration: metrics server reuse + the make serve-daemon smoke
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_server_healthz_carries_generation(tmp_path):
+    sys.path.insert(0, TOOLS)
+    try:
+        from metrics_server import MetricsServer, _fetch
+    finally:
+        sys.path.pop(0)
+    _, a1 = _save(tmp_path, 0, "v1")
+    with ServingDaemon(
+        artifact=a1, devices=1, buckets=(4,), name="t-ms",
+        flight_dir=str(tmp_path),
+    ) as daemon:
+        with MetricsServer(port=0,
+                           health_source=daemon.health_stats) as server:
+            st, body = _fetch(server.url("/healthz"))
+            doc = json.loads(body)
+            assert st == 200
+            assert doc["generation"] == 0
+            assert doc["artifact_fingerprint"] == daemon.artifact_fingerprint
+            assert doc["draining"] is False
+
+    # A draining health source flips to 503 with draining:true — the
+    # early load-balancer signal — without any daemon in the loop.
+    def draining_source():
+        return {"worker_alive": True, "closed": False, "draining": True,
+                "generation": 7, "artifact_fingerprint": "ff00"}
+
+    with MetricsServer(port=0, health_source=draining_source) as server:
+        st, body = _fetch(server.url("/healthz"))
+        doc = json.loads(body)
+        assert st == 503
+        assert doc["draining"] is True and doc["generation"] == 7
+
+
+def test_serve_daemon_smoke_in_process(tmp_path):
+    """`make serve-daemon`, in-process (the obs-serve idiom): the gate
+    can never silently rot."""
+    sys.path.insert(0, TOOLS)
+    try:
+        import serve_daemon
+    finally:
+        sys.path.pop(0)
+    result = serve_daemon.run_smoke(out_dir=str(tmp_path))
+    assert result["ok"], result["pass"]
+
+
+# ---------------------------------------------------------------------------
+# Review-round pins: construction failure, close deadline, key redaction
+# ---------------------------------------------------------------------------
+
+
+def test_daemon_ingress_bind_failure_leaks_nothing():
+    """An occupied socket port fails __init__ AFTER the generation-0
+    service/swap worker are running — the failure must tear all of it
+    down (a retrying operator process would otherwise accumulate thread
+    pools and keep the ephemeral HTTP port wedged)."""
+    import socket as socket_mod
+
+    blocker = socket_mod.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    taken = blocker.getsockname()[1]
+    try:
+        before = {
+            t.name for t in threading.enumerate()
+            if t.name.startswith("keystone-serve")
+        }
+        with pytest.raises(OSError):
+            ServingDaemon(
+                pipeline=_build_pipeline(),
+                http_port=0,
+                socket_port=taken,
+                feature_shape=(D,),
+                name="bindfail",
+            )
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            leaked = [
+                t.name for t in threading.enumerate()
+                if "bindfail" in t.name
+                or (t.name.startswith("keystone-serve")
+                    and t.name not in before)
+            ]
+            if not leaked:
+                break
+            time.sleep(0.05)
+        assert leaked == []
+    finally:
+        blocker.close()
+
+
+def test_service_close_join_s_is_a_total_deadline():
+    """close(join_s=) bounds the TOTAL drain wait, not per-thread-join:
+    the documented KEYSTONE_SWAP_DRAIN_MS contract for the hot-swap
+    flip. Pinned with never-exiting stand-in completer threads — the
+    per-join behavior would wait join_s for EACH of them."""
+    from keystone_tpu.workflow.serving import CompiledPipeline, PipelineService
+
+    cp = CompiledPipeline(_build_pipeline(), max_batch=8).warmup((D,))
+    svc = PipelineService(cp)
+    try:
+        svc.submit(np.ones((1, D), dtype=np.float32)).result(timeout=30)
+        park = threading.Event()
+        stuck = [
+            threading.Thread(target=park.wait, daemon=True)
+            for _ in range(4)
+        ]
+        for t in stuck:
+            t.start()
+        svc._completers = svc._completers + stuck
+        t0 = time.monotonic()
+        svc.close(join_s=0.5)
+        elapsed = time.monotonic() - t0
+        # Per-join semantics would block >= 4 * 0.5s on the parked
+        # threads alone; the shared deadline hands back in ~join_s.
+        assert elapsed < 1.5, elapsed
+        park.set()
+    finally:
+        svc.close()
+
+
+def test_environment_fingerprint_redacts_tenant_keys(monkeypatch):
+    """KEYSTONE_TENANTS carries API keys and environment_fingerprint()
+    lands in committed bench JSON: the key field must be masked while
+    name/qps/tier provenance survives."""
+    from keystone_tpu.utils.metrics import environment_fingerprint
+
+    monkeypatch.setenv(
+        "KEYSTONE_TENANTS", "acme:sk-live-secret:100:gold,beta:k2beta:5"
+    )
+    monkeypatch.setenv("KEYSTONE_SWAP_TOKEN", "prod-swap-secret")
+    fp = environment_fingerprint(devices=False)
+    dumped = json.dumps(fp)
+    assert "sk-live-secret" not in dumped and "k2beta" not in dumped
+    assert "prod-swap-secret" not in dumped  # control-plane credential
+    assert fp["keystone_env"]["KEYSTONE_SWAP_TOKEN"] == "****"
+    assert (
+        fp["keystone_env"]["KEYSTONE_TENANTS"]
+        == "acme:****:100:gold,beta:****:5"
+    )
+
+
+def test_daemon_close_outliving_slow_swap_does_not_park_swap_worker(tmp_path):
+    """close() racing a long in-progress swap consumes the shutdown
+    sentinel in its queue drain — it must re-seed it, or the swap
+    worker parks forever on an empty queue (one leaked thread per such
+    close, pinning both generations in memory)."""
+    _, a1 = _save(tmp_path, 0, "v1")
+    _, a2 = _save(tmp_path, 1, "v2")
+    hold = threading.Event()
+    entered = threading.Event()
+
+    def hook(_d):
+        entered.set()
+        hold.wait(timeout=30)
+
+    d = ServingDaemon(
+        artifact=a1, devices=1, buckets=(4,), name="t-slowswap",
+        flight_dir=str(tmp_path), swap_hook=hook,
+    )
+    d.CLOSE_JOIN_S = 0.3  # instance override: don't wait 10s in a test
+    fut = d.request_swap(a2, wait=False)
+    assert entered.wait(timeout=30)
+    d.close()  # join times out while the hook holds the swap mid-flight
+    hold.set()
+    with pytest.raises(ServiceClosed):
+        fut.result(timeout=30)
+    d._swap_thread.join(timeout=10)
+    assert not d._swap_thread.is_alive()
+
+
+def test_daemon_trickled_body_cannot_pin_admission_slot(monkeypatch, tmp_path):
+    """The HTTP path pre-admits on the header key BEFORE the body read:
+    a client trickling its body must be cut off by ONE total deadline
+    (not per-recv timeouts it can individually beat), releasing the
+    admission slot — pinned slots would starve every tenant."""
+    import socket as socket_mod
+
+    from keystone_tpu.workflow import daemon as daemon_mod
+
+    monkeypatch.setattr(daemon_mod, "CONN_TIMEOUT_S", 1.0)
+    _, a1 = _save(tmp_path, 0, "v1")
+    with ServingDaemon(
+        artifact=a1, devices=1, buckets=(4,), name="t-trickle",
+        flight_dir=str(tmp_path),
+    ) as daemon:
+        conn = socket_mod.create_connection(("127.0.0.1", daemon.http_port))
+        try:
+            conn.sendall(
+                b"POST /predict HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Length: 1000000\r\n\r\n"
+            )
+            conn.sendall(b"{")  # trickle one byte, then stall
+            # First observe the slot actually HELD (pre-admission ran),
+            # then released — polling straight for 0 would pass before
+            # the handler even reached admit.
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                if daemon._admission.stats()["inflight"] >= 1:
+                    break
+                time.sleep(0.01)
+            assert daemon._admission.stats()["inflight"] == 1
+            while time.monotonic() < deadline:
+                if daemon._admission.stats()["inflight"] == 0 and \
+                        daemon.stats()["active_requests"] == 0:
+                    break
+                time.sleep(0.05)
+            assert daemon._admission.stats()["inflight"] == 0
+            assert daemon.stats()["active_requests"] == 0
+        finally:
+            conn.close()
+        # The daemon still serves normally after shedding the trickler.
+        st, doc = _post(daemon.http_port, "/predict", {"x": [[1.0] * D]})
+        assert st == 200, doc
+
+
+def test_flight_record_meta_is_copy_on_write():
+    """note() must swap the meta dict atomically, not mutate in place: a
+    concurrent snapshot()/dump() copies it, and a key insert during that
+    iteration would raise RuntimeError mid-dump (dump never raises)."""
+    from keystone_tpu.utils.flight_recorder import FlightRecord
+
+    rec = FlightRecord(1, 4, first_phase="accepted")
+    rec.note(tenant="acme")
+    before = rec.meta
+    rec.note(status=200)  # new key: must land in a NEW dict
+    assert rec.meta is not before
+    assert before == {"tenant": "acme"}
+    assert rec.as_dict()["meta"] == {"tenant": "acme", "status": 200}
+
+
+def test_control_plane_locked_when_tenants_configured(tmp_path):
+    """POST /swap is operator privilege, not data-plane privilege: with
+    tenants configured and no swap token set, the control plane is
+    LOCKED (403 even with a valid tenant key); with a token set, only
+    the exact X-Swap-Token opens it. /stats redacts the tenant table
+    (names/quotas/tiers) from anonymous callers either way."""
+    _, a1 = _save(tmp_path, 0, "v1")
+    _, a2 = _save(tmp_path, 1, "v2")
+    tenants = {"sk-g": Tenant("acme-corp", "sk-g", qps=0, tier="gold")}
+
+    # No token configured: locked, data-plane key does NOT help.
+    with ServingDaemon(
+        artifact=a1, tenants=tenants, devices=1, buckets=(4,),
+        name="t-ctl-locked", flight_dir=str(tmp_path), swap_token="",
+    ) as daemon:
+        st, doc = _post(daemon.http_port, "/swap", {"artifact": a2},
+                        {"X-API-Key": "sk-g"}, retries=1)
+        assert st == 403 and daemon.generation == 0
+        st, stats = _get(daemon.http_port, "/stats")
+        assert st == 200 and stats["admission"]["tenants"] == 1  # count only
+        assert "acme-corp" not in json.dumps(stats)
+
+    # Token configured: wrong token 403, exact token swaps; /stats is
+    # full for the token holder.
+    with ServingDaemon(
+        artifact=a1, tenants=tenants, devices=1, buckets=(4,),
+        name="t-ctl-token", flight_dir=str(tmp_path), swap_token="s3cret",
+    ) as daemon:
+        st, _doc = _post(daemon.http_port, "/swap", {"artifact": a2},
+                         {"X-Swap-Token": "wrong"}, retries=1)
+        assert st == 403 and daemon.generation == 0
+        st, doc = _post(daemon.http_port, "/swap", {"artifact": a2},
+                        {"X-Swap-Token": "s3cret"}, timeout=120, retries=1)
+        assert st == 200 and doc["generation"] == 1
+        serve_daemon = _serve_daemon_mod()
+        st, body = serve_daemon.http_get(
+            daemon.http_port, "/stats", timeout=30
+        )
+        anon = json.loads(body)
+        assert anon["admission"]["tenants"] == 1
+        import urllib.request
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{daemon.http_port}/stats",
+            headers={"X-Swap-Token": "s3cret"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            full = json.loads(r.read())
+        assert full["admission"]["tenants"][0]["name"] == "acme-corp"
+
+    # Open dev mode (no tenants, no token): /swap stays open — the
+    # existing open-mode tests and demos rely on it.
+    with ServingDaemon(
+        artifact=a1, devices=1, buckets=(4,), name="t-ctl-open",
+        flight_dir=str(tmp_path), swap_token="",
+    ) as daemon:
+        st, doc = _post(daemon.http_port, "/swap", {"artifact": a2},
+                        timeout=120, retries=1)
+        assert st == 200 and doc["generation"] == 1

@@ -1,0 +1,213 @@
+"""Versioned model artifacts: the fit→serve handoff contract.
+
+Round-trips for fitted canonical pipelines (MNIST FFT, newsgroups text):
+save_artifact → load_artifact → predictions bit-identical. Mismatched
+schema versions, tampered payloads, and failed fingerprint pins raise a
+typed ArtifactVersionError AT LOAD TIME — never deep inside apply under
+traffic.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from keystone_tpu.workflow.serialization import (
+    ARTIFACT_SCHEMA_VERSION,
+    ArtifactVersionError,
+    load_artifact,
+    load_pipeline,
+    read_artifact_header,
+    save_artifact,
+    save_pipeline,
+)
+
+_MAGIC = b"KEYSTONE_ARTIFACT\n"
+
+
+def _small_fitted_pipeline(d=6, seed=0):
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+    from keystone_tpu.nodes.stats.random_features import CosineRandomFeatures
+
+    return (
+        CosineRandomFeatures.create(d, 12, seed=seed)
+        .and_then(L2Normalizer())
+        .fit()
+    )
+
+
+def _artifact_roundtrip(pipe, sample, tmp_path, tag):
+    ref = np.asarray(pipe.apply(sample).get())
+    path = str(tmp_path / f"{tag}.kart")
+    art = save_artifact(pipe, path)
+    assert art.schema_version == ARTIFACT_SCHEMA_VERSION
+    assert art.fingerprint
+    loaded = load_artifact(path)
+    assert loaded.fingerprint == art.fingerprint
+    assert loaded.pipeline_digest == art.pipeline_digest
+    got = np.asarray(loaded.pipeline.apply(sample).get())
+    np.testing.assert_array_equal(got, ref)
+    return path, art
+
+
+def test_mnist_fft_artifact_roundtrip(tmp_path):
+    from keystone_tpu.loaders import MnistLoader
+    from keystone_tpu.pipelines.images.mnist_random_fft import (
+        MnistRandomFFTConfig,
+        build_pipeline,
+    )
+
+    train, _ = MnistLoader.synthetic(n=256, seed=0)
+    conf = MnistRandomFFTConfig(num_ffts=2, synthetic_n=256)
+    pipe = build_pipeline(conf, train.data, train.labels).fit()
+    _artifact_roundtrip(pipe, train.data[:16], tmp_path, "mnist")
+
+
+def test_newsgroups_artifact_roundtrip(tmp_path):
+    from keystone_tpu.loaders.newsgroups import NewsgroupsDataLoader
+    from keystone_tpu.nodes.learning import NaiveBayesEstimator
+    from keystone_tpu.nodes.nlp import (
+        CommonSparseFeatures,
+        LowerCase,
+        NGramsFeaturizer,
+        TermFrequency,
+        Tokenizer,
+        Trim,
+    )
+    from keystone_tpu.nodes.util import MaxClassifier
+
+    train, test, classes = NewsgroupsDataLoader.synthetic(
+        n=300, num_classes=4
+    )
+    pipe = (
+        Trim()
+        .and_then(LowerCase())
+        .and_then(Tokenizer())
+        .and_then(NGramsFeaturizer(1, 2))
+        .and_then(TermFrequency("log"))
+        .and_then(CommonSparseFeatures(300), train.data)
+        .and_then(NaiveBayesEstimator(len(classes)), train.data, train.labels)
+        .and_then(MaxClassifier())
+        .fit()
+    )
+    ref = np.asarray(pipe.apply(test.data).get())
+    path = str(tmp_path / "newsgroups.kart")
+    save_artifact(pipe, path)
+    got = np.asarray(load_artifact(path).pipeline.apply(test.data).get())
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_artifact_header_readable_without_unpickling(tmp_path):
+    pipe = _small_fitted_pipeline()
+    path = str(tmp_path / "m.kart")
+    art = save_artifact(pipe, path, feature_shape=(6,), dtype="float32",
+                        extra={"note": "demo"})
+    header = read_artifact_header(path)
+    assert header["schema_version"] == ARTIFACT_SCHEMA_VERSION
+    assert header["fingerprint"] == art.fingerprint
+    assert header["serve"] == {
+        "feature_shape": [6], "dtype": "float32", "note": "demo",
+    }
+    # The environment subset names the backend it was exported under.
+    assert "jax" in header["environment"]
+    assert "backend" in header["environment"]
+
+
+def test_mismatched_schema_version_is_typed_error(tmp_path):
+    pipe = _small_fitted_pipeline()
+    path = str(tmp_path / "m.kart")
+    save_artifact(pipe, path)
+    with open(path, "rb") as f:
+        assert f.read(len(_MAGIC)) == _MAGIC
+        header = json.loads(f.readline())
+        payload = f.read()
+    header["schema_version"] = 99
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        f.write(json.dumps(header).encode() + b"\n")
+        f.write(payload)
+    with pytest.raises(ArtifactVersionError, match="schema version 99"):
+        load_artifact(path)
+
+
+def test_tampered_payload_fails_fingerprint_check(tmp_path):
+    pipe = _small_fitted_pipeline()
+    path = str(tmp_path / "m.kart")
+    save_artifact(pipe, path)
+    with open(path, "ab") as f:
+        f.write(b"\x00")  # one trailing byte: corruption, not a new model
+    with pytest.raises(ArtifactVersionError, match="fingerprint"):
+        load_artifact(path)
+
+
+def test_expect_fingerprint_pin_enforced(tmp_path):
+    pipe = _small_fitted_pipeline()
+    path = str(tmp_path / "m.kart")
+    art = save_artifact(pipe, path)
+    # The correct pin loads; a wrong pin is a typed refusal.
+    assert load_artifact(
+        path, expect_fingerprint=art.fingerprint
+    ).fingerprint == art.fingerprint
+    with pytest.raises(ArtifactVersionError, match="required"):
+        load_artifact(path, expect_fingerprint="deadbeef")
+
+
+def test_bare_pickle_is_not_an_artifact(tmp_path):
+    pipe = _small_fitted_pipeline()
+    pkl = str(tmp_path / "bare.pkl")
+    save_pipeline(pipe, pkl)
+    with pytest.raises(ArtifactVersionError, match="magic"):
+        load_artifact(pkl)
+    # ...and the bare-pickle path still round-trips unchanged.
+    assert load_pipeline(pkl) is not None
+
+
+def test_unfitted_pipeline_refused(tmp_path):
+    from keystone_tpu.loaders.timit import TimitFeaturesDataLoader
+    from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
+    from keystone_tpu.nodes.util import ClassLabelIndicators
+
+    train, _ = TimitFeaturesDataLoader.synthetic(n=64)
+    targets = ClassLabelIndicators(int(train.labels.max()) + 1)(train.labels)
+    from keystone_tpu.nodes.stats import CosineRandomFeatures
+
+    pipe = CosineRandomFeatures.create(train.data.shape[1], 32, seed=0) \
+        .and_then(BlockLeastSquaresEstimator(num_iters=1, lam=1e-2),
+                  train.data, targets)
+    with pytest.raises(ValueError, match="unfitted"):
+        save_artifact(pipe, str(tmp_path / "x.kart"))
+
+
+def test_digest_stable_across_roundtrip(tmp_path):
+    # The content-stable template digest recorded at save time matches a
+    # recompute over the LOADED pipeline — the cross-process identity
+    # the fit cache relies on survives serialization.
+    from keystone_tpu.workflow.serialization import pipeline_digest
+
+    pipe = _small_fitted_pipeline()
+    path = str(tmp_path / "m.kart")
+    art = save_artifact(pipe, path)
+    loaded = load_artifact(path)
+    if art.pipeline_digest is not None:
+        assert pipeline_digest(loaded.pipeline) == art.pipeline_digest
+
+
+def test_tampered_header_fails_fingerprint_check(tmp_path):
+    """The fingerprint covers the HEADER too: a flipped serve hint
+    (feature_shape) must fail the load loudly, not warm a wrong-shaped
+    ladder that 400s every request."""
+    pipe = _small_fitted_pipeline()
+    path = str(tmp_path / "m.kart")
+    save_artifact(pipe, path, feature_shape=(6,), dtype="float32")
+    with open(path, "rb") as f:
+        assert f.read(len(_MAGIC)) == _MAGIC
+        header = json.loads(f.readline())
+        payload = f.read()
+    header["serve"]["feature_shape"] = [60]  # the bit-rot/edit
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        f.write(json.dumps(header, sort_keys=True).encode() + b"\n")
+        f.write(payload)
+    with pytest.raises(ArtifactVersionError, match="fingerprint"):
+        load_artifact(path)

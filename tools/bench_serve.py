@@ -256,6 +256,235 @@ def run_overload(cp, args) -> dict:
     }
 
 
+def run_daemon_bench(args) -> dict:
+    """Open-loop load at 2x measured capacity through the REAL socket
+    ingress of the serving daemon, with two hot-swaps performed under
+    the sustained flood.
+
+    Tenants: one gold (protected: reserved budget headroom + deadline)
+    probed closed-loop for its p99, one best-effort flood driven
+    open-loop at 2x the capacity measured closed-loop through the same
+    wire. Gates: backpressure engages (fast-fail 429/504 on the excess
+    instead of a latency cliff), gold p99 stays within 2x its deadline,
+    both swaps succeed with responses spanning >= 2 generations, and
+    every request issued gets exactly one response (zero
+    dropped/unresolved)."""
+    import tempfile
+
+    import serve_daemon as sd  # tools/ is on sys.path when run as a script
+
+    from keystone_tpu.workflow.daemon import ServingDaemon, Tenant
+    from keystone_tpu.workflow.serialization import save_artifact
+
+    d = args.d
+    out_dir = tempfile.mkdtemp(prefix="keystone_daemon_bench_")
+    arts = []
+    for seed in (args.seed, args.seed + 1):
+        chain = build_chain(d, args.features, args.classes, seed)
+        pipe = chain.to_pipeline().fit()
+        path = os.path.join(out_dir, f"model_s{seed}.kart")
+        save_artifact(pipe, path, feature_shape=(d,), dtype="float32")
+        arts.append(path)
+
+    # Admission capacity is the daemon's pending budget: best-effort is
+    # refused past BE_BUDGET_FRAC of it. The flood offers 2x that
+    # concurrency through the real socket, so the excess MUST fast-fail
+    # at admission (429 before any device work) while gold rides its
+    # reserved headroom.
+    pending_budget = max(4, args.service_clients)
+    from keystone_tpu.workflow.daemon import BE_BUDGET_FRAC
+
+    be_limit = max(1, int(pending_budget * BE_BUDGET_FRAC))
+    clients = 2 * be_limit
+    tenants = {
+        "bk-gold": Tenant("gold", "bk-gold", qps=0, tier="gold"),
+        "bk-be": Tenant("flood", "bk-be", qps=0, tier="best_effort"),
+    }
+    daemon = ServingDaemon(
+        artifact=arts[0], tenants=tenants, devices=1,
+        max_batch=args.overload_max_rows * 2,
+        max_rows=args.overload_max_rows,
+        max_delay_ms=0.5,
+        max_pending=args.overload_max_pending,
+        pending_budget=pending_budget,
+        gold_deadline_ms=args.overload_deadline_ms,
+        be_deadline_ms=args.overload_deadline_ms,
+        name="bench-daemon",
+        swap_token="bench-swap-token",
+    )
+    x_row = np.zeros((d,), dtype=np.float32).tolist()
+    lock = threading.Lock()
+
+    try:
+        # -- calibrate: sustained within-budget closed-loop capacity
+        # through the wire (be_limit concurrent connections = exactly
+        # the admitted best-effort concurrency).
+        def closed_loop(stop_t, counter):
+            sc = sd.SocketClient(daemon.socket_port)
+            n = 0
+            try:
+                while time.perf_counter() < stop_t:
+                    resp = sc.request({"x": x_row, "key": "bk-be"})
+                    if resp.get("status") == 200:
+                        n += 1
+            finally:
+                sc.close()
+                with lock:
+                    counter.append(n)
+
+        cal_counts: list = []
+        t_end = time.perf_counter() + args.calibrate_seconds
+        cal_threads = [
+            threading.Thread(target=closed_loop, args=(t_end, cal_counts))
+            for _ in range(be_limit)
+        ]
+        t0 = time.perf_counter()
+        for t in cal_threads:
+            t.start()
+        for t in cal_threads:
+            t.join()
+        cal_wall = time.perf_counter() - t0
+        capacity_rps = sum(cal_counts) / cal_wall
+
+        # -- flood: 2x the admitted concurrency hammering the socket;
+        # gold probes closed-loop via HTTP; two hot-swaps land mid-flood.
+        outcomes = {"ok": 0, "rejected": 0, "expired": 0, "closed": 0,
+                    "error": 0, "conn": 0}
+        gens_seen = set()
+        gold_lats: list = []
+        gold_errors: list = []
+        swap_results: list = []
+        stop = threading.Event()
+
+        def flood(cid):
+            sc = sd.SocketClient(daemon.socket_port)
+            end = time.perf_counter() + args.overload_seconds
+            try:
+                while time.perf_counter() < end:
+                    try:
+                        resp = sc.request({"x": x_row, "key": "bk-be"})
+                    except (ConnectionError, OSError):
+                        with lock:
+                            outcomes["conn"] += 1
+                        sc.close()
+                        sc = sd.SocketClient(daemon.socket_port)
+                        continue
+                    status = resp.get("status")
+                    with lock:
+                        if status == 200:
+                            outcomes["ok"] += 1
+                            gens_seen.add(resp.get("generation"))
+                        elif status == 429:
+                            outcomes["rejected"] += 1
+                        elif status == 504:
+                            outcomes["expired"] += 1
+                        elif status == 503:
+                            outcomes["closed"] += 1
+                        else:
+                            outcomes["error"] += 1
+            finally:
+                sc.close()
+
+        def gold_probe():
+            while not stop.is_set():
+                t1 = time.perf_counter()
+                st, doc = sd.http_post(
+                    daemon.http_port, "/predict", {"x": x_row},
+                    {"X-API-Key": "bk-gold"},
+                )
+                if st == 200:
+                    gold_lats.append(time.perf_counter() - t1)
+                    gens_seen.add(doc.get("generation"))
+                else:
+                    gold_errors.append((st, doc.get("error")))
+                time.sleep(0.01)
+
+        def swapper():
+            # Two swaps spread across the flood window. retries=1: /swap
+            # is not idempotent — a retried ack-lost swap would run twice.
+            for i, path in enumerate((arts[1], arts[0])):
+                time.sleep(args.overload_seconds / 3.0)
+                st, doc = sd.http_post(
+                    daemon.http_port, "/swap", {"artifact": path},
+                    {"X-Swap-Token": "bench-swap-token"},
+                    timeout=120, retries=1,
+                )
+                swap_results.append((st, doc))
+
+        flood_threads = [
+            threading.Thread(target=flood, args=(c,)) for c in range(clients)
+        ]
+        gold_t = threading.Thread(target=gold_probe, daemon=True)
+        swap_t = threading.Thread(target=swapper)
+        for t in flood_threads:
+            t.start()
+        gold_t.start()
+        swap_t.start()
+        for t in flood_threads:
+            t.join()
+        swap_t.join()
+        stop.set()
+        gold_t.join(timeout=30)
+
+        stats = daemon.stats()
+        total = sum(outcomes.values())
+        fast_fails = outcomes["rejected"] + outcomes["expired"]
+        gold = lat_stats(gold_lats) if gold_lats else None
+        p99_bound_ms = 2.0 * args.overload_deadline_ms
+        swaps_ok = (
+            len(swap_results) == 2
+            and all(st == 200 for st, _ in swap_results)
+        )
+        gold_total = len(gold_lats) + len(gold_errors)
+        gold_ok_frac = len(gold_lats) / gold_total if gold_total else None
+        offered_rps = total / max(args.overload_seconds, 1e-9)
+        result = {
+            "metric": "serve_daemon",
+            "unit": "ms",
+            "clients": clients,
+            "pending_budget_admission": pending_budget,
+            "be_admission_limit": be_limit,
+            "capacity_rps": round(capacity_rps, 1),
+            "offered_rps": round(offered_rps, 1),
+            "offered_requests": total,
+            "deadline_ms": args.overload_deadline_ms,
+            "service_max_pending": args.overload_max_pending,
+            "outcomes": outcomes,
+            "fast_fail_rate": round(fast_fails / total, 4) if total else None,
+            "gold": gold,
+            "gold_ok_frac": (
+                round(gold_ok_frac, 4) if gold_ok_frac is not None else None
+            ),
+            "gold_errors": gold_errors[:10],
+            "generations_seen": sorted(
+                g for g in gens_seen if g is not None
+            ),
+            "swaps": stats["swaps"],
+            "active_leftover": stats["active_requests"],
+            "pass": {
+                "backpressure_engaged": fast_fails > 0,
+                "gold_p99_bounded": bool(
+                    gold and gold["p99_ms"] <= p99_bound_ms
+                ),
+                # A lone gold 504 riding a swap-compile stall on a 1-core
+                # host is noise; sustained gold rejection is the failure.
+                "gold_mostly_served": bool(
+                    gold_ok_frac is not None and gold_ok_frac >= 0.95
+                ),
+                "swap_under_load_ok": swaps_ok,
+                "two_generations_served": len(gens_seen) >= 2,
+                "zero_unresolved": (
+                    stats["active_requests"] == 0 and outcomes["conn"] == 0
+                    and outcomes["error"] == 0
+                ),
+            },
+        }
+        result["ok"] = all(result["pass"].values())
+        return result
+    finally:
+        daemon.close()
+
+
 def run_replica_bench(args) -> dict:
     """Replica-pool scaling: serve the same uniform mixed-size trace at
     devices=1 and devices=N through the pipelined micro-batcher, with
@@ -432,6 +661,10 @@ def main() -> None:
     ap.add_argument("--overload-max-rows", type=int, default=4,
                     help="rows per service flush in the overload phase — "
                     "the capacity-limited-device stand-in")
+    ap.add_argument("--daemon", action="store_true",
+                    help="run the networked-daemon bench instead: open-loop "
+                    "load at 2x capacity through the REAL socket ingress, "
+                    "gold-tier p99 under deadline, two hot-swaps under load")
     ap.add_argument("--devices", type=int, default=0,
                     help="run the replica-scaling bench instead: serve the "
                     "trace at devices=1 and devices=N, report throughput + "
@@ -463,6 +696,18 @@ def main() -> None:
     # KEYSTONE_SERVE_BUCKETS would silently route batch_call through
     # bucketing and collapse the comparison to bucketed-vs-bucketed.
     config.serve_buckets = ()
+
+    if args.daemon:
+        with maybe_trace("bench_serve_daemon"):
+            result = run_daemon_bench(args)
+        result["backend"] = backend
+        result["host_cores"] = os.cpu_count()
+        result["env"] = environment_fingerprint()
+        line = json.dumps(result)
+        print(line)
+        if args.out:
+            write_result(args.out, line, result["metric"])
+        sys.exit(0 if result["ok"] else 1)
 
     if args.devices > 0:
         with maybe_trace("bench_serve_replicas"):

@@ -80,7 +80,11 @@ DISPATCH_METHODS = {"submit", "_loop", "_dispatch", "_pick_slot_locked",
 #: mutates service state outside the lock must be a finding, not a blind
 #: spot behind an indirect spawn.
 KNOWN_THREAD_TARGETS = {"_watchdog_loop", "_watch_loop", "_solve_watch_loop",
-                        "_run_node_worker"}
+                        "_run_node_worker",
+                        # workflow/daemon.py ServingDaemon: the socket
+                        # ingress accept thread, its per-connection
+                        # workers, and the hot-swap worker.
+                        "_accept_loop", "_serve_conn", "_swap_loop"}
 HOST_SYNC_CALLS = {"block_until_ready", "device_get", "asarray", "array"}
 
 #: Mutating method names treated as writes for KL001 (deque/list/set/dict

@@ -131,17 +131,27 @@ class MetricsServer:
 
     def health(self):
         """(healthy, body) for /healthz. Never raises: a health endpoint
-        that 500s on a half-closed service defeats its purpose."""
+        that 500s on a half-closed service defeats its purpose.
+
+        Health sources that carry generation identity (the serving
+        daemon's ``health_stats``) surface ``generation`` /
+        ``artifact_fingerprint`` / ``draining`` at the top level, and a
+        swap mid-drain reports 503 with ``draining: true`` so load
+        balancers stop sending traffic before the flip."""
         if self.health_source is None:
             return True, {"healthy": True}
         try:
             stats = self.health_source()
         except Exception as e:  # lint: broad-ok probe must report, not raise
             return False, {"healthy": False, "error": str(e)[:200]}
-        healthy = bool(stats.get("worker_alive", True)) and not bool(
-            stats.get("closed", False)
-        )
-        return healthy, {"healthy": healthy, "stats": stats}
+        # THE health rule, shared with the daemon's own /healthz — the
+        # two surfaces must never disagree about the same service. (No
+        # new import weight: this process already imported
+        # keystone_tpu.utils.metrics — and with it jax — to construct
+        # the server.)
+        from keystone_tpu.utils.flight_recorder import derive_health
+
+        return derive_health(stats)
 
     def start(self) -> "MetricsServer":
         """Bind (ephemeral port when requested_port=0) and serve on a
