@@ -1,6 +1,6 @@
 # Developer entry points. Pipelines launch via bin/run-pipeline.sh.
 
-.PHONY: test t1 chaos native bench bench-serve bench-serve-overload bench-serve-replicas bench-serve-daemon bench-fit trace-demo obs-serve serve-daemon profile-demo bench-watch lint dryrun clean tpu-checkride sentinel northstar acceptance
+.PHONY: test t1 chaos native bench bench-serve bench-serve-overload bench-serve-replicas bench-serve-daemon bench-fit bench-opt trace-demo obs-serve serve-daemon profile-demo bench-watch lint dryrun clean tpu-checkride sentinel northstar acceptance
 
 # The canonical tier-1 verify (ROADMAP.md), verbatim at the defaults —
 # builders and CI invoke this one entry point instead of hand-copying the
@@ -131,6 +131,19 @@ profile-demo:
 # the BENCH_fit.json history `make bench-watch` regresses against.
 bench-fit:
 	JAX_PLATFORMS=cpu python tools/bench_fit.py --out BENCH_fit.json
+
+# Profile-guided optimizer A/B: the canonical re-used-subchain and
+# two-branch pipelines fitted-and-applied optimizer-off vs optimizer-on,
+# where "on" consumes the MEASURED profile a prior fit(profile=True)
+# stored (zero sample-run executions, counted and gated). Gates:
+# predictions bit-identical, >=1.2x wall-clock win per pipeline (hard on
+# any core count — the win is recompute avoidance, not overlap), zero
+# sample runs. APPENDS the fingerprinted row to the BENCH_fit.json
+# history `make bench-watch` regresses against; prints the optimizer's
+# decision table (tools/profile_report.py --decisions renders the same
+# surface standalone).
+bench-opt:
+	JAX_PLATFORMS=cpu python tools/bench_optimizer.py --out BENCH_fit.json
 
 # Bench regression sentinel: parse every BENCH_*/MULTICHIP_*/BENCH_serve/
 # BENCH_fit history row, fit per-metric noise bands from

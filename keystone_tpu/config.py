@@ -218,8 +218,44 @@ class Config:
     )
     # Whole-pipeline auto-caching (profile a sample run, persist the best
     # time-saved-per-byte intermediates under a budget). Opt-in: profiling
-    # costs a sample execution per optimization.
+    # costs a sample execution per optimization — unless a MEASURED
+    # profile for the pipeline exists in the profile store, in which case
+    # the rule consumes that and skips the sample run entirely.
     auto_cache: bool = False
+    # Directory of the measured-profile store (workflow/profile_store.py):
+    # `Pipeline.fit(profile=True)` persists per-node wall/bytes rows keyed
+    # by the pipeline's structural digest + runtime fingerprint; the
+    # optimizer rules consume matching entries instead of sample-run
+    # extrapolation. None = disabled; the KEYSTONE_PROFILE_STORE env var
+    # takes precedence (presence, not truthiness — an exported empty var
+    # disables, the resolved_cache_dir convention).
+    profile_store: str | None = None
+    # Profile-guided resource planning (workflow/rules.py
+    # PlanResourcesRule): on a measured-profile hit, pick the executor
+    # worker count from the graph's branch width + measured queue-wait
+    # attribution and plan solver chunk rows against the HBM budget
+    # (PR-3's reactive OOM-halving becomes a planned size). The plan is
+    # scoped to the optimized pipeline's own walk
+    # (PipelineEnv.resource_plan, saved/restored around nested passes)
+    # and never overrides an explicitly EXPORTED KEYSTONE_EXEC_WORKERS /
+    # KEYSTONE_SOLVE_CHUNK_ROWS (presence wins, including an explicit
+    # 0). A programmatic pin (config.exec_workers = 0 in code, no env)
+    # cannot be told apart from the unset default — to pin
+    # programmatically, disable the planner: config.plan_resources =
+    # False. Env: KEYSTONE_PLAN_RESOURCES=0 disables.
+    plan_resources: bool = field(
+        default_factory=lambda: os.environ.get(
+            "KEYSTONE_PLAN_RESOURCES", ""
+        ).lower() not in ("0", "false", "no")
+    )
+    # Planned row count per solver chunk H2D transfer: chunks larger than
+    # this are split BEFORE the transfer (linalg/normal_equations.py), so
+    # a chunk that could not fit HBM never triggers the reactive
+    # OOM-halving path. 0 = unplanned (reactive halving only, or the
+    # session plan from PlanResourcesRule). Env: KEYSTONE_SOLVE_CHUNK_ROWS.
+    solve_chunk_rows: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_SOLVE_CHUNK_ROWS", 0)
+    )
     # Raise on NaNs inside jitted computations (jax debug_nans; the
     # sanitizer analog — SURVEY.md §5 race-detection row).
     debug_nans: bool = False
@@ -486,3 +522,38 @@ def resolved_cache_dir() -> str | None:
     if "KEYSTONE_CACHE_DIR" in os.environ:
         return os.environ["KEYSTONE_CACHE_DIR"]
     return config.cache_dir
+
+
+def resolved_exec_workers() -> int | None:
+    """The LIVE env value of KEYSTONE_EXEC_WORKERS when it is exported,
+    else None. Presence, not truthiness: an explicitly exported 0 pins
+    the byte-identical legacy serial walk against the profile-guided
+    session plan (PlanResourcesRule); only the unset default falls
+    through to the plan. Read live (not the config-instantiation
+    snapshot) so a late export behaves like the resolved_cache_dir
+    convention. Lives here so the env read stays inside config.py
+    (keystone-lint KL003)."""
+    if "KEYSTONE_EXEC_WORKERS" in os.environ:
+        return _env_int("KEYSTONE_EXEC_WORKERS", 0)
+    return None
+
+
+def resolved_solve_chunk_rows() -> int | None:
+    """The LIVE env value of KEYSTONE_SOLVE_CHUNK_ROWS when exported,
+    else None — same presence-over-truthiness contract as
+    ``resolved_exec_workers``: an explicit 0 pins reactive-halving-only
+    against the planner's session plan."""
+    if "KEYSTONE_SOLVE_CHUNK_ROWS" in os.environ:
+        return _env_int("KEYSTONE_SOLVE_CHUNK_ROWS", 0)
+    return None
+
+
+def resolved_profile_store() -> str | None:
+    """The measured-profile store directory: env presence (not
+    truthiness) takes precedence over ``config.profile_store``, exactly
+    like ``resolved_cache_dir`` — an exported empty KEYSTONE_PROFILE_STORE
+    explicitly disables the store. Lives here so the env read stays
+    inside config.py (keystone-lint KL003)."""
+    if "KEYSTONE_PROFILE_STORE" in os.environ:
+        return os.environ["KEYSTONE_PROFILE_STORE"] or None
+    return config.profile_store

@@ -90,9 +90,41 @@ def _put_labeled_chunk(chunk):
     return A, B
 
 
+def planned_chunk_rows() -> int:
+    """The PLANNED per-transfer row bound: ``config.solve_chunk_rows``
+    (env KEYSTONE_SOLVE_CHUNK_ROWS) when set, else the session plan the
+    profile-guided ``PlanResourcesRule`` wrote from measured
+    bytes-per-row vs the HBM budget (``PipelineEnv.resource_plan``).
+    An explicitly exported KEYSTONE_SOLVE_CHUNK_ROWS wins outright —
+    including an explicit 0, which pins reactive-halving-only (the
+    planner never overrides an explicit setting; the env is read live,
+    not the config-instantiation snapshot). The unset default 0 falls
+    through to the plan."""
+    from keystone_tpu.config import resolved_solve_chunk_rows
+
+    env_rows = resolved_solve_chunk_rows()
+    if env_rows is not None:
+        return env_rows
+    rows = int(config.solve_chunk_rows or 0)
+    if rows > 0:
+        return rows
+    from keystone_tpu.workflow.executor import PipelineEnv
+
+    env = PipelineEnv._instance  # never CREATE an env from a solver
+    if env is not None:
+        return int(env.resource_plan.get("solve_chunk_rows", 0) or 0)
+    return 0
+
+
 def _put_chunks_resilient(chunk, plan, retry):
     """H2D one labeled chunk with OOM recovery; returns the (A, B) pairs
     to accumulate, in row order.
+
+    A chunk larger than the PLANNED row bound (``planned_chunk_rows``:
+    the profile-guided HBM-budget plan, or the explicit knob) is split to
+    plan size BEFORE any transfer is attempted — the memory-safe-by-
+    construction path (arXiv:2206.14148) that makes the reactive halving
+    below a fallback instead of the mechanism.
 
     RESOURCE_EXHAUSTED at the transfer (real, or the harness's ``oom``
     site) is retried with backoff — transient allocation pressure clears,
@@ -109,6 +141,21 @@ def _put_chunks_resilient(chunk, plan, retry):
     X_chunk, Y_chunk = chunk
     if Y_chunk is None:
         raise ValueError("chunked solve needs labeled batches")
+
+    planned = planned_chunk_rows()
+    if planned > 0:
+        n_rows = int(np.asarray(X_chunk).shape[0])
+        if n_rows > planned:
+            from keystone_tpu.utils.metrics import reliability_counters
+
+            reliability_counters.bump("planned_chunk_splits")
+            out = []
+            for s in range(0, n_rows, planned):
+                out.extend(_put_chunks_resilient(
+                    (X_chunk[s:s + planned], Y_chunk[s:s + planned]),
+                    plan, retry,
+                ))
+            return out
 
     def attempt():
         if plan is not None:

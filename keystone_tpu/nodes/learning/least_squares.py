@@ -46,7 +46,16 @@ def choose_solver(
     hbm_budget_bytes: int | None = None,
     block_size: int = 4096,
 ) -> SolverChoice:
-    hbm = hbm_budget_bytes or config.hbm_budget_bytes
+    if hbm_budget_bytes:
+        hbm = hbm_budget_bytes
+    else:
+        # The budget the RUNTIME reports (TPU bytes_limit) when it does;
+        # config.hbm_budget_bytes otherwise — the same device-first
+        # resolution the auto-cache rule and the resource planner use, so
+        # every cost-model consumer prices against one budget.
+        from keystone_tpu.utils.metrics import device_hbm_bytes
+
+        hbm = device_hbm_bytes()
     bytes_per = 4  # f32
     if n * d * bytes_per < 1 << 24 and d <= 2048:
         return SolverChoice("local", f"tiny problem (n={n}, d={d})")
@@ -100,13 +109,24 @@ class LeastSquaresEstimator(LabelEstimator):
         k = int(labels_shape[1]) if len(labels_shape) > 1 else 1
         choice = choose_solver(n, d, k, self.hbm_budget_bytes, self.block_size)
         self.last_choice = choice
+        return self._concrete(choice)
+
+    def _concrete(self, choice: SolverChoice) -> LabelEstimator:
+        """THE SolverChoice -> concrete-estimator mapping, shared by
+        ``optimize_node`` (graph-optimize-time dispatch) and ``fit``
+        (fit-time dispatch): a new solver added to one path can no longer
+        be missed by the other."""
         if choice.name == "local":
             return LocalLeastSquaresEstimator(self.lam)
         if choice.name == "normal":
             return LinearMapEstimator(self.lam)
-        return BlockLeastSquaresEstimator(
-            block_size=self.block_size, num_iters=self.num_iters, lam=self.lam
-        )
+        if choice.name == "block":
+            return BlockLeastSquaresEstimator(
+                block_size=self.block_size,
+                num_iters=self.num_iters,
+                lam=self.lam,
+            )
+        raise ValueError(f"unknown solver choice {choice.name!r}")
 
     def fit(self, data, labels) -> Transformer:
         X = jnp.asarray(data)
@@ -117,14 +137,4 @@ class LeastSquaresEstimator(LabelEstimator):
             n, d, k, self.hbm_budget_bytes, self.block_size
         )
         self.last_choice = choice
-        if choice.name == "local":
-            est: LabelEstimator = LocalLeastSquaresEstimator(self.lam)
-        elif choice.name == "normal":
-            est = LinearMapEstimator(self.lam)
-        else:
-            est = BlockLeastSquaresEstimator(
-                block_size=self.block_size,
-                num_iters=self.num_iters,
-                lam=self.lam,
-            )
-        return est.fit(X, Y)
+        return self._concrete(choice).fit(X, Y)

@@ -26,6 +26,7 @@ Plus the pre-existing FLOP/byte counts straight from the compiled HLO
 
 from __future__ import annotations
 
+import contextvars
 import json
 import logging
 import math
@@ -1234,10 +1235,18 @@ class ResourceProfile:
     def __init__(self):
         self._lock = threading.Lock()
         self._nodes: "OrderedDict[str, dict]" = OrderedDict()
+        # Content-addressed measured aggregates: prefix digest ->
+        # {label, calls, wall_ns, out_bytes, out_rows, queue_wait_ns}.
+        # This is what the profile store persists and the optimizer rules
+        # re-match to graph nodes — digests survive graph copies, fusion
+        # (chain_digest folds stage-by-stage), and process restarts,
+        # where labels collide and ids die.
+        self._digests: "OrderedDict[str, dict]" = OrderedDict()
 
     def reset(self) -> None:
         with self._lock:
             self._nodes.clear()
+            self._digests.clear()
 
     def record_node(
         self,
@@ -1251,6 +1260,9 @@ class ResourceProfile:
         cache: str = "miss",
         queue_wait_ns: Optional[int] = None,
         worker: Optional[str] = None,
+        digest: Optional[str] = None,
+        out_rows: Optional[int] = None,
+        out_shape: Optional[list] = None,
     ) -> None:
         """Fold one node execution into the label's aggregate row.
 
@@ -1291,6 +1303,28 @@ class ResourceProfile:
             if worker is not None:
                 agg["workers"].add(str(worker))
             agg["cache"][cache] = agg["cache"].get(cache, 0) + 1
+            # Digest aggregation covers EXECUTED nodes only (cache
+            # hits/memos carry no digest): the stored profile must
+            # describe what computing the node costs, not what skipping
+            # it cost.
+            if digest is not None:
+                dagg = self._digests.get(digest)
+                if dagg is None:
+                    dagg = self._digests[digest] = {
+                        "label": label, "calls": 0, "wall_ns": 0,
+                        "out_bytes": 0, "out_rows": 0, "queue_wait_ns": 0,
+                        "out_shape": None,
+                    }
+                dagg["calls"] += 1
+                dagg["wall_ns"] += int(wall_ns)
+                if queue_wait_ns is not None:
+                    dagg["queue_wait_ns"] += int(queue_wait_ns)
+                if out_nbytes is not None:
+                    dagg["out_bytes"] = int(out_nbytes)
+                if out_rows is not None:
+                    dagg["out_rows"] = int(out_rows)
+                if out_shape is not None:
+                    dagg["out_shape"] = list(out_shape)
 
     #: Numeric aggregate fields a ``mark()`` delta subtracts.
     _DELTA_FIELDS = ("calls", "wall_ns", "dispatch_ns", "flops",
@@ -1309,6 +1343,40 @@ class ResourceProfile:
                             workers=set(agg["workers"]))
                 for label, agg in self._nodes.items()
             }
+
+    def mark_digests(self) -> Dict[str, dict]:
+        """``mark()`` for the digest-keyed aggregates: ``digest_rows``
+        with this snapshot reports only executions recorded AFTER it —
+        how one fit's measurements are carved out of the process-wide
+        accumulation for the profile store."""
+        with self._lock:
+            return {d: dict(agg) for d, agg in self._digests.items()}
+
+    #: Numeric digest-aggregate fields a ``mark_digests()`` delta
+    #: subtracts (out_bytes / out_rows are last-write sizes, not sums).
+    _DIGEST_DELTA_FIELDS = ("calls", "wall_ns", "queue_wait_ns")
+
+    def digest_rows(
+        self, since: Optional[Dict[str, dict]] = None
+    ) -> Dict[str, Dict[str, Any]]:
+        """The content-addressed measured aggregates ({prefix digest ->
+        {label, calls, wall_ns, out_bytes, out_rows, queue_wait_ns}}) the
+        profile store persists. ``since`` (a ``mark_digests()``) restricts
+        to the delta; digests untouched after the mark are dropped."""
+        with self._lock:
+            items = {d: dict(agg) for d, agg in self._digests.items()}
+        if since is None:
+            return items
+        out: Dict[str, Dict[str, Any]] = {}
+        for d, agg in items.items():
+            base = since.get(d)
+            if base is not None:
+                agg = dict(agg)
+                for f in self._DIGEST_DELTA_FIELDS:
+                    agg[f] = agg[f] - base[f]
+            if agg["calls"] > 0:
+                out[d] = agg
+        return out
 
     def rows(
         self, since: Optional[Dict[str, dict]] = None
@@ -1426,7 +1494,11 @@ class ResourceProfile:
     def export(self, path: str) -> dict:
         """Write rows + snapshot as JSON (atomic), for
         ``tools/profile_report.py`` to render offline."""
-        doc = {"profile": self.snapshot(), "rows": self.rows()}
+        doc = {
+            "profile": self.snapshot(),
+            "rows": self.rows(),
+            "digests": self.digest_rows(),
+        }
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f, indent=1)
@@ -1468,36 +1540,46 @@ def render_attribution_table(rows: List[Dict[str, Any]]) -> str:
 resource_profile = ResourceProfile()
 metrics_registry.register("profile", resource_profile)
 
-#: profile_scope() nesting depth — nonzero forces ``active_profile()`` on
-#: regardless of config (the Pipeline.fit(profile=True) path).
-_profile_force = 0
-_profile_force_lock = threading.Lock()
+#: profile_scope() nesting depth, CONTEXT-local (contextvar, not a
+#: process global): one thread's fit(profile=True) must not flip every
+#: concurrently executing walk in the process into forced-profiling mode
+#: (double-executing their nodes for the warmed re-time and persisting
+#: store entries for unrelated graphs). The parallel walk copies its
+#: build-thread context into each pool task, so nested estimator
+#: sub-fits inside a profiled walk stay inside the scope.
+_profile_force: "contextvars.ContextVar[int]" = contextvars.ContextVar(
+    "keystone_profile_force", default=0
+)
 
 
 @contextmanager
 def profile_scope():
     """Force per-node profiling on for the dynamic extent of one fit /
-    apply (``Pipeline.fit(profile=True)``), yielding the process-wide
-    ``ResourceProfile``. Nests; restores on exit."""
-    global _profile_force
-    with _profile_force_lock:
-        _profile_force += 1
+    apply (``Pipeline.fit(profile=True)``) in THIS context, yielding the
+    process-wide ``ResourceProfile``. Nests; restores on exit."""
+    token = _profile_force.set(_profile_force.get() + 1)
     try:
         yield resource_profile
     finally:
-        with _profile_force_lock:
-            _profile_force -= 1
+        _profile_force.reset(token)
+
+
+def profile_forced() -> bool:
+    """True inside an explicit ``profile_scope()`` (fit(profile=True) or
+    a user scope) — the opt-in the profile store's per-apply auto-save
+    keys on, distinct from ambient KEYSTONE_PROFILE=1 observation."""
+    return bool(_profile_force.get())
 
 
 def active_profile() -> Optional[ResourceProfile]:
     """The process-wide ``ResourceProfile``, or None when profiling is
     disabled (``config.profile`` / KEYSTONE_PROFILE off and no
-    ``profile_scope()`` active). Resolve ONCE per executor walk — the
-    ``active_plan()`` discipline — so the unprofiled walk pays one None
-    check per node."""
+    ``profile_scope()`` active in this context). Resolve ONCE per
+    executor walk — the ``active_plan()`` discipline — so the unprofiled
+    walk pays one None check per node."""
     from keystone_tpu.config import config
 
-    if config.profile or _profile_force:
+    if config.profile or _profile_force.get():
         return resource_profile
     return None
 

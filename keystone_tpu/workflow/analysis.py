@@ -30,10 +30,14 @@ Rule catalog (KG = Keystone Graph):
   (composition orphans the pruner should have dropped).
 - ``KG202 cache-advice`` — a non-trivial subchain re-used by >= 2
   consumers with no cache node: each consumer recomputes the prefix.
+- ``KG203 profile-unused`` — a measured profile for this pipeline exists
+  in the profile store, but the auto-cache rule would run model-only
+  (``config.auto_cache`` is off, so the measured costs are never used
+  for cache placement; the resource planner may still consume them).
 
 Severity model: serveability rules (KG00x) are *errors* when linting
 with ``serve=True`` (the pre-``compiled()`` gate) and *warnings*
-otherwise; KG101/KG102 are warnings; KG201/KG202 are info.
+otherwise; KG101/KG102 are warnings; KG201/KG202/KG203 are info.
 
 Wire-up: ``Pipeline.lint()`` runs this directly; the opt-in env gate
 ``KEYSTONE_LINT=warn|error|off`` (default off) runs it before every
@@ -73,6 +77,7 @@ GRAPH_RULES: Dict[str, str] = {
     "KG102": "silent dtype upcast / mixed-dtype seam across nodes",
     "KG201": "dead node unreachable from the pipeline sink",
     "KG202": "re-used subchain with no cache node",
+    "KG203": "stored measured profile exists but auto-cache is model-only",
 }
 
 
@@ -436,6 +441,29 @@ def lint_graph(
             hint="insert .cache() after the shared prefix (or enable "
                  "config.auto_cache)",
         ))
+
+    # -- KG203: stored measured profile not consumed -----------------------
+    # Only when a store is configured: the existence probe is one stat(),
+    # and the digest walk is skipped entirely for unstored sessions.
+    from keystone_tpu.config import resolved_profile_store
+
+    if resolved_profile_store() and not config.auto_cache:
+        from keystone_tpu.workflow.profile_store import (
+            has_profile,
+            pipeline_profile_digest,
+        )
+
+        if has_profile(pipeline_profile_digest(graph, sink)):
+            emit(Diagnostic(
+                "KG203", "info", "-",
+                "a measured profile for this pipeline exists in the "
+                "profile store, but config.auto_cache is off — the "
+                "cache rule will run model-only and the measured costs "
+                "go unused for cache placement (the resource planner "
+                "may still consume them)",
+                hint="enable config.auto_cache to consume the stored "
+                     "profile for cache placement with zero sample runs",
+            ))
 
     return report
 

@@ -17,6 +17,7 @@ the optimizer.
 
 from __future__ import annotations
 
+import contextvars
 import threading
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
@@ -60,8 +61,22 @@ def _no_sources(sid: SourceId):
     )
 
 
+def _out_rows(value) -> Optional[int]:
+    """Leading-axis row count of a node output (None when rowless) — the
+    per-row-bytes denominator the resource planner sizes chunks with."""
+    shape = getattr(value, "shape", None)
+    if shape is not None and len(shape) >= 1:
+        try:
+            return int(shape[0])
+        except (TypeError, ValueError):
+            return None
+    if isinstance(value, (list, tuple)):
+        return len(value)
+    return None
+
+
 def _observed_execute(op, deps, tracer, profile, worker=None,
-                      queue_wait_ns=None):
+                      queue_wait_ns=None, digest=None):
     """Execute one node under the tracer and/or the resource profile.
 
     The profiled path blocks on array outputs so wall time covers device
@@ -73,7 +88,10 @@ def _observed_execute(op, deps, tracer, profile, worker=None,
     ``worker`` / ``queue_wait_ns`` come from the parallel walk: which pool
     thread ran the node and how long it sat ready before a worker picked
     it up. The serial walk passes neither, so its spans and profile rows
-    are unchanged."""
+    are unchanged. ``digest`` (the node's content-stable prefix digest,
+    precomputed by the walk) additionally files the measurement under the
+    profile's digest-keyed aggregates — the rows the profile store
+    persists and the optimizer rules re-match."""
     import time
 
     label = op.label()
@@ -93,7 +111,11 @@ def _observed_execute(op, deps, tracer, profile, worker=None,
 
     import jax
 
-    from keystone_tpu.utils.metrics import node_cost_analysis, peak_hbm_bytes
+    from keystone_tpu.utils.metrics import (
+        node_cost_analysis,
+        peak_hbm_bytes,
+        profile_forced,
+    )
 
     hbm0 = peak_hbm_bytes()
     t0 = time.perf_counter_ns()
@@ -103,6 +125,22 @@ def _observed_execute(op, deps, tracer, profile, worker=None,
         out.block_until_ready()
     end = time.perf_counter_ns()
     hbm1 = peak_hbm_bytes()
+    if profile_forced() and not isinstance(op, EstimatorOperator):
+        # Explicit profiling sessions (fit(profile=True) — the rows the
+        # profile store persists for the optimizer) re-time on the warmed
+        # path so recorded wall excludes one-time jit compile/tracing —
+        # compile cost attributed as recompute cost would make every
+        # trivial jittable node look cache-worthy (the sampled Profiler's
+        # warmed re-time, applied to the measured walk). Non-estimator
+        # operators are pure, so the extra execution cannot change state;
+        # the FIRST output is still the one returned. Ambient
+        # KEYSTONE_PROFILE=1 observation never pays the double execution.
+        t0 = time.perf_counter_ns()
+        warm = op.execute(deps)
+        t_disp = time.perf_counter_ns()
+        if isinstance(warm, jax.Array):
+            warm.block_until_ready()
+        end = time.perf_counter_ns()
     cost = None
     if (
         isinstance(op, TransformerOperator)
@@ -124,6 +162,9 @@ def _observed_execute(op, deps, tracer, profile, worker=None,
         cache="miss",
         queue_wait_ns=queue_wait_ns,
         worker=worker,
+        digest=digest,
+        out_rows=_out_rows(out),
+        out_shape=_span_shape(out),
     )
     if tracer is not None:
         tracer.record(
@@ -202,7 +243,7 @@ class _ParallelWalk:
     """
 
     def __init__(self, executor, graph, order, values, by_hash, hmemo,
-                 d_of, tracer, profile, workers):
+                 d_of, tracer, profile, workers, node_digests=None):
         self.ex = executor
         self.graph = graph
         self.values = values
@@ -211,6 +252,15 @@ class _ParallelWalk:
         self.tracer = tracer
         self.profile = profile
         self.workers = workers
+        # Precomputed in the single-threaded build phase (like dks): the
+        # shared digest memo is never touched from a worker thread.
+        self.node_digests: Dict[NodeId, Any] = node_digests or {}
+        # The build thread's context, copied into every pool task: the
+        # profile_scope() contextvar (and anything else context-scoped)
+        # must follow the walk onto its workers — without this, a
+        # fit(profile=True) parallel walk would lose the forced scope on
+        # pool threads while keeping it on the serial path.
+        self._ctx = contextvars.copy_context()
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._pool = None
@@ -293,7 +343,11 @@ class _ParallelWalk:
         # raise surfaces as the walk's error instead of wedging run()'s
         # drain wait forever. The spawned task cannot observe the
         # bookkeeping early: its first action takes this same lock.
-        self._pool.submit(self._run_node_worker, nid)
+        # Each task runs under its own COPY of the walk's build-thread
+        # context (a Context cannot be entered concurrently).
+        self._pool.submit(
+            self._ctx.copy().run, self._run_node_worker, nid
+        )
         self._inflight += 1
 
     def _run_node_worker(self, nid: NodeId) -> None:
@@ -358,6 +412,7 @@ class _ParallelWalk:
                 op, deps, self.tracer, self.profile,
                 worker=threading.current_thread().name,
                 queue_wait_ns=queue_wait_ns,
+                digest=self.node_digests.get(nid),
             )
         if isinstance(op, EstimatorOperator):
             # Cross-process store: content-addressed, atomic put — safe
@@ -496,14 +551,45 @@ class GraphExecutor:
         # falls through to the legacy serial loop, byte for byte. A walk
         # re-entered from a pool thread (an estimator fitting sub-pipelines)
         # always runs serial so concurrency stays bounded by ONE pool.
+        # Digest every node the walk will execute under a FORCED profile
+        # scope (fit(profile=True) / profile_scope() — the rows the
+        # profile store persists): the measured row's content-stable
+        # key, shared with the disk cache's memo so dataset fingerprints
+        # hash once. Ambient KEYSTONE_PROFILE=1 observation never pays
+        # the digest walk — only forced sessions can save store entries,
+        # so hashing each per-batch dataset there would buy nothing.
+        node_digests: Dict[GraphId, Any] = {}
+        if profile is not None:
+            from keystone_tpu.utils.metrics import profile_forced
+
+            if profile_forced():
+                for nid in order:
+                    node_digests[nid] = structural_digest(graph, nid, dmemo)
+
         if len(order) > 1 and not getattr(_walk_tls, "active", False):
             from keystone_tpu.config import config
 
-            workers = config.exec_workers
+            # Explicit setting wins — including an explicitly exported
+            # KEYSTONE_EXEC_WORKERS=0 (the byte-identical serial pin);
+            # only the UNSET default falls back to the profile-guided
+            # session plan (PlanResourcesRule), which only exists after
+            # a measured-profile hit. The env is read live so a late
+            # export is honored, not the config-instantiation snapshot.
+            from keystone_tpu.config import resolved_exec_workers
+
+            env_workers = resolved_exec_workers()
+            if env_workers is not None:
+                workers = env_workers
+            else:
+                workers = config.exec_workers
+                if not workers:
+                    workers = int(
+                        self.env.resource_plan.get("exec_workers", 0) or 0
+                    )
             if workers and workers > 0:
                 _ParallelWalk(
                     self, graph, order, values, by_hash, hmemo, d_of,
-                    tracer, profile, workers,
+                    tracer, profile, workers, node_digests=node_digests,
                 ).run()
                 return values
 
@@ -530,7 +616,10 @@ class GraphExecutor:
             if tracer is None and profile is None:
                 out = op.execute(deps)
             else:
-                out = _observed_execute(op, deps, tracer, profile)
+                out = _observed_execute(
+                    op, deps, tracer, profile,
+                    digest=node_digests.get(nid),
+                )
             values[nid] = by_hash[h] = out
             if isinstance(op, EstimatorOperator):
                 self._cache_fit(graph, nid, h, op, out)
@@ -585,15 +674,25 @@ class GraphExecutor:
         This is the `Pipeline.fit` lowering: the result graph is
         transformer-only on the inference path.
         """
+        # The resource plan the optimizer pass writes is scoped to THIS
+        # fit's walk: a nested optimization (an estimator fitting a
+        # sub-pipeline, an interleaved apply) saves the outer plan at
+        # its own entry and restores it here on exit, so the outer
+        # solve keeps reading the plan computed FOR it.
+        prior_plan = dict(self.env.resource_plan)
         graph = self.env.optimizer.execute(graph, [sink])
         order = graph.reachable([sink])
         est_nodes = [
             n for n in order if isinstance(graph.operators[n], EstimatorOperator)
         ]
-        if est_nodes:
-            fitted = self.execute_many(graph, est_nodes)
-        else:
-            fitted = {}
+        try:
+            if est_nodes:
+                fitted = self.execute_many(graph, est_nodes)
+            else:
+                fitted = {}
+        finally:
+            self.env.resource_plan.clear()
+            self.env.resource_plan.update(prior_plan)
         ops = dict(graph.operators)
         dps = dict(graph.dependencies)
         for nid in order:
@@ -684,6 +783,11 @@ class PipelineEnv:
         self.fit_cache: Dict[int, Any] = {}
         # structural hash -> persisted value (auto-cache rule / Cacher nodes)
         self.node_cache: Dict[int, Any] = {}
+        # Session-scoped profile-guided plan (workflow/rules.py
+        # PlanResourcesRule): e.g. {"exec_workers": 4,
+        # "solve_chunk_rows": 8192}. Consulted only where the explicit
+        # config knob is unset, so a user setting always wins.
+        self.resource_plan: Dict[str, Any] = {}
         # Cross-process fitted-prefix store, keyed by content digest; the
         # env-presence-over-config precedence lives in config.py so the
         # os.environ read stays out of this module (keystone-lint KL003).
@@ -720,6 +824,7 @@ class PipelineEnv:
         (frees pinned data)."""
         self.fit_cache.clear()
         self.node_cache.clear()
+        self.resource_plan.clear()
         for _name, rules, _iters in getattr(self.optimizer, "batches", []):
             for rule in rules:
                 clear = getattr(rule, "clear_cache", None)
@@ -727,8 +832,71 @@ class PipelineEnv:
                     clear()
 
     def optimize_and_execute(self, graph: Graph, sink: GraphId) -> Any:
+        save = self._profile_save_ctx(graph, sink)
+        # Scope this pass's resource plan to this execution (see
+        # fit_estimators): the pass clears-then-writes the plan, the
+        # walk consumes it, and the OUTER pass's plan is restored on
+        # exit so a nested optimization never retires a plan some
+        # enclosing solve is still reading.
+        prior_plan = dict(self.resource_plan)
         g = self.optimizer.execute(graph, [sink])
-        return self.executor.execute(g, sink)
+        try:
+            out = self.executor.execute(g, sink)
+        finally:
+            self.resource_plan.clear()
+            self.resource_plan.update(prior_plan)
+        if save is not None:
+            save()
+        return out
+
+    @staticmethod
+    def _profile_save_ctx(graph: Graph, sink: GraphId):
+        """When this execution is under a FORCED profile scope (an
+        explicit ``profile_scope()`` / ``fit(profile=True)`` session —
+        ambient KEYSTONE_PROFILE=1 deliberately does not write store
+        entries per apply) and a profile store is configured, return a
+        closure that persists the walk's measured delta under THIS
+        graph's digest — so a profiled apply makes later applies of the
+        same pipeline-over-data a measured-store hit too, completing the
+        profile-once-optimize-forever workflow on the apply side."""
+        from keystone_tpu.config import resolved_profile_store
+        from keystone_tpu.utils.metrics import profile_forced
+
+        if not profile_forced() or not resolved_profile_store():
+            return None
+        from keystone_tpu.utils.metrics import (
+            resource_profile,
+            runtime_fingerprint,
+        )
+        from keystone_tpu.workflow.profile_store import (
+            ProfileStoreError,
+            pipeline_profile_digest,
+            save_profile,
+        )
+
+        digest = pipeline_profile_digest(graph, sink)
+        if digest is None:
+            return None
+        mark = resource_profile.mark()
+        dmark = resource_profile.mark_digests()
+
+        def save():
+            digests = resource_profile.digest_rows(since=dmark)
+            if not digests:
+                return  # nothing executed (full cache hit): keep the old entry
+            try:
+                save_profile(
+                    digest, digests, resource_profile.rows(since=mark),
+                    fingerprint=runtime_fingerprint(),
+                )
+            except ProfileStoreError as e:
+                import logging
+
+                logging.getLogger("keystone_tpu").warning(
+                    "apply profile not saved: %s", e
+                )
+
+        return save
 
     def execute(self, graph: Graph, sink: GraphId) -> Any:
         return self.executor.execute(graph, sink)

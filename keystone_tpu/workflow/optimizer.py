@@ -17,12 +17,29 @@ rules (see workflow/rules.py as they land).
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
+import contextvars
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from keystone_tpu.config import config
 from keystone_tpu.workflow.graph import Graph, GraphId, NodeId, SourceId
 from keystone_tpu.workflow.operators import TransformerOperator
 from keystone_tpu.workflow.pipeline import FusedTransformer
+
+#: The content digest of the pipeline AS THE USER WROTE IT, captured at
+#: optimizer entry BEFORE any rule rewrites the graph (node-level solver
+#: swaps change node digests, so a rule computing the key mid-pass would
+#: never match what Pipeline.fit(profile=True) stored). Rules read it via
+#: ``active_profile_key()``.
+_profile_key: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
+    "keystone_profile_key", default=None
+)
+
+
+def active_profile_key() -> Optional[str]:
+    """The measured-profile store key of the pipeline currently being
+    optimized (None outside an optimizer pass, when no store is
+    configured, or when the pipeline has no content identity)."""
+    return _profile_key.get()
 
 
 class Rule:
@@ -143,22 +160,55 @@ class Optimizer:
         self.batches = list(batches)
 
     def execute(self, graph: Graph, targets: Sequence[GraphId]) -> Graph:
-        for _name, rules, max_iters in self.batches:
-            for _ in range(max_iters):
-                before = (graph.operators, graph.dependencies)
-                for rule in rules:
-                    graph = rule.apply(graph, targets)
-                if (graph.operators, graph.dependencies) == before:
-                    break
-        return graph
+        token = _profile_key.set(self._profile_key_of(graph, targets))
+        try:
+            for _name, rules, max_iters in self.batches:
+                for _ in range(max_iters):
+                    before = (graph.operators, graph.dependencies)
+                    for rule in rules:
+                        graph = rule.apply(graph, targets)
+                    if (graph.operators, graph.dependencies) == before:
+                        break
+            return graph
+        finally:
+            _profile_key.reset(token)
+
+    @staticmethod
+    def _profile_key_of(
+        graph: Graph, targets: Sequence[GraphId]
+    ) -> Optional[str]:
+        """The store key for this pass — computed only when a profile
+        store is configured AND a consuming rule is enabled (the digest
+        walks the whole graph, fingerprinting bound data; a per-batch
+        apply pass with auto-cache and the planner both off must not
+        pay it)."""
+        from keystone_tpu.config import config, resolved_profile_store
+
+        if not targets or not resolved_profile_store():
+            return None
+        if not (config.auto_cache or config.plan_resources):
+            return None
+        from keystone_tpu.workflow.profile_store import (
+            pipeline_profile_digest,
+        )
+
+        return pipeline_profile_digest(graph, targets[0])
 
 
 def default_optimizer() -> Optimizer:
-    from keystone_tpu.workflow.rules import AutoCacheRule, NodeOptimizationRule
+    from keystone_tpu.workflow.rules import (
+        AutoCacheRule,
+        NodeOptimizationRule,
+        PlanResourcesRule,
+    )
 
     batches: List[Tuple[str, List[Rule], int]] = [
         ("dedup", [EquivalentNodeMergeRule()], 3),
         ("node-level", [NodeOptimizationRule()], 1),
+        # Profile-guided resource planning (exec workers / solve chunk
+        # rows): acts only on a measured-profile hit; gated per-apply on
+        # config.plan_resources like auto-cache below.
+        ("plan", [PlanResourcesRule(only_if_enabled=True)], 1),
         # Gated per-apply on config.auto_cache (see AutoCacheRule), so the
         # flag works whenever it's flipped, not only before env creation.
         ("auto-cache", [AutoCacheRule(only_if_enabled=True)], 1),

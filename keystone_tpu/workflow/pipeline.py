@@ -485,9 +485,16 @@ class Pipeline:
         time, cost-model FLOPs/bytes, output nbytes, HBM delta per node
         — when the fit completes; the rows stay readable afterwards via
         ``utils.metrics.resource_profile`` and the registry/Prometheus
-        surface. ``None`` (default) follows ``config.profile``.
-        Profiling never changes fit OUTPUTS (bit-identical either way);
-        it only measures.
+        surface, AND this fit's own delta is attached to the returned
+        pipeline as ``fit_profile`` (a ``profile_store.FitProfile``) so
+        callers can inspect or persist it without re-reading the
+        process-wide registry. When a profile store is configured
+        (``KEYSTONE_PROFILE_STORE`` / ``config.profile_store``) the
+        measurements are saved there automatically, keyed by the
+        pipeline's content digest — the profile-once half of the
+        profile-guided optimizer loop. ``None`` (default) follows
+        ``config.profile``. Profiling never changes fit OUTPUTS
+        (bit-identical either way); it only measures.
 
         Ref: Pipeline.fit returning FittedPipeline [unverified].
         """
@@ -510,20 +517,64 @@ class Pipeline:
         # mark() scopes the logged table to THIS fit's delta — the
         # process-wide profile keeps accumulating for registry readers.
         mark = resource_profile.mark() if profile else None
+        dmark = resource_profile.mark_digests() if profile else None
         with (profile_scope() if profile else nullcontext()):
             with (tracer.span("pipeline.fit", "pipeline")
                   if tracer is not None else nullcontext()):
                 graph = PipelineEnv.get().executor.fit_estimators(
                     self.graph, self.sink
                 )
+        fitted = Pipeline(graph, self.source, self.sink)
         if profile:
             import logging
 
             logging.getLogger("keystone_tpu").info(
                 "fit attribution:\n%s", resource_profile.table(since=mark)
             )
+            fitted.fit_profile = self._build_fit_profile(mark, dmark)
         # Prune to the subgraph feeding our sink.
-        return Pipeline(graph, self.source, self.sink)
+        return fitted
+
+    def _build_fit_profile(self, mark, dmark):
+        """This fit's measurement handle (+ auto-save when a store is
+        configured and the pipeline has content identity)."""
+        from keystone_tpu.config import resolved_profile_store
+        from keystone_tpu.utils.metrics import (
+            resource_profile,
+            runtime_fingerprint,
+        )
+        from keystone_tpu.workflow.profile_store import (
+            FitProfile,
+            ProfileStoreError,
+            pipeline_profile_digest,
+        )
+
+        fp = FitProfile(
+            pipeline_digest=pipeline_profile_digest(self.graph, self.sink),
+            fingerprint=runtime_fingerprint(),
+            rows=resource_profile.rows(since=mark),
+            digests=resource_profile.digest_rows(since=dmark),
+        )
+        if (
+            resolved_profile_store()
+            and fp.pipeline_digest is not None
+            and fp.digests
+            # An empty delta (warm session: every node served from the
+            # fit cache) must KEEP the existing store entry, not clobber
+            # a good one with zero rows — the _profile_save_ctx rule.
+        ):
+            import logging
+
+            try:
+                fp.save()
+                logging.getLogger("keystone_tpu").info(
+                    "measured profile saved: %s", fp.saved_to
+                )
+            except ProfileStoreError as e:
+                logging.getLogger("keystone_tpu").warning(
+                    "measured profile not saved: %s", e
+                )
+        return fp
 
     def compiled(
         self, buckets=None, max_batch=None, donate=None, devices=None,

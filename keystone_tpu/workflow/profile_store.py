@@ -1,0 +1,352 @@
+"""Measured-profile store — the persistence layer that closes the
+cost-model loop.
+
+``Pipeline.fit(profile=True)`` measures what every node of a pipeline
+actually cost (utils/metrics.py ResourceProfile: wall, output nbytes,
+HBM delta, per prefix digest); this module persists those rows to a
+versioned JSON artifact keyed by the pipeline's content-stable
+structural digest, and the optimizer rules (workflow/rules.py) load them
+back on the NEXT optimization of the same pipeline — measured costs
+replacing sample-run extrapolation, the profile-once-optimize-forever
+workflow ("A Learned Performance Model for TPUs", arXiv:2008.01040,
+re-grounded in measurements instead of a learned surrogate).
+
+Store contract (the bench_watch band rule, applied at load):
+
+- an entry records the ``runtime_fingerprint()`` backend subset
+  (backend / device kind / device count); loading under an incompatible
+  runtime raises the typed ``ProfileFingerprintError`` — a CPU profile
+  must never size a TPU plan;
+- the payload carries a blake2b content digest; a corrupt or tampered
+  entry is SKIPPED with a warning (``load_profile`` returns None), never
+  crashes an optimizer pass;
+- unknown schema versions are skipped the same way (forward compat).
+
+Layout: one ``<pipeline_digest[:40]>.json`` per pipeline under the
+directory named by ``KEYSTONE_PROFILE_STORE`` / ``config.profile_store``
+(``config.resolved_profile_store``), written atomically.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import tempfile
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("keystone_tpu")
+
+#: Store schema version; bump on any incompatible shape change.
+STORE_VERSION = 1
+
+#: Fingerprint keys that must agree between the recording and consuming
+#: runtimes (None on either side is a wildcard — the bench_watch rule).
+_FINGERPRINT_KEYS = ("backend", "device_kind", "device_count")
+
+#: Parsed-entry memo keyed by (path, mtime_ns, size): optimizer batches
+#: re-apply rules to fixed point, and each apply must not re-read and
+#: re-parse the same JSON. Bounded FIFO (dict keeps insertion order);
+#: lock-guarded — parallel-walk estimator sub-fits re-enter the
+#: optimizer from pool threads, and an unguarded evict can double-pop.
+_LOAD_MEMO_CAP = 64
+_load_memo: Dict[tuple, "StoredProfile"] = {}
+_load_memo_lock = threading.Lock()
+
+
+class ProfileStoreError(RuntimeError):
+    """Base class for profile-store failures."""
+
+
+class ProfileFingerprintError(ProfileStoreError):
+    """A stored profile was recorded under an incompatible runtime
+    (different backend / device kind / device count) — refused at load,
+    the bench_watch fingerprint-band rule."""
+
+
+@dataclass
+class StoredProfile:
+    """One loaded store entry: per-node measured aggregates keyed by the
+    node's content-stable prefix digest, plus provenance."""
+
+    pipeline_digest: str
+    fingerprint: Dict[str, Any]
+    #: digest -> {label, calls, wall_ns, out_bytes, out_rows, queue_wait_ns}
+    digests: Dict[str, Dict[str, Any]]
+    #: label-keyed attribution rows (ResourceProfile.rows shape) — the
+    #: human/explainability side; rules consume ``digests``.
+    rows: List[Dict[str, Any]] = field(default_factory=list)
+    path: Optional[str] = None
+
+    def node(self, digest: Optional[str]) -> Optional[Dict[str, Any]]:
+        if digest is None:
+            return None
+        return self.digests.get(digest)
+
+
+def pipeline_profile_digest(graph, sink) -> Optional[str]:
+    """THE store key for a pipeline: content-stable structural digest of
+    its sink with the free input tokenized (a profile describes the
+    pipeline TEMPLATE plus its bound training data, not one serve
+    request). One definition shared by the save side (Pipeline.fit), the
+    consume side (the optimizer rules), and the lint side (KG203), so
+    the key can never drift between them. None when any operator in the
+    prefix lacks content identity — such pipelines cannot be stored."""
+    from keystone_tpu.workflow.graph import structural_digest
+
+    return structural_digest(graph, sink, source_token="profile-input")
+
+
+def _payload_digest(digests: Dict[str, Any], rows: List[dict]) -> str:
+    blob = json.dumps([digests, rows], sort_keys=True).encode()
+    return hashlib.blake2b(blob, digest_size=16).hexdigest()
+
+
+def _entry_path(store_dir: str, pipeline_digest: str) -> str:
+    return os.path.join(store_dir, pipeline_digest[:40] + ".json")
+
+
+def _fingerprint_compatible(a: Dict[str, Any], b: Dict[str, Any]) -> bool:
+    for k in _FINGERPRINT_KEYS:
+        if a.get(k) is not None and b.get(k) is not None and a[k] != b[k]:
+            return False
+    return True
+
+
+def store_dir_or_none(store_dir: Optional[str] = None) -> Optional[str]:
+    """The effective store directory (explicit arg > env > config)."""
+    if store_dir is not None:
+        return store_dir or None
+    from keystone_tpu.config import resolved_profile_store
+
+    return resolved_profile_store()
+
+
+def save_profile(
+    pipeline_digest: str,
+    digests: Dict[str, Dict[str, Any]],
+    rows: List[Dict[str, Any]],
+    store_dir: Optional[str] = None,
+    fingerprint: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Persist one pipeline's measured profile (atomic write). Returns
+    the entry path. Raises ``ProfileStoreError`` when no store directory
+    is configured or the directory cannot be created."""
+    root = store_dir_or_none(store_dir)
+    if not root:
+        raise ProfileStoreError(
+            "no profile store configured (set KEYSTONE_PROFILE_STORE or "
+            "config.profile_store)"
+        )
+    if fingerprint is None:
+        from keystone_tpu.utils.metrics import runtime_fingerprint
+
+        fingerprint = runtime_fingerprint()
+    try:
+        os.makedirs(root, exist_ok=True)
+    except OSError as e:
+        raise ProfileStoreError(f"cannot create profile store {root}: {e}")
+    doc = {
+        "version": STORE_VERSION,
+        "pipeline_digest": pipeline_digest,
+        "fingerprint": {k: fingerprint.get(k) for k in _FINGERPRINT_KEYS},
+        "digests": digests,
+        "rows": rows,
+        "payload_digest": _payload_digest(digests, rows),
+    }
+    path = _entry_path(root, pipeline_digest)
+    # Unique tmp name (not a fixed path+".tmp"): a fit(profile=True)
+    # auto-save racing a forced-profile apply save of the same pipeline
+    # must not interleave bytes into one tmp file, and a failed write
+    # must not litter a stale tmp (the serialization.py save_artifact
+    # rule).
+    fd, tmp = tempfile.mkstemp(
+        prefix=os.path.basename(path) + ".", suffix=".tmp", dir=root
+    )
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def has_profile(
+    pipeline_digest: Optional[str], store_dir: Optional[str] = None
+) -> bool:
+    """Cheap existence probe (no parse, no fingerprint check) — the lint
+    layer's KG203 question: 'does a stored profile exist at all?'."""
+    root = store_dir_or_none(store_dir)
+    if not root or not pipeline_digest:
+        return False
+    return os.path.exists(_entry_path(root, pipeline_digest))
+
+
+def load_profile(
+    pipeline_digest: Optional[str],
+    store_dir: Optional[str] = None,
+    fingerprint: Optional[Dict[str, Any]] = None,
+) -> Optional[StoredProfile]:
+    """Load the store entry for ``pipeline_digest``.
+
+    Returns None when the store is unconfigured, the entry is absent, or
+    the entry is corrupt/tampered/unknown-version (warned, skipped — an
+    optimizer pass must degrade to model-only, not crash). Raises
+    ``ProfileFingerprintError`` when the entry exists and parses but was
+    recorded under an incompatible runtime: that is a refusal the caller
+    must hear about, not silently equivalent to 'no profile'.
+    """
+    root = store_dir_or_none(store_dir)
+    if not root or not pipeline_digest:
+        return None
+    path = _entry_path(root, pipeline_digest)
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    memo_key = (path, st.st_mtime_ns, st.st_size)
+    with _load_memo_lock:
+        entry = _load_memo.get(memo_key)
+    if entry is None:
+        entry = _parse_entry(path, pipeline_digest)
+        if entry is None:
+            return None
+        with _load_memo_lock:
+            while len(_load_memo) >= _LOAD_MEMO_CAP:
+                _load_memo.pop(next(iter(_load_memo)))
+            _load_memo[memo_key] = entry
+    if fingerprint is None:
+        from keystone_tpu.utils.metrics import runtime_fingerprint
+
+        fingerprint = runtime_fingerprint()
+    if not _fingerprint_compatible(entry.fingerprint, fingerprint):
+        raise ProfileFingerprintError(
+            f"stored profile {path} was recorded under "
+            f"{entry.fingerprint}, incompatible with this runtime "
+            f"{ {k: fingerprint.get(k) for k in _FINGERPRINT_KEYS} }; "
+            "re-profile with Pipeline.fit(profile=True) on this backend"
+        )
+    return entry
+
+
+def _parse_entry(path: str, pipeline_digest: str) -> Optional[StoredProfile]:
+    """Parse + integrity-check one entry file; None (with a warning) on
+    any corruption — the skip-don't-crash half of the store contract."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+        logger.warning(
+            "profile store: skipping unreadable entry %s (%s)", path, e
+        )
+        return None
+    if not isinstance(doc, dict) or doc.get("version") != STORE_VERSION:
+        logger.warning(
+            "profile store: skipping %s (unknown schema version %r)",
+            path, doc.get("version") if isinstance(doc, dict) else None,
+        )
+        return None
+    digests = doc.get("digests")
+    rows = doc.get("rows")
+    if not isinstance(digests, dict) or not isinstance(rows, list):
+        logger.warning(
+            "profile store: skipping malformed entry %s", path
+        )
+        return None
+    if doc.get("payload_digest") != _payload_digest(digests, rows):
+        logger.warning(
+            "profile store: skipping %s — payload digest mismatch "
+            "(tampered or truncated entry)", path,
+        )
+        return None
+    if doc.get("pipeline_digest") != pipeline_digest:
+        logger.warning(
+            "profile store: skipping %s — entry names pipeline %r, "
+            "looked up %r", path, doc.get("pipeline_digest"),
+            pipeline_digest,
+        )
+        return None
+    return StoredProfile(
+        pipeline_digest=pipeline_digest,
+        fingerprint=doc.get("fingerprint") or {},
+        digests=digests,
+        rows=rows,
+        path=path,
+    )
+
+
+def lookup_measured(
+    pipeline_digest: Optional[str], store_dir: Optional[str] = None
+) -> Optional[StoredProfile]:
+    """The optimizer rules' entry point: the stored profile for a
+    pipeline digest, or None when nothing usable is stored. A fingerprint
+    refusal is logged and treated as no-profile here — the rules fall
+    back to model/sample costing; callers who must surface the refusal
+    (tests, tools) use ``load_profile`` directly. An entry with ZERO
+    digest rows is likewise no-profile: it carries no per-node
+    information, and letting it shadow the sampled path would turn
+    auto-cache into a silent no-op for that pipeline."""
+    if pipeline_digest is None:
+        return None
+    try:
+        entry = load_profile(pipeline_digest, store_dir=store_dir)
+    except ProfileFingerprintError as e:
+        logger.warning("profile store: %s", e)
+        return None
+    if entry is not None and not entry.digests:
+        logger.warning(
+            "profile store: entry %s has no per-node rows; falling back "
+            "to sampled costing", entry.path,
+        )
+        return None
+    return entry
+
+
+@dataclass
+class FitProfile:
+    """The handle ``Pipeline.fit(profile=True)`` attaches to the fitted
+    pipeline: this fit's own attribution delta (not the process-wide
+    registry accumulation), ready to inspect or persist."""
+
+    pipeline_digest: Optional[str]
+    fingerprint: Dict[str, Any]
+    rows: List[Dict[str, Any]]
+    digests: Dict[str, Dict[str, Any]]
+    #: Store path when the fit auto-saved (store configured), else None.
+    saved_to: Optional[str] = None
+
+    def table(self) -> str:
+        from keystone_tpu.utils.metrics import render_attribution_table
+
+        return render_attribution_table(self.rows)
+
+    def save(self, store_dir: Optional[str] = None) -> str:
+        """Persist this fit's measurements (see ``save_profile``).
+        Raises ``ProfileStoreError`` when the pipeline has no content
+        identity, no store is configured, or this fit recorded no
+        executions (a warm-session delta must not clobber a good entry
+        with zero rows)."""
+        if self.pipeline_digest is None:
+            raise ProfileStoreError(
+                "pipeline has no content-stable digest; its profile "
+                "cannot be stored (an id-keyed operator is in the graph)"
+            )
+        if not self.digests:
+            raise ProfileStoreError(
+                "this fit recorded no executions (every node came from "
+                "the session cache); nothing to store — an empty entry "
+                "would clobber the measurements a cold profiled fit saved"
+            )
+        self.saved_to = save_profile(
+            self.pipeline_digest, self.digests, self.rows,
+            store_dir=store_dir, fingerprint=self.fingerprint,
+        )
+        return self.saved_to

@@ -1,15 +1,33 @@
-"""Whole-pipeline optimization rules: auto-caching and node-level solver
-selection.
+"""Whole-pipeline optimization rules: auto-caching, node-level solver
+selection, and profile-guided resource planning.
 
 Ref: src/main/scala/workflow/{AutoCacheRule,NodeOptimizationRule}.scala
 (SURVEY.md §2.1, §3.5) [unverified].
+
+Cost provenance, in preference order (the closed cost-model loop):
+
+1. **measured** — per-node wall/bytes/shape rows recorded by a prior
+   ``Pipeline.fit(profile=True)`` and persisted in the profile store
+   (workflow/profile_store.py), matched back to graph nodes by
+   content-stable prefix digest. On a store hit the rules run ZERO
+   sample executions.
+2. **sampled** — the 64-row sample-run ``Profiler`` extrapolation
+   (with the compiled-FLOPs non-linearity correction).
+3. **model** — the abstract ``node_cost_analysis`` AOT estimate, where
+   neither of the above exists.
+
+Every choice is appended to the process-wide decision log
+(``optimizer_decisions()``), which ``tools/profile_report.py
+--decisions`` renders — the optimizer explains itself.
 """
 
 from __future__ import annotations
 
 import logging
+import threading
 import weakref
-from typing import Dict, List, Sequence
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
 
 from keystone_tpu.config import config
 from keystone_tpu.workflow.cache import CacheOperator, NodeProfile, Profiler
@@ -19,7 +37,78 @@ from keystone_tpu.workflow.operators import (
     EstimatorOperator,
     TransformerOperator,
 )
-from keystone_tpu.workflow.optimizer import Rule
+from keystone_tpu.workflow.optimizer import Rule, active_profile_key
+
+logger = logging.getLogger(__name__)
+
+# ---------------------------------------------------------------------------
+# Decision log — how the optimizer explains itself
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class OptimizerDecision:
+    """One recorded optimizer choice: which rule, on which node, what it
+    did, from which cost provenance, and why."""
+
+    rule: str
+    node: str
+    action: str        # e.g. "cache-insert", "cache-skip", "solver=...",
+                       # "exec_workers=4", "solve_chunk_rows=8192"
+    provenance: str    # "measured" | "sampled" | "model"
+    reason: str
+    cost: Dict[str, Any] = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "node": self.node,
+            "action": self.action,
+            "provenance": self.provenance,
+            "reason": self.reason,
+            "cost": dict(self.cost),
+        }
+
+
+#: Bounded process-wide decision ring (newest kept): repeated optimizer
+#: passes over hot pipelines must not grow memory.
+_DECISIONS_CAP = 256
+_decisions_lock = threading.Lock()
+_decisions: List[OptimizerDecision] = []
+
+
+def record_decision(
+    rule: str, node: str, action: str, provenance: str, reason: str,
+    cost: Optional[Dict[str, Any]] = None,
+) -> None:
+    d = OptimizerDecision(rule, node, action, provenance, reason, cost or {})
+    with _decisions_lock:
+        _decisions.append(d)
+        if len(_decisions) > _DECISIONS_CAP:
+            del _decisions[: len(_decisions) - _DECISIONS_CAP]
+
+
+def optimizer_decisions() -> List[OptimizerDecision]:
+    """The recorded decisions, oldest first (bounded ring)."""
+    with _decisions_lock:
+        return list(_decisions)
+
+
+def clear_decisions() -> None:
+    with _decisions_lock:
+        _decisions.clear()
+
+
+def _measured_profile():
+    """The stored measured profile for the pipeline currently being
+    optimized, or None (no store / no key / no entry / incompatible
+    fingerprint — the latter logged by lookup_measured)."""
+    key = active_profile_key()
+    if key is None:
+        return None
+    from keystone_tpu.workflow.profile_store import lookup_measured
+
+    return lookup_measured(key)
 
 
 def _scaled_shape(value, scale: float):
@@ -40,10 +129,12 @@ class NodeOptimizationRule(Rule):
     An estimator opts in by defining ``optimize_node(self, data_shape) ->
     estimator``. Shapes are read from directly-attached dataset nodes when
     available (the simple with_data case); estimators fed by deeper
-    transformer subgraphs get their (n, d) from ONE sampled prefix run per
-    apply (the reference's optimizer profiles sampled prefixes for stats
-    anywhere in the DAG — SURVEY.md §3.5), so cost-model dispatch happens
-    at optimization time, not fit time.
+    transformer subgraphs get their (n, d) from the MEASURED output shapes
+    of a stored profile when one matches (exact full-size shapes, zero
+    executions), else from ONE sampled prefix run per apply (the
+    reference's optimizer profiles sampled prefixes for stats anywhere in
+    the DAG — SURVEY.md §3.5), so cost-model dispatch happens at
+    optimization time, not fit time.
 
     The concrete replacement is memoized per (estimator, shapes): every
     optimizer pass over any copy of the graph swaps in the SAME concrete
@@ -85,6 +176,27 @@ class NodeOptimizationRule(Rule):
             return None, True
         return tuple(digests), True
 
+    @staticmethod
+    def _measured_shapes(graph: Graph, deps, shapes, measured, dmemo):
+        """Resolve the still-missing dep shapes from a stored measured
+        profile's recorded output shapes (exact full-size values — better
+        than a scaled sample). None when any gap stays unresolved."""
+        from keystone_tpu.workflow.graph import structural_digest
+
+        out = []
+        for s, dep in zip(shapes, deps):
+            if s is not None:
+                out.append(tuple(s))
+                continue
+            if not isinstance(dep, NodeId):
+                return None
+            entry = measured.node(structural_digest(graph, dep, dmemo))
+            shp = (entry or {}).get("out_shape")
+            if not shp:
+                return None
+            out.append(tuple(int(x) for x in shp))
+        return out
+
     def _sample_prefixes(self, graph: Graph, targets: Sequence[GraphId]):
         """One row-sampled execution of the input prefixes of every
         optimizable estimator that still NEEDS sampling — deep-graph deps
@@ -116,6 +228,8 @@ class NodeOptimizationRule(Rule):
         out = graph
         sampled = None  # lazy: only deep-graph estimators pay for the run
         sample_ok = True
+        measured = _measured_profile()
+        dmemo: Dict[GraphId, Any] = {}
         for nid in graph.reachable(targets):
             op = graph.operators[nid]
             if not isinstance(op, EstimatorOperator):
@@ -132,60 +246,73 @@ class NodeOptimizationRule(Rule):
                     if isinstance(dep_op, DatasetOperator):
                         shape = getattr(dep_op.data, "shape", None)
                 shapes.append(shape)
+            provenance = "model"
             if shapes and any(s is None for s in shapes):
                 pkey, sampleable = self._dep_prefix_key(graph, deps)
                 if not sampleable:
                     continue  # unbound prefix: nothing to sample or dispatch
-                memo_shapes = (
-                    self._shape_memo.get(pkey) if pkey is not None else None
-                )
-                if memo_shapes is not None:
-                    shapes = memo_shapes
+                resolved = None
+                if measured is not None:
+                    resolved = self._measured_shapes(
+                        graph, deps, shapes, measured, dmemo
+                    )
+                if resolved is not None:
+                    shapes = resolved
+                    provenance = "measured"
                 else:
-                    if sampled is None:
-                        try:
-                            sampled = self._sample_prefixes(graph, targets)
-                            sample_ok = True
-                        except Exception:  # lint: broad-ok sample-run probe over arbitrary user operators
-                            # A prefix that can't run on a 64-row sample
-                            # must not crash optimization: affected
-                            # estimators keep their fit-time dispatch.
-                            logging.getLogger(__name__).warning(
-                                "sampled prefix run failed; deep-graph "
-                                "estimators keep fit-time dispatch",
-                                exc_info=True,
+                    memo_shapes = (
+                        self._shape_memo.get(pkey)
+                        if pkey is not None else None
+                    )
+                    if memo_shapes is not None:
+                        shapes = memo_shapes
+                        provenance = "sampled"
+                    else:
+                        provenance = "sampled"
+                        if sampled is None:
+                            try:
+                                sampled = self._sample_prefixes(graph, targets)
+                                sample_ok = True
+                            except Exception:  # lint: broad-ok sample-run probe over arbitrary user operators
+                                # A prefix that can't run on a 64-row sample
+                                # must not crash optimization: affected
+                                # estimators keep their fit-time dispatch.
+                                logger.warning(
+                                    "sampled prefix run failed; deep-graph "
+                                    "estimators keep fit-time dispatch",
+                                    exc_info=True,
+                                )
+                                sampled = ({}, {}, {})
+                                sample_ok = False
+                        values, scales, rows_ok = sampled
+                        shapes = [
+                            s
+                            if s is not None
+                            else (
+                                _scaled_shape(
+                                    values.get(dep), scales.get(dep, 1.0)
+                                )
+                                # A row-changing prefix (sampler/aggregator)
+                                # makes scaled-n a lie; defer to fit-time.
+                                if rows_ok.get(dep, False)
+                                else None
                             )
-                            sampled = ({}, {}, {})
-                            sample_ok = False
-                    values, scales, rows_ok = sampled
-                    shapes = [
-                        s
-                        if s is not None
-                        else (
-                            _scaled_shape(
-                                values.get(dep), scales.get(dep, 1.0)
-                            )
-                            # A row-changing prefix (sampler/aggregator)
-                            # makes scaled-n a lie; defer to fit-time.
-                            if rows_ok.get(dep, False)
-                            else None
-                        )
-                        for s, dep in zip(shapes, deps)
-                    ]
-                    # Legitimate deferrals memoize; a FAILED run must not —
-                    # a transient error would otherwise disable
-                    # optimize-time dispatch for this prefix forever.
-                    # Bounded by refusing inserts when full, NOT by
-                    # clearing: a mid-apply clear would strand estimators
-                    # that _sample_prefixes skipped on a memo hit, letting
-                    # them memoize all-None shapes from a run that never
-                    # sampled their deps.
-                    if (
-                        pkey is not None
-                        and sample_ok
-                        and len(self._shape_memo) < 1024
-                    ):
-                        self._shape_memo[pkey] = shapes
+                            for s, dep in zip(shapes, deps)
+                        ]
+                        # Legitimate deferrals memoize; a FAILED run must not —
+                        # a transient error would otherwise disable
+                        # optimize-time dispatch for this prefix forever.
+                        # Bounded by refusing inserts when full, NOT by
+                        # clearing: a mid-apply clear would strand estimators
+                        # that _sample_prefixes skipped on a memo hit, letting
+                        # them memoize all-None shapes from a run that never
+                        # sampled their deps.
+                        if (
+                            pkey is not None
+                            and sample_ok
+                            and len(self._shape_memo) < 1024
+                        ):
+                            self._shape_memo[pkey] = shapes
             if not shapes or shapes[0] is None:
                 continue
             key = (id(op.estimator), tuple(shapes))
@@ -210,15 +337,46 @@ class NodeOptimizationRule(Rule):
                 if ref is not None:
                     self._memo[key] = (ref, concrete)
             if concrete is not None and concrete is not op.estimator:
+                choice = getattr(op.estimator, "last_choice", None)
+                record_decision(
+                    rule="NodeOptimizationRule",
+                    node=op.label(),
+                    action=f"solver={type(concrete).__name__}",
+                    provenance=provenance,
+                    reason=(
+                        getattr(choice, "reason", None)
+                        or "optimize_node replacement from data shapes"
+                    ),
+                    cost={"shapes": [list(map(int, s)) for s in shapes
+                                     if s is not None]},
+                )
                 out = out.replace_node(
                     nid, EstimatorOperator(concrete), graph.dependencies[nid]
                 )
         return out
 
 
+#: Assumed host/HBM materialization bandwidth used to price PERSISTING a
+#: cached value (bytes / this = seconds of materialization cost). A
+#: deliberately conservative 2 GB/s: only nodes whose recompute clearly
+#: dominates a memory write get cached on the measured path.
+_MATERIALIZE_BYTES_PER_S = 2e9
+
+#: Absolute floor on a measured node's per-call wall before it can earn a
+#: cache slot: sub-millisecond "costs" are dispatch overhead, and caching
+#: them trades a fusion boundary (and a recompile) for nothing.
+_MIN_CACHE_WALL_S = 1e-3
+
+
 class AutoCacheRule(Rule):
-    """Profile a sample run, then greedily insert cache nodes under a
-    memory budget, best time-saved-per-byte first.
+    """Insert cache nodes where a subchain's recompute cost exceeds its
+    materialization cost, best time-saved-per-byte first, under a memory
+    budget.
+
+    Costs come measured-first: a stored profile for this pipeline
+    (matched by prefix digest) supplies per-node wall/bytes with ZERO
+    sample executions; otherwise one 64-row sample run extrapolates
+    (``Profiler``, with the compiled-FLOPs non-linearity correction).
 
     The session cache persists values across executions (fit → later
     applies, repeated gets over graph copies); within one execution the
@@ -243,6 +401,112 @@ class AutoCacheRule(Rule):
         # was constructed. Directly-constructed rules stay unconditional.
         self.only_if_enabled = only_if_enabled
 
+    def _skippable(self, graph: Graph, nid: NodeId, targets_set, cons) -> bool:
+        """Nodes no candidate path considers (shared by both provenances)."""
+        op = graph.operators[nid]
+        if isinstance(op, (DatasetOperator, CacheOperator)):
+            return True  # data already lives in host memory; cache is cache
+        if isinstance(op, EstimatorOperator):
+            # Fits persist in the fit cache already, and a cache node
+            # between an estimator and its delegating consumer would
+            # hide the fitted transformer from Pipeline.fit's rewrite.
+            return True
+        if nid in targets_set or len(cons.get(nid, ())) < self.min_consumers:
+            return True
+        return False
+
+    def _measured_candidates(
+        self, graph: Graph, targets, measured, targets_set, cons
+    ) -> List[tuple]:
+        """(ratio, bytes, nid, decision-meta) candidates priced from the
+        stored profile — no execution of any kind. A candidate survives
+        only when its measured per-call recompute cost exceeds the cost
+        of materializing its measured output bytes. The saving a cache
+        buys is ONE avoided re-execution per later walk — the executor's
+        structural-hash memo already runs a multi-consumer node once per
+        walk, so consumer count is reported as context, never multiplied
+        into the saving (the sampled path prices identically)."""
+        from keystone_tpu.workflow.graph import structural_digest
+
+        dmemo: Dict[GraphId, Any] = {}
+        out: List[tuple] = []
+        for nid in graph.reachable(targets):
+            if self._skippable(graph, nid, targets_set, cons):
+                continue
+            label = graph.operators[nid].label()
+            entry = measured.node(structural_digest(graph, nid, dmemo))
+            if entry is None:
+                # No measured row for this prefix (e.g. it only executed
+                # fused into a larger program in the recorded run): leave
+                # it uncached rather than guessing.
+                continue
+            calls = max(1, int(entry.get("calls") or 0))
+            wall_s = (int(entry.get("wall_ns") or 0) / 1e9) / calls
+            nbytes = int(entry.get("out_bytes") or 0)
+            if nbytes <= 0 or wall_s <= 0:
+                continue
+            reuse = max(1, len(
+                [u for u in cons.get(nid, ()) if isinstance(u, NodeId)]
+            ))
+            materialize_s = nbytes / _MATERIALIZE_BYTES_PER_S
+            if wall_s < _MIN_CACHE_WALL_S:
+                record_decision(
+                    rule="AutoCacheRule", node=label, action="cache-skip",
+                    provenance="measured",
+                    reason=(
+                        "measured wall below the cache floor "
+                        "(dispatch overhead, not recompute)"
+                    ),
+                    cost={"recompute_s": round(wall_s, 6),
+                          "floor_s": _MIN_CACHE_WALL_S,
+                          "bytes": nbytes},
+                )
+                continue
+            if wall_s <= materialize_s:
+                record_decision(
+                    rule="AutoCacheRule", node=label, action="cache-skip",
+                    provenance="measured",
+                    reason="measured recompute cheaper than materialization",
+                    cost={"recompute_s": round(wall_s, 6),
+                          "materialize_s": round(materialize_s, 6),
+                          "bytes": nbytes},
+                )
+                continue
+            out.append((
+                wall_s / nbytes, nbytes, nid,
+                ("measured", {
+                    "recompute_s": round(wall_s, 6),
+                    "materialize_s": round(materialize_s, 6),
+                    "bytes": nbytes, "consumers": reuse,
+                }),
+            ))
+        return out
+
+    def _sampled_candidates(
+        self, graph: Graph, targets, targets_set, cons
+    ) -> List[tuple]:
+        """The original sample-run path: profile a 64-row execution and
+        extrapolate (rows scale bytes; compiled FLOPs scale time)."""
+        profiles = Profiler(self.sample_rows).profile(graph, targets)
+        out: List[tuple] = []
+        for nid, prof in profiles.items():
+            if self._skippable(graph, nid, targets_set, cons):
+                continue
+            # Output bytes scale with rows; time scales with compiled FLOPs
+            # when XLA counted them (the non-linear-stage correction).
+            est_bytes = int(prof.bytes * prof.scale)
+            est_seconds = prof.seconds * prof.time_scale
+            if est_bytes <= 0 or est_seconds <= 0:
+                continue
+            out.append((
+                est_seconds / est_bytes, est_bytes, nid,
+                ("sampled", {
+                    "recompute_s": round(est_seconds, 6),
+                    "bytes": est_bytes,
+                }),
+            ))
+        return out
+
     def apply(self, graph: Graph, targets: Sequence[GraphId]) -> Graph:
         if self.only_if_enabled and not config.auto_cache:
             return graph
@@ -255,39 +519,41 @@ class AutoCacheRule(Rule):
             from keystone_tpu.utils.metrics import device_hbm_bytes
 
             budget = device_hbm_bytes() // 4
-        profiles = Profiler(self.sample_rows).profile(graph, targets)
-        if not profiles:
-            return graph
         cons = graph.consumers(targets)
         targets_set = set(targets)
-        candidates: List[tuple[float, int, NodeId]] = []
-        for nid, prof in profiles.items():
-            op = graph.operators[nid]
-            if isinstance(op, (DatasetOperator, CacheOperator)):
-                continue  # data already lives in host memory; cache is cache
-            if isinstance(op, EstimatorOperator):
-                # Fits persist in the fit cache already, and a cache node
-                # between an estimator and its delegating consumer would
-                # hide the fitted transformer from Pipeline.fit's rewrite.
-                continue
-            if nid in targets_set or len(cons.get(nid, ())) < self.min_consumers:
-                continue
-            # Output bytes scale with rows; time scales with compiled FLOPs
-            # when XLA counted them (the non-linear-stage correction).
-            est_bytes = int(prof.bytes * prof.scale)
-            est_seconds = prof.seconds * prof.time_scale
-            if est_bytes <= 0 or est_seconds <= 0:
-                continue
-            candidates.append((est_seconds / est_bytes, est_bytes, nid))
-        candidates.sort(reverse=True)
+        measured = _measured_profile()
+        if measured is not None:
+            # Profile hit: measured costs, ZERO sample-run executions.
+            candidates = self._measured_candidates(
+                graph, targets, measured, targets_set, cons
+            )
+        else:
+            candidates = self._sampled_candidates(
+                graph, targets, targets_set, cons
+            )
+        if not candidates:
+            return graph
+        candidates.sort(key=lambda c: (c[0], c[1]), reverse=True)
 
         ops = dict(graph.operators)
         dps = dict(graph.dependencies)
         spent = 0
-        for _ratio, nbytes, nid in candidates:
+        changed = False
+        for _ratio, nbytes, nid, (provenance, cost) in candidates:
+            label = graph.operators[nid].label()
             if spent + nbytes > budget:
+                record_decision(
+                    rule="AutoCacheRule", node=label, action="cache-skip",
+                    provenance=provenance,
+                    reason=(
+                        f"budget exhausted ({spent + nbytes} of {budget} "
+                        "bytes would be pinned)"
+                    ),
+                    cost=cost,
+                )
                 continue
             spent += nbytes
+            changed = True
             from keystone_tpu.workflow.graph import fresh_node_id
 
             cache_id = fresh_node_id()
@@ -297,4 +563,162 @@ class AutoCacheRule(Rule):
                 dps[consumer] = tuple(
                     cache_id if d == nid else d for d in dps[consumer]
                 )
-        return Graph(ops, dps)
+            record_decision(
+                rule="AutoCacheRule", node=label, action="cache-insert",
+                provenance=provenance,
+                reason=(
+                    "measured recompute cost exceeds materialization cost"
+                    if provenance == "measured"
+                    else "best sampled time-saved-per-byte under budget"
+                ),
+                cost=dict(cost, budget_spent=spent, budget=budget),
+            )
+        return Graph(ops, dps) if changed else graph
+
+
+class PlanResourcesRule(Rule):
+    """Profile-guided resource planning: on a measured-profile hit, pick
+    the executor worker count and the solver chunk rows BEFORE any device
+    work, writing a session-scoped plan (``PipelineEnv.resource_plan``)
+    that the executor and the chunked solvers consult wherever the
+    explicit knobs (KEYSTONE_EXEC_WORKERS / KEYSTONE_SOLVE_CHUNK_ROWS)
+    are unset.
+
+    - ``exec_workers``: the graph's independent-branch width (max fan-in
+      over gather-style joins), clamped to host cores — measured
+      queue-wait attribution from a previous parallel run widens nothing
+      (the pool was already saturated) but is surfaced in the decision.
+    - ``solve_chunk_rows``: measured bytes-per-row of each estimator's
+      input against the HBM budget, so PR-3's reactive OOM-halving
+      becomes a planned size ("Memory Safe Computations with XLA",
+      arXiv:2206.14148).
+
+    The graph is never rewritten — this rule only plans.
+    """
+
+    #: Fraction of the device budget one solver chunk may occupy: the
+    #: accumulators, the previous in-flight chunk, and XLA scratch all
+    #: live alongside it.
+    CHUNK_BUDGET_FRAC = 8
+
+    def __init__(self, only_if_enabled: bool = False):
+        self.only_if_enabled = only_if_enabled
+
+    #: The plan keys this rule owns (and therefore clears every pass).
+    PLAN_KEYS = ("exec_workers", "solve_chunk_rows")
+
+    def apply(self, graph: Graph, targets: Sequence[GraphId]) -> Graph:
+        if not targets:
+            return graph
+        from keystone_tpu.workflow.executor import PipelineEnv
+
+        plan = PipelineEnv.get().resource_plan
+        # The plan describes the pipeline being optimized NOW. Clearing
+        # at pass entry — BEFORE the enable gate, so disabling the
+        # planner mid-session also retires its last plan — keeps a plan
+        # derived from one profiled pipeline from leaking into an
+        # unrelated pipeline's walk/solve in the same session (a planned
+        # chunk split regroups the gram accumulation — numerics the
+        # other pipeline never opted into).
+        for key in self.PLAN_KEYS:
+            plan.pop(key, None)
+        if self.only_if_enabled and not config.plan_resources:
+            return graph
+        measured = _measured_profile()
+        if measured is None:
+            return graph
+        self._plan_workers(graph, targets, measured, plan)
+        self._plan_chunk_rows(graph, targets, measured, plan)
+        return graph
+
+    @staticmethod
+    def _branch_width(graph: Graph, targets) -> int:
+        """Independent-branch width: the widest fan-in any reachable node
+        joins (a gather of B branches can run B-wide)."""
+        width = 1
+        for nid in graph.reachable(targets):
+            deps = [d for d in graph.dependencies[nid]
+                    if isinstance(d, NodeId)
+                    and not isinstance(graph.operators.get(d),
+                                       DatasetOperator)]
+            width = max(width, len(set(deps)))
+        return width
+
+    def _plan_workers(self, graph, targets, measured, plan) -> None:
+        import os
+
+        width = self._branch_width(graph, targets)
+        cores = os.cpu_count() or 1
+        workers = min(width, cores)
+        queue_wait_ms = round(sum(
+            int(e.get("queue_wait_ns") or 0)
+            for e in measured.digests.values()
+        ) / 1e6, 3)
+        if workers >= 2:
+            plan["exec_workers"] = workers
+            record_decision(
+                rule="PlanResourcesRule", node="-",
+                action=f"exec_workers={workers}",
+                provenance="measured",
+                reason=(
+                    f"graph has {width} independent branch(es) on a "
+                    f"{cores}-core host"
+                ),
+                cost={"branch_width": width, "host_cores": cores,
+                      "measured_queue_wait_ms": queue_wait_ms},
+            )
+        else:
+            record_decision(
+                rule="PlanResourcesRule", node="-", action="exec_workers=0",
+                provenance="measured",
+                reason=(
+                    "serial walk kept: "
+                    + (f"only {cores} host core(s)" if cores < 2
+                       else "no independent branches to overlap")
+                ),
+                cost={"branch_width": width, "host_cores": cores},
+            )
+
+    def _plan_chunk_rows(self, graph, targets, measured, plan) -> None:
+        from keystone_tpu.workflow.graph import structural_digest
+        from keystone_tpu.utils.metrics import device_hbm_bytes
+
+        dmemo: Dict[GraphId, Any] = {}
+        budget = device_hbm_bytes() // self.CHUNK_BUDGET_FRAC
+        for nid in graph.reachable(targets):
+            op = graph.operators[nid]
+            if not isinstance(op, EstimatorOperator):
+                continue
+            deps = graph.dependencies[nid]
+            if not deps or not isinstance(deps[0], NodeId):
+                continue
+            entry = measured.node(structural_digest(graph, deps[0], dmemo))
+            if entry is None:
+                continue
+            rows = int(entry.get("out_rows") or 0)
+            nbytes = int(entry.get("out_bytes") or 0)
+            if rows <= 0 or nbytes <= 0:
+                continue
+            bytes_per_row = nbytes / rows
+            planned = int(budget // max(1.0, bytes_per_row))
+            if planned >= rows or planned < 1:
+                # The whole measured input fits the chunk budget: nothing
+                # to plan (streams smaller than the budget never split).
+                continue
+            prior = int(plan.get("solve_chunk_rows", 0) or 0)
+            plan["solve_chunk_rows"] = (
+                min(prior, planned) if prior else planned
+            )
+            record_decision(
+                rule="PlanResourcesRule", node=op.label(),
+                action=f"solve_chunk_rows={planned}",
+                provenance="measured",
+                reason=(
+                    f"measured {bytes_per_row:.0f} B/row vs "
+                    f"{budget} B chunk budget — planned split replaces "
+                    "reactive OOM-halving"
+                ),
+                cost={"bytes_per_row": round(bytes_per_row, 1),
+                      "chunk_budget_bytes": budget,
+                      "measured_rows": rows},
+            )

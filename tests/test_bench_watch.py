@@ -119,7 +119,10 @@ def test_fit_family_loaded_and_regression_flagged(history):
     bit-identity flag flipping false regresses by definition."""
     path = os.path.join(str(history), "BENCH_fit.json")
     rows = [json.loads(line) for line in open(path)]
-    row = json.loads(json.dumps(rows[-1]))
+    # Anchor by metric: the file interleaves fit families (parallel
+    # walk, optimizer A/B), one JSONL row per run of each.
+    latest = [r for r in rows if r.get("metric") == "fit_parallel_walk"][-1]
+    row = json.loads(json.dumps(latest))
     row["value"] *= 0.3  # speedup collapses
     row["detail"]["parallel_wall_s"] *= 4.0  # wall-like, up = regress
     row["detail"]["bit_identical"] = False
@@ -131,6 +134,31 @@ def test_fit_family_loaded_and_regression_flagged(history):
     assert "fit:fit_parallel_walk:value" in names
     assert "fit:fit_parallel_walk:detail.parallel_wall_s" in names
     assert "fit:fit_parallel_walk:detail.bit_identical" in names
+
+
+def test_optimizer_family_loaded_and_regression_flagged(history):
+    """ISSUE-12: the `make bench-opt` row gates under the same generic
+    loader — the optimizer speedup regressing down, a per-pipeline
+    speedup collapsing, or the bit-identity / zero-sample-run flags
+    flipping false all fail the watch."""
+    path = os.path.join(str(history), "BENCH_fit.json")
+    rows = [json.loads(line) for line in open(path)]
+    latest = [r for r in rows if r.get("metric") == "fit_optimizer"][-1]
+    row = json.loads(json.dumps(latest))
+    row["value"] *= 0.3
+    row["detail"]["pipelines"]["reused_subchain"]["speedup"] *= 0.3
+    row["detail"]["bit_identical"] = False
+    row["detail"]["zero_sample_runs"] = False
+    with open(path, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    result = bench_watch.run(str(history))
+    assert not result["ok"]
+    names = {v["series"] for v in result["regressions"]}
+    assert "fit:fit_optimizer:value" in names
+    assert ("fit:fit_optimizer:detail.pipelines.reused_subchain.speedup"
+            in names)
+    assert "fit:fit_optimizer:detail.bit_identical" in names
+    assert "fit:fit_optimizer:detail.zero_sample_runs" in names
 
 
 def test_unjudged_leaves_never_gate(history):

@@ -1,0 +1,790 @@
+"""Profile-guided optimization tests (ISSUE-12): the measured-profile
+store (workflow/profile_store.py) and the rules that consume it
+(workflow/rules.py), end to end.
+
+The contract pinned here:
+
+- ``Pipeline.fit(profile=True)`` attaches a ``FitProfile`` handle to the
+  fitted pipeline and auto-persists the measured per-node rows to the
+  store (``KEYSTONE_PROFILE_STORE`` / ``config.profile_store``), keyed
+  by the pipeline's content-stable digest + runtime fingerprint.
+- On a store hit, ``AutoCacheRule`` / ``NodeOptimizationRule`` /
+  ``PlanResourcesRule`` consume MEASURED costs with ZERO sample-run
+  executions (the acceptance pin: the ``Profiler`` entry points are
+  replaced with ``raise`` and optimization still completes), and the
+  resulting plan is bit-stable across export -> reload.
+- A fingerprint-incompatible entry is refused with the typed
+  ``ProfileFingerprintError``; corrupt / tampered / unknown-version
+  entries are SKIPPED with a warning and the optimizer degrades to the
+  sampled path instead of crashing.
+- KG202 cache advice goes quiet once the optimizer acts on it; KG203
+  reports a stored profile that model-only optimization would ignore.
+- ``PlanResourcesRule`` turns measured bytes-per-row into a planned
+  solver chunk size (``planned_chunk_rows``) and the graph's branch
+  width into an executor worker plan — explicit knobs always win.
+"""
+
+import glob
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from keystone_tpu.config import config
+from keystone_tpu.nodes.learning.least_squares import (
+    LeastSquaresEstimator,
+    SolverChoice,
+)
+from keystone_tpu.nodes.learning.linear_mapper import LinearMapEstimator
+from keystone_tpu.utils.metrics import profile_scope, resource_profile
+from keystone_tpu.workflow import Pipeline, Transformer
+from keystone_tpu.workflow import profile_store as ps
+from keystone_tpu.workflow import rules
+from keystone_tpu.workflow.cache import CacheOperator, Profiler
+from keystone_tpu.workflow.executor import PipelineEnv
+from keystone_tpu.workflow.graph import Graph, fresh_source_id
+from keystone_tpu.workflow.operators import (
+    DatasetOperator,
+    GatherOperator,
+    TransformerOperator,
+)
+
+
+class HostWork(Transformer):
+    """Deterministic host-bound featurizer: heavy enough (~ms per call)
+    to clear the auto-cache wall floor, with a FIXED iteration count so
+    every output (and the bit-identity assertions) is exact."""
+
+    jittable = False
+
+    def __init__(self, seed: int, iters: int = 16):
+        self.seed, self.iters = int(seed), int(iters)
+
+    def signature(self):
+        return self.stable_signature(self.seed, self.iters)
+
+    def apply_batch(self, X):
+        Y = np.asarray(X, dtype=np.float32)
+        rng = np.random.default_rng(self.seed)
+        filt = (1.0 + rng.uniform(size=Y.shape[1] // 2 + 1)).astype(
+            np.complex64
+        )
+        for _ in range(self.iters):
+            spec = np.fft.rfft(Y, axis=1) * filt
+            Y = np.tanh(Y + np.fft.irfft(
+                spec, n=Y.shape[1], axis=1
+            ).astype(np.float32))
+        return Y
+
+
+class ScaleBy(Transformer):
+    jittable = True
+
+    def __init__(self, c: float):
+        self.c = float(c)
+
+    def signature(self):
+        return self.stable_signature(self.c)
+
+    def apply_batch(self, X):
+        return X * self.c
+
+
+N, D, K = 256, 64, 4
+
+
+def _data(n=N, d=D, k=K):
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    Y = (X @ rng.normal(size=(d, k)).astype(np.float32)).astype(np.float32)
+    return X, Y
+
+
+def build_reused_subchain(X, Y, estimator=None):
+    """The canonical re-used-subchain pipeline: one heavy prefix fanned
+    out to two consumers, gathered into a solve."""
+    prefix = HostWork(seed=1).to_pipeline()
+    b1 = prefix.and_then(ScaleBy(2.0))
+    b2 = prefix.and_then(ScaleBy(0.5))
+    return Pipeline.gather([b1, b2]).and_then(
+        estimator or LinearMapEstimator(lam=1e-3), X, Y
+    )
+
+
+def dataset_rooted_reused_graph(X):
+    """The fit-side shape alone: Dataset -> heavy prefix -> two consumers
+    -> gather, no source-fed serve template (whose re-used prefix the
+    optimizer legitimately cannot cache — it depends on runtime input)."""
+    src = fresh_source_id()
+    g, data = Graph().add(DatasetOperator(X), [])
+    g, prefix = g.add(TransformerOperator(HostWork(seed=1)), [data])
+    g, b1 = g.add(TransformerOperator(ScaleBy(2.0)), [prefix])
+    g, b2 = g.add(TransformerOperator(ScaleBy(0.5)), [prefix])
+    g, out = g.add(GatherOperator(), [b1, b2])
+    return g, src, out
+
+
+@pytest.fixture
+def store(tmp_path, monkeypatch):
+    """A private profile store + full isolation of the knobs and
+    process-wide state the loop touches. The store is pinned via the
+    ENV var (which wins over config.profile_store), so a developer
+    machine exporting KEYSTONE_PROFILE_STORE cannot leak in."""
+    prior = (config.auto_cache, config.plan_resources,
+             config.solve_chunk_rows, config.exec_workers)
+    path = str(tmp_path / "profiles")
+    monkeypatch.setenv("KEYSTONE_PROFILE_STORE", path)
+    PipelineEnv.reset()
+    resource_profile.reset()
+    rules.clear_decisions()
+    ps._load_memo.clear()
+    yield path
+    (config.auto_cache, config.plan_resources,
+     config.solve_chunk_rows, config.exec_workers) = prior
+    PipelineEnv.reset()
+    resource_profile.reset()
+    rules.clear_decisions()
+    ps._load_memo.clear()
+
+
+def _entry_paths(store_dir):
+    return sorted(glob.glob(os.path.join(store_dir, "*.json")))
+
+
+def _profiled_fit(pipe):
+    PipelineEnv.reset()
+    fitted = pipe.fit(profile=True)
+    return fitted
+
+
+def _boom(self, *a, **k):
+    raise AssertionError("sample run executed on the measured path")
+
+
+# ---------------------------------------------------------------------------
+# FitProfile handle + store artifact
+# ---------------------------------------------------------------------------
+
+
+def test_fit_profile_handle_attached_and_autosaved(store):
+    X, Y = _data()
+    fitted = _profiled_fit(build_reused_subchain(X, Y))
+    fp = fitted.fit_profile
+    assert isinstance(fp, ps.FitProfile)
+    assert fp.pipeline_digest and fp.rows and fp.digests
+    assert fp.saved_to and os.path.exists(fp.saved_to)
+    # Handle rows are THIS fit's delta, renderable without the registry.
+    assert "wall_ms" in fp.table() or "wall" in fp.table()
+    doc = json.load(open(fp.saved_to))
+    assert doc["version"] == ps.STORE_VERSION
+    assert doc["pipeline_digest"] == fp.pipeline_digest
+    assert set(doc["fingerprint"]) == {"backend", "device_kind",
+                                       "device_count"}
+    assert doc["payload_digest"] == ps._payload_digest(
+        doc["digests"], doc["rows"]
+    )
+    # The measured aggregates carry what the rules price with.
+    entry = next(iter(doc["digests"].values()))
+    assert {"label", "calls", "wall_ns", "out_bytes", "out_rows"} <= set(
+        entry
+    )
+
+
+def test_fit_profile_without_store_attached_not_saved(store, monkeypatch):
+    # An exported EMPTY env var explicitly disables the store.
+    monkeypatch.setenv("KEYSTONE_PROFILE_STORE", "")
+    X, Y = _data()
+    fitted = _profiled_fit(build_reused_subchain(X, Y))
+    fp = fitted.fit_profile
+    assert fp is not None and fp.saved_to is None
+    with pytest.raises(ps.ProfileStoreError):
+        fp.save()  # still no store configured
+    fp.save(store_dir=store)  # explicit destination works
+    assert fp.saved_to and os.path.exists(fp.saved_to)
+
+
+def test_warm_session_refit_keeps_stored_measurements(store):
+    """A second fit(profile=True) in the same session serves every node
+    from the fit cache — its EMPTY delta must keep the cold fit's store
+    entry, not clobber it with zero rows (which would silently turn
+    every later measured optimization into a no-op)."""
+    X, Y = _data()
+    p = build_reused_subchain(X, Y)
+    cold = _profiled_fit(p)
+    n_rows = len(json.load(open(cold.fit_profile.saved_to))["digests"])
+    assert n_rows > 0
+    warm = p.fit(profile=True)  # same session: full fit-cache hit
+    assert len(json.load(
+        open(cold.fit_profile.saved_to)
+    )["digests"]) == n_rows
+    # The warm handle knows it has nothing to store.
+    if not warm.fit_profile.digests:
+        with pytest.raises(ps.ProfileStoreError, match="no executions"):
+            warm.fit_profile.save()
+    # And an artificially emptied entry never shadows the sampled path.
+    ps.save_profile(cold.fit_profile.pipeline_digest, {}, [])
+    ps._load_memo.clear()
+    assert ps.lookup_measured(cold.fit_profile.pipeline_digest) is None
+
+
+def test_nested_optimization_restores_outer_plan(store):
+    """An interleaved/nested optimize-and-execute (sub-pipeline fit,
+    concurrent apply) must not retire the plan an enclosing solve is
+    still reading: orchestration points restore the outer plan on
+    exit."""
+    X, _ = _data(n=64, d=16)
+    g, _src, out = dataset_rooted_reused_graph(X)
+    env = PipelineEnv.get()
+    env.resource_plan["solve_chunk_rows"] = 77  # the outer pass's plan
+    env.optimize_and_execute(g, out)  # nested pass, no profile of its own
+    assert env.resource_plan.get("solve_chunk_rows") == 77
+
+
+def test_plain_fit_attaches_no_profile(store):
+    X, Y = _data()
+    PipelineEnv.reset()
+    fitted = build_reused_subchain(X, Y).fit()
+    assert getattr(fitted, "fit_profile", None) is None
+    assert not _entry_paths(store)
+
+
+def test_forced_profile_apply_saves_store_entry(store):
+    """A profiled EXECUTION (not just fit) persists its measured walk
+    too: the dataset-rooted graph run under profile_scope() lands in the
+    store keyed by its own digest — profile-once covers apply graphs."""
+    X, _ = _data()
+    g, _src, out = dataset_rooted_reused_graph(X)
+    PipelineEnv.reset()
+    with profile_scope():
+        PipelineEnv.get().optimize_and_execute(g, out)
+    assert len(_entry_paths(store)) == 1
+    digest = ps.pipeline_profile_digest(g, out)
+    assert ps.has_profile(digest)
+    loaded = ps.load_profile(digest)
+    assert loaded is not None and loaded.digests
+
+
+# ---------------------------------------------------------------------------
+# Zero sample runs + bit-stable plans on a store hit
+# ---------------------------------------------------------------------------
+
+
+def test_zero_sample_runs_end_to_end(store, monkeypatch):
+    """THE acceptance pin: with a stored measured profile, auto-cache +
+    node-level solver dispatch both run from measurements — zero
+    sample-run executions (any ``Profiler`` entry raises) — and
+    predictions stay bit-identical to the un-optimized arm."""
+    X, Y = _data(n=512, d=128)
+
+    def build():
+        return build_reused_subchain(X, Y, LeastSquaresEstimator(lam=1e-3))
+
+    # Off-arm reference + profile phase, with sampling available.
+    PipelineEnv.reset()
+    ref = np.asarray(build().fit().apply(X).get())
+    _profiled_fit(build())
+    assert len(_entry_paths(store)) >= 1
+
+    # On-arm: store hit, sampling FORBIDDEN, optimizer fully on.
+    monkeypatch.setattr(Profiler, "profile", _boom)
+    monkeypatch.setattr(Profiler, "sample_values", _boom)
+    PipelineEnv.reset()
+    rules.clear_decisions()
+    config.auto_cache = True
+    try:
+        fitted = build().fit()
+    finally:
+        config.auto_cache = False
+    out = np.asarray(fitted.apply(X).get())
+
+    decisions = rules.optimizer_decisions()
+    assert any(d.action == "cache-insert" and d.provenance == "measured"
+               for d in decisions)
+    assert all(d.provenance == "measured" for d in decisions
+               if d.rule == "AutoCacheRule")
+    # The deep-graph estimator's solver dispatch resolved its (n, d)
+    # from MEASURED output shapes, not a sampled prefix run.
+    solver = [d for d in decisions if d.rule == "NodeOptimizationRule"]
+    assert solver and solver[0].provenance == "measured"
+    assert solver[0].action.startswith("solver=")
+    assert out.shape == ref.shape and np.array_equal(out, ref)
+
+
+def test_export_reload_identical_decisions_bit_stable_plan(store):
+    X, Y = _data()
+
+    def build():
+        return build_reused_subchain(X, Y)
+
+    _profiled_fit(build())
+
+    def optimize():
+        PipelineEnv.reset()
+        rules.clear_decisions()
+        config.auto_cache = True
+        try:
+            p = build()
+            g = PipelineEnv.get().optimizer.execute(p.graph, [p.sink])
+        finally:
+            config.auto_cache = False
+        caches = sorted(
+            g.operators[g.dependencies[nid][0]].label()
+            for nid, op in g.operators.items()
+            if isinstance(op, CacheOperator)
+        )
+        return caches, [d.as_dict() for d in rules.optimizer_decisions()]
+
+    caches_a, decisions_a = optimize()
+    assert caches_a  # the heavy prefix earned its cache
+    ps._load_memo.clear()  # force a true reload from disk
+    caches_b, decisions_b = optimize()
+    assert caches_a == caches_b
+    assert decisions_a == decisions_b
+
+
+# ---------------------------------------------------------------------------
+# Store refusal semantics
+# ---------------------------------------------------------------------------
+
+
+def _tamper(path, mutate):
+    doc = json.load(open(path))
+    mutate(doc)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+
+
+def test_fingerprint_mismatch_refused_with_typed_error(store, caplog):
+    X, Y = _data()
+    fitted = _profiled_fit(build_reused_subchain(X, Y))
+    fp = fitted.fit_profile
+    _tamper(fp.saved_to, lambda doc: doc.__setitem__(
+        "fingerprint",
+        {"backend": "tpu", "device_kind": "TPU v4", "device_count": 8},
+    ))
+    ps._load_memo.clear()
+    with pytest.raises(ps.ProfileFingerprintError) as ei:
+        ps.load_profile(fp.pipeline_digest)
+    assert "re-profile" in str(ei.value)
+    # The rules' entry point degrades to no-profile, loudly.
+    with caplog.at_level(logging.WARNING, logger="keystone_tpu"):
+        assert ps.lookup_measured(fp.pipeline_digest) is None
+    assert any("incompatible" in r.message for r in caplog.records)
+
+
+def test_corrupt_entry_skipped_with_warning_not_crash(store, caplog):
+    X, Y = _data()
+    fitted = _profiled_fit(build_reused_subchain(X, Y))
+    path = fitted.fit_profile.saved_to
+    with open(path, "w") as f:
+        f.write("{definitely not json")
+    ps._load_memo.clear()
+    with caplog.at_level(logging.WARNING, logger="keystone_tpu"):
+        assert ps.load_profile(fitted.fit_profile.pipeline_digest) is None
+    assert any("skipping" in r.message for r in caplog.records)
+    # The optimizer pass survives: it falls back to the SAMPLED path.
+    PipelineEnv.reset()
+    rules.clear_decisions()
+    config.auto_cache = True
+    try:
+        build_reused_subchain(X, Y).fit()
+    finally:
+        config.auto_cache = False
+    cache_decisions = [d for d in rules.optimizer_decisions()
+                       if d.rule == "AutoCacheRule"]
+    assert cache_decisions
+    assert all(d.provenance == "sampled" for d in cache_decisions)
+
+
+def test_tampered_payload_skipped(store, caplog):
+    X, Y = _data()
+    fitted = _profiled_fit(build_reused_subchain(X, Y))
+    fp = fitted.fit_profile
+
+    def flip_wall(doc):
+        entry = next(iter(doc["digests"].values()))
+        entry["wall_ns"] = int(entry["wall_ns"]) * 1000  # lie bigger
+
+    _tamper(fp.saved_to, flip_wall)
+    ps._load_memo.clear()
+    with caplog.at_level(logging.WARNING, logger="keystone_tpu"):
+        assert ps.load_profile(fp.pipeline_digest) is None
+    assert any("payload digest mismatch" in r.message
+               for r in caplog.records)
+
+
+def test_unknown_schema_version_skipped(store, caplog):
+    X, Y = _data()
+    fitted = _profiled_fit(build_reused_subchain(X, Y))
+    fp = fitted.fit_profile
+    _tamper(fp.saved_to, lambda doc: doc.__setitem__("version", 99))
+    ps._load_memo.clear()
+    with caplog.at_level(logging.WARNING, logger="keystone_tpu"):
+        assert ps.load_profile(fp.pipeline_digest) is None
+    assert any("schema version" in r.message for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# Lint integration: KG202 goes quiet, KG203 says "you have measurements"
+# ---------------------------------------------------------------------------
+
+
+def test_kg202_quiet_after_optimizer_inserts_cache(store):
+    """The advice loop closes: the canonical re-used subchain lints
+    KG202 un-optimized, and lints CLEAN after the profile-guided
+    optimizer inserts the cache node it was asking for."""
+    from keystone_tpu.workflow.analysis import lint_graph
+
+    X, _ = _data()
+    g, src, out = dataset_rooted_reused_graph(X)
+    assert lint_graph(g, src, out, example=(D,),
+                      have_ladder=True).by_rule("KG202")
+
+    # Profile the walk, then let the optimizer consume the measurements.
+    PipelineEnv.reset()
+    with profile_scope():
+        PipelineEnv.get().optimize_and_execute(g, out)
+    PipelineEnv.reset()
+    config.auto_cache = True
+    try:
+        g_on = PipelineEnv.get().optimizer.execute(g, [out])
+    finally:
+        config.auto_cache = False
+    assert any(isinstance(op, CacheOperator)
+               for op in g_on.operators.values())
+    assert not lint_graph(g_on, src, out, example=(D,),
+                          have_ladder=True).by_rule("KG202")
+
+
+def test_kg203_reports_unconsumed_profile(store):
+    X, Y = _data()
+    p = build_reused_subchain(X, Y)
+    # No store entry yet: silent.
+    assert not p.lint(example=(D,), have_ladder=True).by_rule("KG203")
+    _profiled_fit(p)
+    # Entry exists, optimization is model-only: say so.
+    found = p.lint(example=(D,), have_ladder=True).by_rule("KG203")
+    assert found and found[0].severity == "info"
+    assert "auto_cache" in found[0].message
+    # Optimizer on: the profile WILL be consumed — silent again.
+    config.auto_cache = True
+    try:
+        assert not p.lint(example=(D,),
+                          have_ladder=True).by_rule("KG203")
+    finally:
+        config.auto_cache = False
+    # Store disabled (exported empty): silent, and no digest walk at all.
+    import os as os_mod
+
+    os_mod.environ["KEYSTONE_PROFILE_STORE"] = ""
+    try:
+        assert not p.lint(example=(D,),
+                          have_ladder=True).by_rule("KG203")
+    finally:
+        os_mod.environ["KEYSTONE_PROFILE_STORE"] = store
+
+
+# ---------------------------------------------------------------------------
+# PlanResourcesRule: workers + solver chunk rows
+# ---------------------------------------------------------------------------
+
+
+def test_plan_workers_from_branch_width(store, monkeypatch):
+    import os as os_mod
+
+    X, Y = _data()
+    _profiled_fit(build_reused_subchain(X, Y))
+    monkeypatch.setattr(os_mod, "cpu_count", lambda: 4)
+    PipelineEnv.reset()
+    rules.clear_decisions()
+    p = build_reused_subchain(X, Y)
+    PipelineEnv.get().optimizer.execute(p.graph, [p.sink])
+    plan = PipelineEnv.get().resource_plan
+    # Two independent branches on a "4-core" host: plan 2 workers.
+    assert plan.get("exec_workers") == 2
+    planned = [d for d in rules.optimizer_decisions()
+               if d.rule == "PlanResourcesRule"
+               and d.action.startswith("exec_workers=")]
+    assert planned and planned[0].provenance == "measured"
+    assert planned[0].cost["branch_width"] == 2
+
+
+def test_plan_workers_serial_on_one_core(store, monkeypatch):
+    import os as os_mod
+
+    X, Y = _data()
+    _profiled_fit(build_reused_subchain(X, Y))
+    monkeypatch.setattr(os_mod, "cpu_count", lambda: 1)
+    PipelineEnv.reset()
+    rules.clear_decisions()
+    p = build_reused_subchain(X, Y)
+    PipelineEnv.get().optimizer.execute(p.graph, [p.sink])
+    assert "exec_workers" not in PipelineEnv.get().resource_plan
+    kept = [d for d in rules.optimizer_decisions()
+            if d.action == "exec_workers=0"]
+    assert kept and "serial walk kept" in kept[0].reason
+
+
+def test_plan_chunk_rows_from_measured_bytes_per_row(store, monkeypatch):
+    """Measured bytes-per-row vs a (shrunk) HBM budget turns into a
+    planned chunk size: PR-3's reactive OOM-halving becomes a plan."""
+    import keystone_tpu.utils.metrics as metrics_mod
+
+    X, Y = _data(n=512, d=128)
+    p = build_reused_subchain(X, Y, LeastSquaresEstimator(lam=1e-3))
+    _profiled_fit(p)
+    # Estimator input: 512 rows x 256 features f32 = 1024 B/row. An HBM
+    # of 512 KiB / CHUNK_BUDGET_FRAC=8 budgets 65536 B -> 64-row chunks.
+    monkeypatch.setattr(metrics_mod, "device_hbm_bytes", lambda: 524288)
+    PipelineEnv.reset()
+    rules.clear_decisions()
+    p2 = build_reused_subchain(X, Y, LeastSquaresEstimator(lam=1e-3))
+    PipelineEnv.get().optimizer.execute(p2.graph, [p2.sink])
+    plan = PipelineEnv.get().resource_plan
+    assert plan.get("solve_chunk_rows") == 64
+    planned = [d for d in rules.optimizer_decisions()
+               if d.action.startswith("solve_chunk_rows=")]
+    assert planned and planned[0].provenance == "measured"
+    assert planned[0].cost["bytes_per_row"] == 1024.0
+
+
+def test_plan_cleared_when_next_pipeline_has_no_profile(store):
+    """A plan derived from one profiled pipeline must not leak into an
+    unrelated pipeline's solve in the same session: the rule clears its
+    keys at every pass entry (a planned chunk split regroups the gram
+    accumulation — numerics the other pipeline never opted into)."""
+    X, Y = _data()
+    plan = PipelineEnv.get().resource_plan
+    plan["solve_chunk_rows"] = 99  # stale plan from a profiled pipeline
+    plan["exec_workers"] = 7
+    p = build_reused_subchain(X, Y)  # no store entry for this one
+    PipelineEnv.get().optimizer.execute(p.graph, [p.sink])
+    assert "solve_chunk_rows" not in plan
+    assert "exec_workers" not in plan
+    # Disabling the planner mid-session also retires its last plan: the
+    # clear runs BEFORE the enable gate.
+    plan["solve_chunk_rows"] = 99
+    config.plan_resources = False
+    try:
+        PipelineEnv.get().optimizer.execute(p.graph, [p.sink])
+    finally:
+        config.plan_resources = True
+    assert "solve_chunk_rows" not in plan
+
+
+def test_measured_pricing_has_no_consumer_multiplier(store):
+    """The executor's structural-hash memo runs a multi-consumer node
+    ONCE per walk, so a cache saves one re-execution per later walk —
+    pricing must not multiply the saving by consumer count. A node
+    measured at 6 ms with a 20 MB output (materialize ~10 ms at the
+    assumed 2 GB/s) must be SKIPPED even though 6 ms x 2 consumers
+    would beat materialization."""
+    from keystone_tpu.workflow.graph import structural_digest
+
+    X, _ = _data()
+    g, _src, out = dataset_rooted_reused_graph(X)
+    prefix_nid = next(n for n, op in g.operators.items()
+                      if "HostWork" in op.label())
+    entry = {"label": "HostWork", "calls": 1, "wall_ns": 6_000_000,
+             "out_bytes": 20_000_000, "out_rows": 256,
+             "queue_wait_ns": 0, "out_shape": [256, 64]}
+    ps.save_profile(
+        ps.pipeline_profile_digest(g, out),
+        {structural_digest(g, prefix_nid): entry}, rows=[],
+    )
+    PipelineEnv.reset()
+    rules.clear_decisions()
+    config.auto_cache = True
+    try:
+        g_on = PipelineEnv.get().optimizer.execute(g, [out])
+    finally:
+        config.auto_cache = False
+    assert not any(isinstance(op, CacheOperator)
+                   for op in g_on.operators.values())
+    skip = [d for d in rules.optimizer_decisions()
+            if d.action == "cache-skip" and d.node == "HostWork"]
+    assert skip and "cheaper than materialization" in skip[0].reason
+
+
+def test_env_pin_beats_session_plan(store, monkeypatch):
+    """An explicitly exported 0 pins its setting: the planner never
+    overrides an explicit knob, including the 'off' value."""
+    from keystone_tpu.linalg.normal_equations import planned_chunk_rows
+
+    PipelineEnv.get().resource_plan["solve_chunk_rows"] = 32
+    monkeypatch.setenv("KEYSTONE_SOLVE_CHUNK_ROWS", "0")
+    assert planned_chunk_rows() == 0
+    # The env is read LIVE (resolved_cache_dir convention): a late
+    # export of a nonzero value wins too, not just the 0 pin.
+    monkeypatch.setenv("KEYSTONE_SOLVE_CHUNK_ROWS", "4096")
+    assert planned_chunk_rows() == 4096
+    monkeypatch.delenv("KEYSTONE_SOLVE_CHUNK_ROWS")
+    assert planned_chunk_rows() == 32
+
+
+def test_exec_workers_env_pin_keeps_serial_walk(store, monkeypatch):
+    """KEYSTONE_EXEC_WORKERS=0 exported pins the byte-identical legacy
+    serial loop even when a session plan exists; with the default
+    (unset), the same plan engages the parallel walk. Driven through
+    the executor directly — the plan consumer — since an optimizer pass
+    would (correctly) clear a plan that has no matching profile."""
+    from keystone_tpu.workflow import executor as executor_mod
+
+    def forbidden(*a, **k):
+        raise AssertionError("parallel walk constructed under env pin")
+
+    X, _ = _data(n=64, d=16)
+    g, _src, out = dataset_rooted_reused_graph(X)
+    monkeypatch.setattr(executor_mod, "_ParallelWalk", forbidden)
+    PipelineEnv.reset()
+    env = PipelineEnv.get()
+    env.resource_plan["exec_workers"] = 4
+    monkeypatch.setenv("KEYSTONE_EXEC_WORKERS", "0")
+    env.executor.execute(g, out)  # serial: forbidden never fires
+    monkeypatch.delenv("KEYSTONE_EXEC_WORKERS")
+    env.resource_plan["exec_workers"] = 4
+    with pytest.raises(AssertionError, match="parallel walk constructed"):
+        env.executor.execute(g, out)
+
+
+def test_planned_chunk_rows_resolution_order(store):
+    from keystone_tpu.linalg.normal_equations import planned_chunk_rows
+
+    PipelineEnv.get().resource_plan["solve_chunk_rows"] = 32
+    assert planned_chunk_rows() == 32  # session plan when knob unset
+    config.solve_chunk_rows = 16
+    try:
+        assert planned_chunk_rows() == 16  # explicit knob always wins
+    finally:
+        config.solve_chunk_rows = 0
+
+
+def test_planned_split_replaces_reactive_halving(store):
+    """A chunk over the planned bound splits BEFORE any transfer and the
+    split is counted. Splitting regroups the gram accumulation exactly
+    like feeding the smaller chunks directly — planned 128-row chunks
+    split at 32 are BIT-identical to a native 32-row stream (and agree
+    with the unsplit solve to float tolerance, the same contract as the
+    reactive OOM halving it replaces)."""
+    from keystone_tpu.linalg import solve_least_squares_chunked
+    from keystone_tpu.utils.metrics import reliability_counters
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(256, 16)).astype(np.float32)
+    Y = (X @ rng.normal(size=(16, 2)).astype(np.float32))
+
+    def chunks(rows):
+        for s in range(0, 256, rows):
+            yield X[s:s + rows], Y[s:s + rows]
+
+    unsplit = np.asarray(solve_least_squares_chunked(
+        chunks(128), lam=1e-3, prefetch_depth=0
+    ))
+    native32 = np.asarray(solve_least_squares_chunked(
+        chunks(32), lam=1e-3, prefetch_depth=0
+    ))
+    before = reliability_counters.get("planned_chunk_splits")
+    config.solve_chunk_rows = 32
+    try:
+        planned = np.asarray(solve_least_squares_chunked(
+            chunks(128), lam=1e-3, prefetch_depth=0
+        ))
+    finally:
+        config.solve_chunk_rows = 0
+    assert reliability_counters.get("planned_chunk_splits") - before >= 2
+    assert np.array_equal(native32, planned)
+    assert np.allclose(unsplit, planned, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Solver dispatch dedup (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def test_solver_choice_single_constructor_path():
+    from keystone_tpu.nodes.learning.block_least_squares import (
+        BlockLeastSquaresEstimator,
+    )
+    from keystone_tpu.nodes.learning.local_least_squares import (
+        LocalLeastSquaresEstimator,
+    )
+
+    est = LeastSquaresEstimator(lam=1e-3)
+    assert isinstance(est._concrete(SolverChoice("local", "")),
+                      LocalLeastSquaresEstimator)
+    assert isinstance(est._concrete(SolverChoice("normal", "")),
+                      LinearMapEstimator)
+    assert isinstance(est._concrete(SolverChoice("block", "")),
+                      BlockLeastSquaresEstimator)
+    with pytest.raises(ValueError, match="unknown solver choice"):
+        est._concrete(SolverChoice("bogus", ""))
+
+
+# ---------------------------------------------------------------------------
+# Tools: decision table + bench harness (in-process --quick)
+# ---------------------------------------------------------------------------
+
+
+def _tools(name):
+    import importlib
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        return importlib.import_module(name)
+    finally:
+        sys.path.pop(0)
+
+
+def test_decision_table_renders_provenance_and_cost():
+    profile_report = _tools("profile_report")
+    d = rules.OptimizerDecision(
+        rule="AutoCacheRule", node="HostWork", action="cache-insert",
+        provenance="measured", reason="why",
+        cost={"recompute_s": 0.01, "bytes": 42},
+    )
+    table = profile_report.render_decision_table([d])
+    assert "cache-insert" in table and "measured" in table
+    assert "recompute_s=0.01" in table and "bytes=42" in table
+    assert profile_report.render_decision_table([]).startswith("(no ")
+
+
+def test_decision_log_is_bounded():
+    rules.clear_decisions()
+    for i in range(rules._DECISIONS_CAP + 50):
+        rules.record_decision("R", f"n{i}", "a", "model", "r")
+    log = rules.optimizer_decisions()
+    assert len(log) == rules._DECISIONS_CAP
+    assert log[-1].node == f"n{rules._DECISIONS_CAP + 49}"
+    rules.clear_decisions()
+
+
+def test_bench_optimizer_quick_in_process(store):
+    """`make bench-opt`'s harness at --quick scale: the row is
+    well-formed, bit-identity holds, and the measured store hit ran
+    zero sample executions (the speedup gate is timing and belongs to
+    the bench, not tier-1)."""
+    import argparse
+
+    bench_optimizer = _tools("bench_optimizer")
+    args = argparse.Namespace(
+        reps=1, applies=1, rows=64, dim=32, classes=4, work_iters=4,
+        min_speedup=1.2, quick=True, out=None,
+    )
+    row = bench_optimizer.run_bench(args)
+    row.pop("_decisions")
+    det = row["detail"]
+    assert row["ok"], row
+    assert det["bit_identical"] and det["zero_sample_runs"]
+    assert set(det["pipelines"]) == {"reused_subchain", "two_branch"}
+
+
+def test_profile_report_decisions_demo(store):
+    profile_report = _tools("profile_report")
+    result = profile_report.run_decisions_demo()
+    assert result["ok"], result
+    assert result["pass"]["measured_provenance_present"]
+    assert "cache-insert" in result["table"]

@@ -17,6 +17,18 @@ Modes:
         schema-valid-but-empty profile (a dead profiler must fail
         loudly, not print a clean empty table — the trace_report rule).
 
+    python tools/profile_report.py --decisions
+        The explain-plan surface of the profile-guided optimizer
+        (ISSUE-12): run the canonical re-used-subchain pipeline through
+        the full profile-once-optimize-forever loop in-process — a
+        ``fit(profile=True)`` persists the measured per-node profile to
+        a private store, then a fresh optimization consumes it — and
+        render every recorded ``OptimizerDecision`` (rule, node, chosen
+        action, cost provenance measured/sampled/model, measured-vs-
+        modeled cost numbers, reason). Exit 1 when the decision log
+        stays empty or no decision carries measured provenance (a dead
+        loop must fail loudly, the trace_report rule).
+
     python tools/profile_report.py --demo [--out PROFILE.json]
         The ``make profile-demo`` smoke, also run in-process by tier-1
         (tests/test_profile.py): a small fit + apply of a canonical
@@ -54,6 +66,151 @@ def render(doc: dict, top: int = 0) -> str:
     if top > 0:
         rows = rows[:top]
     return render_attribution_table(rows)
+
+
+def render_decision_table(decisions) -> str:
+    """The optimizer's explain-plan: one row per recorded
+    ``OptimizerDecision`` (workflow/rules.py), column-aligned like the
+    attribution table. ``cost`` renders as compact key=value pairs —
+    the measured-vs-modeled numbers behind the choice."""
+    headers = ("rule", "node", "action", "provenance", "reason / cost")
+    rows = []
+    for d in decisions:
+        cost = " ".join(f"{k}={v}" for k, v in sorted(d.cost.items()))
+        why = d.reason + (f"  [{cost}]" if cost else "")
+        rows.append((d.rule, d.node, d.action, d.provenance, why))
+    if not rows:
+        return "(no optimizer decisions recorded)"
+    widths = [
+        max(len(headers[i]), *(len(r[i]) for r in rows))
+        for i in range(len(headers) - 1)
+    ]
+    lines = [
+        "  ".join(h.ljust(w) for h, w in zip(headers, widths))
+        + "  " + headers[-1],
+        "  ".join("-" * w for w in widths) + "  " + "-" * len(headers[-1]),
+    ]
+    for r in rows:
+        lines.append(
+            "  ".join(c.ljust(w) for c, w in zip(r, widths)) + "  " + r[-1]
+        )
+    return "\n".join(lines)
+
+
+def run_decisions_demo() -> dict:
+    """The ``--decisions`` flow: close the cost-model loop on the
+    canonical re-used-subchain pipeline in-process and render what the
+    optimizer decided from the measurements. Returns the verdict dict.
+    """
+    import tempfile
+
+    import numpy as np
+
+    from keystone_tpu.config import config
+    from keystone_tpu.workflow import rules
+    from keystone_tpu.workflow.executor import PipelineEnv
+    from keystone_tpu.workflow.pipeline import Pipeline, Transformer
+
+    class HostWork(Transformer):
+        """Deterministic host-bound featurizer (fixed iteration count)."""
+
+        jittable = False
+
+        def __init__(self, seed: int, iters: int):
+            self.seed, self.iters = int(seed), int(iters)
+
+        def signature(self):
+            return self.stable_signature(self.seed, self.iters)
+
+        def apply_batch(self, X):
+            Y = np.asarray(X, dtype=np.float32)
+            rng = np.random.default_rng(self.seed)
+            filt = (1.0 + rng.uniform(size=Y.shape[1] // 2 + 1)).astype(
+                np.complex64
+            )
+            for _ in range(self.iters):
+                spec = np.fft.rfft(Y, axis=1) * filt
+                Y = np.tanh(Y + np.fft.irfft(
+                    spec, n=Y.shape[1], axis=1
+                ).astype(np.float32))
+            return Y
+
+    class ScaleBy(Transformer):
+        jittable = True
+
+        def __init__(self, c: float):
+            self.c = float(c)
+
+        def signature(self):
+            return self.stable_signature(self.c)
+
+        def apply_batch(self, X):
+            return X * self.c
+
+    from keystone_tpu.nodes.learning.linear_mapper import LinearMapEstimator
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(256, 64)).astype(np.float32)
+    Y = (X @ rng.normal(size=(64, 4))).astype(np.float32)
+
+    def build():
+        prefix = HostWork(seed=1, iters=12).to_pipeline()
+        branches = [prefix.and_then(ScaleBy(2.0)),
+                    prefix.and_then(ScaleBy(0.5))]
+        return Pipeline.gather(branches).and_then(
+            LinearMapEstimator(lam=1e-3), X, Y
+        )
+
+    store = tempfile.mkdtemp(prefix="keystone_decisions_demo_")
+    # Env-level isolation: the env var wins over config.profile_store,
+    # so only it guarantees the demo never touches a user-exported store.
+    prev_env = os.environ.get("KEYSTONE_PROFILE_STORE")
+    prev_cache = config.auto_cache
+    try:
+        os.environ["KEYSTONE_PROFILE_STORE"] = store
+        # Profile once: the measured rows the next optimization consumes.
+        PipelineEnv.reset()
+        fitted = build().fit(profile=True)
+        saved = getattr(fitted, "fit_profile", None)
+        # Optimize forever (well, once more): fresh session, measured hit.
+        PipelineEnv.reset()
+        config.auto_cache = True
+        rules.clear_decisions()
+        refit = build().fit()
+        # The optimizer plans at FIT time; applies run plain and hit the
+        # session cache through the executor's discovery cut (re-running
+        # whole-pipeline optimization per apply would re-pay sampling).
+        config.auto_cache = False
+        refit.apply(X[:64]).get()
+        decisions = rules.optimizer_decisions()
+    finally:
+        if prev_env is None:
+            os.environ.pop("KEYSTONE_PROFILE_STORE", None)
+        else:
+            os.environ["KEYSTONE_PROFILE_STORE"] = prev_env
+        config.auto_cache = prev_cache
+        PipelineEnv.reset()
+        import shutil
+
+        shutil.rmtree(store, ignore_errors=True)
+
+    result = {
+        "metric": "optimizer_decisions",
+        "decisions": len(decisions),
+        "store_entry_saved": bool(saved is not None and saved.saved_to),
+        "pass": {
+            "decision_log_nonempty": bool(decisions),
+            "measured_provenance_present": any(
+                d.provenance == "measured" for d in decisions
+            ),
+            "cache_decision_present": any(
+                d.action.startswith("cache-") for d in decisions
+            ),
+        },
+    }
+    result["ok"] = all(result["pass"].values())
+    result["table"] = render_decision_table(decisions)
+    return result
 
 
 def run_demo(out_path: str | None = None) -> dict:
@@ -204,9 +361,23 @@ def main(argv=None) -> int:
                     help="only the N heaviest-wall rows")
     ap.add_argument("--demo", action="store_true",
                     help="run the gated profile-demo instead of rendering")
+    ap.add_argument("--decisions", action="store_true",
+                    help="close the cost-model loop on the canonical "
+                         "re-used-subchain pipeline and print the "
+                         "optimizer's decision table (explain-plan)")
     ap.add_argument("--out", default=None,
                     help="demo: also export the profile JSON here")
     args = ap.parse_args(argv)
+
+    if args.decisions:
+        result = run_decisions_demo()
+        table = result.pop("table")
+        print(json.dumps(result))
+        print("\n" + table, file=sys.stderr)
+        if not result["ok"]:
+            failed = [k for k, v in result["pass"].items() if not v]
+            print(f"decisions: FAIL ({', '.join(failed)})", file=sys.stderr)
+        return 0 if result["ok"] else 1
 
     if args.demo:
         result = run_demo(args.out)
