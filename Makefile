@@ -1,6 +1,6 @@
 # Developer entry points. Pipelines launch via bin/run-pipeline.sh.
 
-.PHONY: test t1 chaos native bench bench-serve bench-serve-overload bench-serve-replicas bench-serve-daemon bench-fit bench-opt trace-demo obs-serve serve-daemon profile-demo bench-watch lint dryrun clean tpu-checkride sentinel northstar acceptance
+.PHONY: test t1 chaos native bench bench-serve bench-serve-overload bench-serve-replicas bench-serve-daemon bench-fit bench-opt bench-multichip trace-demo obs-serve serve-daemon profile-demo bench-watch lint dryrun clean tpu-checkride sentinel northstar acceptance
 
 # The canonical tier-1 verify (ROADMAP.md), verbatim at the defaults —
 # builders and CI invoke this one entry point instead of hand-copying the
@@ -144,6 +144,21 @@ bench-fit:
 # surface standalone).
 bench-opt:
 	JAX_PLATFORMS=cpu python tools/bench_optimizer.py --out BENCH_fit.json
+
+# Mesh-native data-parallel fit bench: the canonical two-branch jittable
+# featurize -> solve pipeline fitted in a 1-device and an N-fake-device
+# subprocess (XLA_FLAGS=--xla_force_host_platform_device_count, the
+# test_multihost precedent), each A/Bing the explicitly-specced sharded
+# walk against the single-device walk. Gates: sharded predictions
+# bit-identical to the single-device walk (hard, always, both widths),
+# zero silent single-device fallbacks (registry-counter-verified; the
+# bench's held-out batch is deliberately non-divisible so the mask-pad
+# path is always exercised), rows/s scaling hard only on real multi-chip
+# hardware (fake CPU devices time-slice the host — the PR-5/PR-9
+# precedent). APPENDS the fingerprinted fit_multichip row to the
+# BENCH_fit.json history `make bench-watch` regresses against.
+bench-multichip:
+	JAX_PLATFORMS=cpu python tools/bench_multichip.py --out BENCH_fit.json
 
 # Bench regression sentinel: parse every BENCH_*/MULTICHIP_*/BENCH_serve/
 # BENCH_fit history row, fit per-metric noise bands from

@@ -133,12 +133,26 @@ class Config:
     # queried. v5e = 16 GiB; leave headroom for XLA scratch.
     hbm_budget_bytes: int = 12 * (1 << 30)
     # Row-shard array batches over the mesh when they enter the graph (the
-    # RDD-partitioning analog): featurization chains then run data-parallel
-    # across chips via sharding propagation, not just the solvers. Batches
-    # whose row count doesn't divide the mesh stay single-device.
-    shard_data_batches: bool = True
-    # Minimum rows before sharding is worth the placement overhead.
-    shard_min_rows: int = 64
+    # RDD-partitioning analog): divisible batches are placed with the
+    # explicit data sharding, and fused jittable chains lower ONCE with
+    # the SpecLayout convention's in_shardings/out_shardings
+    # (utils/mesh.py) — not just the solvers. Batches whose row count
+    # doesn't divide the mesh are mask-padded onto it by the chain call
+    # and trimmed (bit-identical, counted in the "sharding" registry);
+    # only sub-shard_min_rows batches fall back to single-device, and
+    # that fallback is counted too. KEYSTONE_SHARD_DATA=0 pins the
+    # single-device walk (the bench's A/B control and the escape hatch).
+    shard_data_batches: bool = field(
+        default_factory=lambda: os.environ.get(
+            "KEYSTONE_SHARD_DATA", ""
+        ).lower() not in ("0", "false", "no")
+    )
+    # Minimum rows before sharding is worth the placement overhead — the
+    # ONLY batch class still allowed to run single-device (visible via
+    # sharding.fallback_small_batch). Env: KEYSTONE_SHARD_MIN_ROWS.
+    shard_min_rows: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_SHARD_MIN_ROWS", 64)
+    )
     # Feature blocks whose gram ridge inverses are factorized together in
     # ONE batched XLA program (batched Cholesky + triangular solves over a
     # leading block axis). TPU lowers a single b×b factorization to a

@@ -638,7 +638,12 @@ def _make_fingerprint(
     (first and last real row), so the device-resident and host-streamed
     paths produce identical fingerprints and can resume each other. The
     storage dtype is part of the identity — an f32 solve must not resume a
-    bf16 one (mixed-precision epochs with no warning)."""
+    bf16 one (mixed-precision epochs with no warning). ``device_count`` /
+    ``data_axis`` are the per-shard manifest: same problem on a different
+    mesh width is REFUSED at restore (``MeshMismatchError``), never
+    resumed into differently-folded accumulators."""
+    from keystone_tpu.utils.mesh import num_data_shards
+
     return {
         "rows": B.padded_rows,
         "n": B.n,
@@ -650,6 +655,8 @@ def _make_fingerprint(
         "a_dtype": str(jnp.dtype(a_dtype)),
         "a_probe": a_probe,
         "b_probe": float(jnp.sum(B.data[0]) + jnp.sum(B.data[B.n - 1])),
+        "device_count": int(num_data_shards(B.mesh)),
+        "data_axis": str(config.data_axis),
     }
 
 
@@ -754,8 +761,15 @@ def _restore_latest(ckpt_dir: str, fingerprint):
     tree = ocp.PyTreeCheckpointer().restore(
         os.path.join(ckpt_dir, f"epoch_{latest}")
     )
-    saved_fp = tree.get("fingerprint")
+    from keystone_tpu.utils.mesh import mesh_fp_compat
+
+    # Pre-manifest snapshots (no device_count/data_axis keys) compare
+    # with the absent keys backfilled as wildcards, so a legacy epoch
+    # checkpoint of the SAME problem still resumes after the manifest
+    # upgrade instead of silently restarting at epoch 0.
+    saved_fp = mesh_fp_compat(tree.get("fingerprint"), fingerprint)
     if saved_fp is None or not _fingerprint_matches(saved_fp, fingerprint):
+        _refuse_bcd_mesh_mismatch(saved_fp, fingerprint, ckpt_dir)
         logging.getLogger("keystone_tpu").warning(
             "checkpoint dir %s holds a different solve (fingerprint "
             "mismatch); starting fresh",
@@ -763,6 +777,21 @@ def _restore_latest(ckpt_dir: str, fingerprint):
         )
         return None
     return int(tree["epoch"]), tree["W"], tree["R"]
+
+
+def _refuse_bcd_mesh_mismatch(saved_fp, expected_fp, ckpt_dir) -> None:
+    """The shared mesh-width refusal (``utils.mesh.refuse_mesh_mismatch``)
+    with the BCD-specific exclusions: padded ``rows`` follow the mesh (the
+    shard multiple changes them for the same logical solve), and problem
+    identity uses the solver's tolerant float matching. Resuming W/R
+    folded under one shard layout into another is a wrong-answer resume;
+    other mismatches stay on the warn-and-start-fresh path."""
+    from keystone_tpu.utils.mesh import refuse_mesh_mismatch
+
+    refuse_mesh_mismatch(
+        saved_fp, expected_fp, f"BCD checkpoint {ckpt_dir}",
+        extra_mesh_keys=("rows",), same_problem=_fingerprint_matches,
+    )
 
 
 def assemble_blocks(W: List[jax.Array]) -> jax.Array:

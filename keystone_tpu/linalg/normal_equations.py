@@ -227,10 +227,14 @@ def _stream_ckpt_store(checkpoint_dir: str):
 
 
 def _stream_fingerprint(first_chunk) -> dict:
-    """Solve identity for checkpoint binding: shapes, dtypes, and a probe
-    of the stream's first record — enough to refuse resuming a different
-    problem into these accumulators."""
+    """Solve identity for checkpoint binding: shapes, dtypes, a probe of
+    the stream's first record — enough to refuse resuming a different
+    problem into these accumulators — plus the per-shard manifest (mesh
+    width and data axis), so a snapshot folded under one mesh can never
+    continue under another."""
     import numpy as np
+
+    from keystone_tpu.utils.mesh import num_data_shards
 
     X, Y = first_chunk
     X = np.asarray(X)
@@ -241,7 +245,11 @@ def _stream_fingerprint(first_chunk) -> dict:
         "storage_dtype": str(jnp.dtype(storage_dtype())),
         "chunk_rows": int(X.shape[0]),
         "x0_probe": float(np.asarray(X[0], dtype=np.float64).sum()),
+        "device_count": int(num_data_shards()),
+        "data_axis": str(config.data_axis),
     }
+
+
 
 
 class _StreamCheckpointer:
@@ -279,11 +287,24 @@ class _StreamCheckpointer:
 
         from keystone_tpu.utils.metrics import reliability_counters
 
+        from keystone_tpu.utils.mesh import (
+            mesh_fp_compat,
+            refuse_mesh_mismatch,
+        )
+
         self.fingerprint = _stream_fingerprint(first_chunk)
         state = self.store.get(_STREAM_CKPT_KEY)
         if state is None:
             return
-        if state.get("fingerprint") != self.fingerprint:
+        # Pre-manifest snapshots (no device_count/data_axis keys) compare
+        # with the absent keys backfilled as wildcards, so a legacy
+        # checkpoint of the SAME problem still resumes after the manifest
+        # upgrade instead of silently recomputing hours of accumulation.
+        saved_fp = mesh_fp_compat(state.get("fingerprint"), self.fingerprint)
+        if saved_fp != self.fingerprint:
+            # Same problem on a different mesh width is REFUSED (typed),
+            # never a wrong-answer resume and never a silent restart.
+            refuse_mesh_mismatch(saved_fp, self.fingerprint, "stream solve")
             logging.getLogger("keystone_tpu").warning(
                 "stream-solve checkpoint holds a different solve "
                 "(fingerprint mismatch); starting fresh"

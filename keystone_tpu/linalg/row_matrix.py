@@ -100,6 +100,26 @@ def _gram_and_atb_fn(mesh: Mesh, axis: str, precision):
 
 
 @lru_cache(maxsize=None)
+def _col_sum_fn(mesh: Mesh, axis: str):
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P())
+    def col_sum(a):
+        return lax.psum(jnp.sum(a, axis=0), axis)
+
+    return col_sum
+
+
+@lru_cache(maxsize=None)
+def _weighted_col_sum_fn(mesh: Mesh, axis: str):
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P())
+    def weighted_col_sum(w, a):
+        return lax.psum(jnp.sum(w * a, axis=0), axis)
+
+    return weighted_col_sum
+
+
+@lru_cache(maxsize=None)
 def _matmul_fn(mesh: Mesh, axis: str, precision):
     @jax.jit
     @partial(shard_map, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(axis))
@@ -178,6 +198,38 @@ class RowMatrix:
         return _gram_and_atb_fn(self.mesh, config.data_axis, _precision())(
             self.data, other.data
         )
+
+    def col_sums(self) -> jax.Array:
+        """Column sums over the LOGICAL rows, replicated: per-shard sum +
+        psum over ICI. Zero pad rows are inert, so this equals the
+        unpadded sum — and because every construction path re-shards onto
+        the same mesh, the result is bit-identical no matter what
+        placement the source array arrived with (the property that keeps
+        intercept means — and thus whole fits — placement-invariant)."""
+        return _col_sum_fn(self.mesh, config.data_axis)(self.data)
+
+    def weighted_col_sums(self, weights: "RowMatrix") -> jax.Array:
+        """Σ_i w_i · row_i for a row-aligned (n, 1) weight column — the
+        weighted-centering reduction, psum'd like ``col_sums``."""
+        self._check_aligned(weights)
+        return _weighted_col_sum_fn(self.mesh, config.data_axis)(
+            weights.data, self.data
+        )
+
+    def centered(self, means: jax.Array, dtype=None) -> "RowMatrix":
+        """``self - means`` over the LOGICAL rows, pad rows kept ZERO (a
+        plain subtraction would turn them into ``-means`` and poison the
+        gram-inertness contract), optionally cast to the solver storage
+        dtype. Derived on-device from the already-sharded data, so
+        intercept centering costs ZERO additional host-to-device
+        transfers of the big operand — the subtraction/mask/cast are
+        elementwise and placement-inert, keeping centered fits
+        bit-identical across arrival placements."""
+        mask = (jnp.arange(self.padded_rows) < self.n)[:, None]
+        data = jnp.where(mask, self.data - means, 0)
+        if dtype is not None:
+            data = data.astype(dtype)
+        return RowMatrix(data, self.n, self.mesh)
 
     def matmul(self, w: jax.Array) -> "RowMatrix":
         """A @ W for replicated W; result stays row-sharded."""

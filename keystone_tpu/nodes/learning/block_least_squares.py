@@ -157,24 +157,31 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             X_host = np.asarray(data, dtype=config.default_dtype)
             Y = jnp.asarray(labels)
             weights = self._weights(Y)
+            # Labels are placed ONCE (they ride the solve as B anyway);
+            # centering derives on-device from that copy
+            # (RowMatrix.centered) — no second label transfer.
+            Ay = RowMatrix.from_array(Y)
             x_mean = y_mean = None
             if self.fit_intercept:
                 # Same math and guard as the device path below (weighted
-                # means with a wsum floor), computed host-side.
+                # means with a wsum floor), computed host-side for X
+                # (host-resident by contract); label means ride the
+                # psum'd re-shard path so the streamed fit is invariant
+                # to the LABELS' arrival placement.
                 if weights is None:
                     x_mean = X_host.mean(axis=0, dtype=X_host.dtype)
-                    y_mean = Y.mean(axis=0)
+                    y_mean = Ay.col_sums() / Ay.n
                 else:
                     w_np = np.asarray(weights, dtype=X_host.dtype)
                     wsum = max(float(w_np.sum()), 1e-12)
                     # matvec, not (w[:,None] * X).sum(0): no X-sized temporary
                     # on the path that exists because X barely fits in RAM.
                     x_mean = (w_np @ X_host) / wsum
-                    y_mean = (weights[:, None] * Y).sum(0) / jnp.maximum(
-                        weights.sum(), 1e-12
+                    Aw = RowMatrix.from_array(weights[:, None])
+                    y_mean = Ay.weighted_col_sums(Aw) / jnp.maximum(
+                        Aw.col_sums()[0], 1e-12
                     )
-                Y = Y - y_mean
-            B = RowMatrix.from_array(Y)
+            B = Ay if y_mean is None else Ay.centered(y_mean)
             W_blocks, blocks = block_coordinate_descent_streamed(
                 X_host,
                 B,
@@ -196,22 +203,38 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         X = jnp.asarray(data)
         Y = jnp.asarray(labels)
         weights = self._weights(Y)
+        from keystone_tpu.linalg.row_matrix import storage_dtype
+
+        full = jnp.dtype(config.default_dtype)
+        x_mean = y_mean = None
         if self.fit_intercept:
             # Weighted problems need weighted centering: the intercept of
             # weighted ridge absorbs the weighted means, b = ȳ_w − x̄_wᵀW.
+            # The means ride the same re-shard + per-shard-sum + psum path
+            # as the grams (RowMatrix.col_sums), so a fit over a sharded
+            # batch is bit-identical to one over the same bytes on a
+            # single device — no host-side fold, and no dependence on
+            # whatever placement the features arrived with. Centering
+            # derives on-device from the ONE placed copy
+            # (RowMatrix.centered: subtract, re-zero pad rows, cast) —
+            # no second host-to-device transfer of X.
+            Ax = RowMatrix.from_array(X, dtype=X.dtype)
+            Ay = RowMatrix.from_array(Y, dtype=Y.dtype)
             if weights is None:
-                x_mean = X.mean(axis=0)
-                y_mean = Y.mean(axis=0)
+                x_mean = Ax.col_sums() / Ax.n
+                y_mean = Ay.col_sums() / Ay.n
             else:
-                wsum = jnp.maximum(weights.sum(), 1e-12)
-                x_mean = (weights[:, None] * X).sum(axis=0) / wsum
-                y_mean = (weights[:, None] * Y).sum(axis=0) / wsum
-            X = X - x_mean
-            Y = Y - y_mean
-        from keystone_tpu.linalg.row_matrix import storage_dtype
-
-        A = RowMatrix.from_array(X, dtype=storage_dtype())
-        B = RowMatrix.from_array(Y)
+                Aw = RowMatrix.from_array(
+                    weights[:, None], dtype=weights.dtype
+                )
+                wsum = jnp.maximum(Aw.col_sums()[0], 1e-12)
+                x_mean = Ax.weighted_col_sums(Aw) / wsum
+                y_mean = Ay.weighted_col_sums(Aw) / wsum
+            A = Ax.centered(x_mean, dtype=storage_dtype())
+            B = Ay.centered(y_mean, dtype=full)
+        else:
+            A = RowMatrix.from_array(X, dtype=storage_dtype())
+            B = RowMatrix.from_array(Y)
         W_blocks, blocks = block_coordinate_descent(
             A,
             B,

@@ -56,17 +56,33 @@ class LinearMapEstimator(LabelEstimator):
 
         X = jnp.asarray(data)
         Y = jnp.asarray(labels)
-        x_mean = X.mean(axis=0)
-        y_mean = Y.mean(axis=0)
+        # Placement-invariant centering: the means ride the same re-shard
+        # + per-shard-sum + psum path as the grams (RowMatrix.col_sums),
+        # so the fit is bit-identical whether the features arrived
+        # sharded, replicated, or on one device — the data-parallel walk
+        # can never perturb a solve. Centering derives on-device from the
+        # ONE placed copy (RowMatrix.centered: subtract, re-zero pad
+        # rows, cast) — no second host-to-device transfer of X.
+        Ax = RowMatrix.from_array(X, dtype=X.dtype)
+        Ay = RowMatrix.from_array(Y, dtype=Y.dtype)
+        x_mean = Ax.col_sums() / Ax.n
+        y_mean = Ay.col_sums() / Ay.n
+        from keystone_tpu.config import config
+
+        full = jnp.dtype(config.default_dtype)
         if self.method == "tsqr":
             # QR is storage-dtype-sensitive; TSQR keeps full width.
-            A = RowMatrix.from_array(X - x_mean)
-            B = RowMatrix.from_array(Y - y_mean)
-            W = solve_least_squares_tsqr(A, B, self.lam)
+            W = solve_least_squares_tsqr(
+                Ax.centered(x_mean, dtype=full),
+                Ay.centered(y_mean, dtype=full),
+                self.lam,
+            )
         else:
             # Normal equations: A may store bf16 (gram accumulates f32).
-            A = RowMatrix.from_array(X - x_mean, dtype=storage_dtype())
-            B = RowMatrix.from_array(Y - y_mean)
-            W = solve_least_squares_normal(A, B, self.lam)
+            W = solve_least_squares_normal(
+                Ax.centered(x_mean, dtype=storage_dtype()),
+                Ay.centered(y_mean, dtype=full),
+                self.lam,
+            )
         b = y_mean - x_mean @ W
         return LinearMapper(W, b)

@@ -1,4 +1,19 @@
 from keystone_tpu.utils.stats import about_eq
-from keystone_tpu.utils.mesh import default_mesh, data_sharding, replicated_sharding
+from keystone_tpu.utils.mesh import (
+    MeshMismatchError,
+    SpecLayout,
+    data_sharding,
+    default_mesh,
+    replicated_sharding,
+    reset_default_mesh,
+)
 
-__all__ = ["about_eq", "default_mesh", "data_sharding", "replicated_sharding"]
+__all__ = [
+    "about_eq",
+    "default_mesh",
+    "data_sharding",
+    "replicated_sharding",
+    "reset_default_mesh",
+    "MeshMismatchError",
+    "SpecLayout",
+]

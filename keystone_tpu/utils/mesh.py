@@ -1,9 +1,18 @@
-"""Device-mesh helpers.
+"""Device-mesh helpers and the data-parallel sharding convention.
 
 The Spark execution substrate of the reference (RDD partitions over executors,
 Ref: workflow over org.apache.spark.rdd.RDD [unverified]) maps here to a
 ``jax.sharding.Mesh`` over TPU chips: the ``data`` axis plays the role of RDD
 row partitioning, and collectives over ICI replace ``treeAggregate``/shuffle.
+
+``SpecLayout`` is the one sharding convention the workflow layer threads
+through fused featurize chains (arXiv:2112.09017's spec-threading for
+gram-accumulation-as-all-reduce designs): activations row-sharded on
+``config.data_axis``, params and small outputs replicated. A fused chain
+lowers ONCE under ``jax.jit`` with these explicit ``in_shardings`` /
+``out_shardings`` instead of inheriting whatever placement its input
+happened to carry — input placement can no longer silently degrade a
+chain to single-device.
 
 Everything in keystone_tpu is written to be mesh-shape agnostic: the same code
 runs on 1 chip, on N fake CPU devices (tests), and on a pod slice.
@@ -11,6 +20,7 @@ runs on 1 chip, on N fake CPU devices (tests), and on a pod slice.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Optional, Sequence
 
 import jax
@@ -20,6 +30,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from keystone_tpu.config import config
 
 _default_mesh: Optional[Mesh] = None
+
+
+class MeshMismatchError(RuntimeError):
+    """Persisted solver/checkpoint state was recorded under a different
+    mesh width (device count / data axis) than the one resuming it.
+
+    Raised — never silently resumed and never silently restarted — by the
+    streaming solvers' checkpoint binding: per-shard state folded under
+    one mesh must not continue under another, because the operator would
+    read a 'resumed' solve whose provenance (and any per-shard manifest)
+    lies about the mesh it ran on. Re-run on the recording mesh width, or
+    delete the checkpoint to start fresh deliberately."""
 
 
 def default_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
@@ -43,6 +65,15 @@ def default_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
 def set_default_mesh(mesh: Mesh) -> None:
     global _default_mesh
     _default_mesh = mesh
+
+
+def reset_default_mesh() -> None:
+    """Drop the memoized default mesh (the ``reset_memory_probe``
+    convention): tests that fake device counts or install one-off meshes
+    via ``set_default_mesh`` call this so a memoized narrow mesh can never
+    leak into a later test expecting the full device set."""
+    global _default_mesh
+    _default_mesh = None
 
 
 def data_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
@@ -78,3 +109,207 @@ def pad_rows(x: np.ndarray | jax.Array, multiple: int):
     import jax.numpy as jnp
 
     return jnp.pad(x, pad_widths), n
+
+
+@dataclass(frozen=True)
+class SpecLayout:
+    """THE data-parallel sharding convention for fused featurize chains:
+    row-sharded activations on ``axis`` (``config.data_axis``), replicated
+    params/outputs — the SpecLayout-style spec threading of SNIPPETS [2].
+
+    Hashable (frozen, Mesh is hashable), so transformers key their
+    sharded-jit caches on the layout itself: one compiled executable per
+    (chain, mesh) pair, lowered once with explicit shardings.
+    """
+
+    mesh: Mesh
+    axis: str
+
+    @classmethod
+    def for_mesh(cls, mesh: Optional[Mesh] = None) -> "SpecLayout":
+        return cls(mesh or default_mesh(), config.data_axis)
+
+    @property
+    def num_shards(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    def data(self) -> NamedSharding:
+        """Row-sharded: batches/activations flowing through the chain."""
+        return NamedSharding(self.mesh, P(self.axis))
+
+    def replicated(self) -> NamedSharding:
+        """Replicated: fitted params, grams, solved weights."""
+        return NamedSharding(self.mesh, P())
+
+    def jit(self, fn, **jit_kwargs):
+        """Lower ``fn`` (batch -> batch, row-independent) ONCE with the
+        convention's explicit shardings: rows sharded in, rows sharded
+        out. The explicit specs — not input inheritance — are what make
+        the chain's placement a contract instead of an accident."""
+        return jax.jit(
+            fn, in_shardings=self.data(), out_shardings=self.data(),
+            **jit_kwargs,
+        )
+
+    def put(self, x) -> jax.Array:
+        """Row-shard a (divisible) batch over the mesh."""
+        return jax.device_put(x, self.data())
+
+    def pad_put(self, x):
+        """Mask-pad a batch's rows to the shard multiple and shard it;
+        returns (sharded_padded, n_real). Pad rows are zeros — inert for
+        the row-independent chains this layout lowers, and trimmed back to
+        ``n_real`` by the caller after the chain runs."""
+        padded, n = pad_rows(x, self.num_shards)
+        return self.put(padded), n
+
+
+def layout_of_array(x) -> Optional[SpecLayout]:
+    """The SpecLayout an array already carries: a ``jax.Array`` whose
+    sharding is a NamedSharding row-partitioned on a >1-shard data axis
+    (the placement ``DatasetOperator`` gives divisible batches). None for
+    host arrays, replicated/single-device arrays, and foreign layouts."""
+    if not isinstance(x, jax.Array):
+        return None
+    sharding = getattr(x, "sharding", None)
+    if not isinstance(sharding, NamedSharding):
+        return None
+    mesh = sharding.mesh
+    axis = config.data_axis
+    if axis not in mesh.axis_names or mesh.shape[axis] <= 1:
+        return None
+    spec = sharding.spec
+    if not spec or spec[0] != axis:
+        return None
+    return SpecLayout(mesh, axis)
+
+
+def host_batch_shard_class(data, shards: Optional[int] = None) -> str:
+    """THE shardability classifier for a host batch entering the graph —
+    one definition shared by the runtime placement (DatasetOperator), the
+    fused-chain lowering decision (``batch_layout``), and the static lint
+    (KG103), so the three can never drift apart:
+
+    - ``"inert"`` — not a numeric host array, or a 1-share mesh: nothing
+      to decide;
+    - ``"small"`` — below ``config.shard_min_rows``: the single-device
+      fallback class (counted, never silent);
+    - ``"pad"`` — rows never divide the mesh: the mask-pad class;
+    - ``"shard"`` — rows divide the mesh: direct row-sharded placement.
+    """
+    if (
+        not isinstance(data, np.ndarray)
+        or data.ndim < 1
+        or data.dtype.kind not in "biufc"
+    ):
+        return "inert"
+    if shards is None:
+        try:
+            shards = num_data_shards()
+        except RuntimeError:  # deviceless backend: no mesh to shard over
+            return "inert"
+    if shards <= 1:
+        return "inert"
+    if data.shape[0] < config.shard_min_rows:
+        return "small"
+    return "pad" if data.shape[0] % shards else "shard"
+
+
+#: Fingerprint keys that name the MESH a solve ran on, not the problem.
+MESH_FP_KEYS = ("device_count", "data_axis")
+
+
+def refuse_mesh_mismatch(
+    saved_fp,
+    expected_fp,
+    where: str,
+    extra_mesh_keys: tuple = (),
+    same_problem=None,
+) -> None:
+    """Raise the typed ``MeshMismatchError`` when a persisted fingerprint
+    names the SAME problem as ``expected_fp`` under a DIFFERENT mesh —
+    the one refusal rule shared by every checkpointing solver, so the
+    contract can never fork per solver.
+
+    ``extra_mesh_keys`` names additional keys that legitimately follow
+    the mesh (e.g. padded row counts); ``same_problem`` overrides the
+    problem-identity comparison (default: dict equality) for solvers with
+    tolerant float matching. Pre-manifest fingerprints (mesh keys absent
+    or None) never refuse — they have no mesh claim to contradict — and
+    any OTHER disagreement is the caller's warn-and-start-fresh path.
+    """
+    if not isinstance(saved_fp, dict):
+        return
+    saved_mesh = {k: saved_fp.get(k) for k in MESH_FP_KEYS}
+    if None in saved_mesh.values():
+        return
+    expected_mesh = {k: expected_fp.get(k) for k in MESH_FP_KEYS}
+    if saved_mesh == expected_mesh:
+        return
+    excluded = set(MESH_FP_KEYS) | set(extra_mesh_keys)
+    if same_problem is None:
+        same_problem = lambda a, b: a == b  # noqa: E731
+    if same_problem(
+        {k: v for k, v in saved_fp.items() if k not in excluded},
+        {k: v for k, v in expected_fp.items() if k not in excluded},
+    ):
+        raise MeshMismatchError(
+            f"{where}: checkpoint was written under mesh {saved_mesh}, "
+            f"but this solve runs under {expected_mesh}; resuming solver "
+            "state across a mesh-width change is refused. Re-run on the "
+            "recording mesh width, or delete the checkpoint to start "
+            "fresh."
+        )
+
+
+def mesh_fp_compat(saved_fp, expected_fp):
+    """Backfill ABSENT mesh-manifest keys in a pre-manifest fingerprint
+    from the expected one (wildcards), so a legacy checkpoint of the same
+    problem on the same mesh still RESUMES after the manifest upgrade
+    instead of silently restarting. Keys that are present always keep
+    their saved values — a real mismatch still mismatches."""
+    if not isinstance(saved_fp, dict):
+        return saved_fp
+    out = dict(saved_fp)
+    for k in MESH_FP_KEYS:
+        if k not in out and k in expected_fp:
+            out[k] = expected_fp[k]
+    return out
+
+
+def value_data_shards(value) -> Optional[int]:
+    """How many data shards a node output spans: the layout's width for
+    row-sharded device arrays, 1 for any other placed ``jax.Array``
+    (replicated/single-device), None for host values — the profile row's
+    mesh-width provenance, a dict read, never a device sync."""
+    layout = layout_of_array(value)
+    if layout is not None:
+        return layout.num_shards
+    return 1 if isinstance(value, jax.Array) else None
+
+
+def batch_layout(x) -> Optional[SpecLayout]:
+    """The layout a fused chain should lower with for input ``x``, or None
+    for the plain (propagation) path.
+
+    - An already row-sharded device array (the DatasetOperator placement)
+      returns its own layout: the chain re-lowers with those explicit
+      specs instead of trusting propagation.
+    - A host numeric batch whose rows do NOT divide the default mesh —
+      the silent single-device cliff of old — returns the default layout
+      when padding is worth it (>= ``config.shard_min_rows`` rows): the
+      chain call mask-pads, runs sharded, and trims.
+    - Everything else (sub-minimum batches, non-numeric data, 1-share
+      meshes) returns None.
+    """
+    layout = layout_of_array(x)
+    if layout is not None:
+        return layout
+    if isinstance(x, jax.Array):  # placed already (replicated/one device)
+        return None
+    if host_batch_shard_class(x) != "pad":
+        # Divisible host batches are placed by DatasetOperator (a direct
+        # batch_call on one keeps today's propagation path); small /
+        # non-numeric batches have nothing to pad.
+        return None
+    return SpecLayout.for_mesh()

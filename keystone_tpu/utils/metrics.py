@@ -1263,6 +1263,7 @@ class ResourceProfile:
         digest: Optional[str] = None,
         out_rows: Optional[int] = None,
         out_shape: Optional[list] = None,
+        data_shards: Optional[int] = None,
     ) -> None:
         """Fold one node execution into the label's aggregate row.
 
@@ -1281,7 +1282,7 @@ class ResourceProfile:
                     "flops": 0.0, "bytes_accessed": 0.0, "output_bytes": 0,
                     "hbm_delta_bytes": 0, "cost_modeled": 0,
                     "hbm_known": False, "queue_wait_ns": 0,
-                    "workers": set(),
+                    "workers": set(), "data_shards": None,
                     "cache": {"hit": 0, "memo": 0, "miss": 0},
                 }
             agg["calls"] += 1
@@ -1302,6 +1303,11 @@ class ResourceProfile:
                 agg["queue_wait_ns"] += int(queue_wait_ns)
             if worker is not None:
                 agg["workers"].add(str(worker))
+            if data_shards is not None:
+                # Last-write (like out_shape): how many data shards the
+                # node's output spanned — the profile row's mesh-width
+                # provenance, so a 1-shard row is visibly 1-shard.
+                agg["data_shards"] = int(data_shards)
             agg["cache"][cache] = agg["cache"].get(cache, 0) + 1
             # Digest aggregation covers EXECUTED nodes only (cache
             # hits/memos carry no digest): the stored profile must
@@ -1313,7 +1319,7 @@ class ResourceProfile:
                     dagg = self._digests[digest] = {
                         "label": label, "calls": 0, "wall_ns": 0,
                         "out_bytes": 0, "out_rows": 0, "queue_wait_ns": 0,
-                        "out_shape": None,
+                        "out_shape": None, "data_shards": None,
                     }
                 dagg["calls"] += 1
                 dagg["wall_ns"] += int(wall_ns)
@@ -1325,6 +1331,8 @@ class ResourceProfile:
                     dagg["out_rows"] = int(out_rows)
                 if out_shape is not None:
                     dagg["out_shape"] = list(out_shape)
+                if data_shards is not None:
+                    dagg["data_shards"] = int(data_shards)
 
     #: Numeric aggregate fields a ``mark()`` delta subtracts.
     _DELTA_FIELDS = ("calls", "wall_ns", "dispatch_ns", "flops",
@@ -1443,6 +1451,9 @@ class ResourceProfile:
                     if agg["queue_wait_ns"] else None
                 ),
                 "workers": sorted(agg["workers"]) or None,
+                # Mesh-width provenance: how many data shards the node's
+                # output spanned (None where never observed/arrayless).
+                "data_shards": agg.get("data_shards"),
                 "provenance": (
                     "cost-model" if agg["cost_modeled"] else "measured"
                 ),
@@ -1715,3 +1726,32 @@ class ReliabilityCounters(CounterSet):
 
 reliability_counters = ReliabilityCounters()
 metrics_registry.register("reliability", reliability_counters)
+
+
+class ShardingCounters(CounterSet):
+    """Process-wide data-parallel placement observability: every batch
+    entering the graph (and every fused-chain lowering decision) lands
+    here, so 'the fit ran data-parallel' is a counter assertion instead
+    of a hope — the registry-verified 'no silent single-device cliff'
+    gate of the multichip bench. Thread-safe (CounterSet).
+
+    Well-known keys:
+
+    - ``batches_sharded`` — divisible host batches row-sharded over the
+      mesh at graph entry (DatasetOperator)
+    - ``batches_deferred_pad`` — non-divisible host batches left to the
+      fused chain's mask-pad path (placement deferred, NOT a fallback)
+    - ``batches_padded`` / ``pad_rows_added`` — fused-chain calls that
+      mask-padded a non-divisible batch onto the mesh, and how many
+      zero rows the padding added in total
+    - ``sharded_chain_calls`` — fused-chain executions lowered with the
+      explicit SpecLayout shardings (vs inheriting input placement)
+    - ``fallback_small_batch`` — batches below ``config.shard_min_rows``
+      that genuinely ran single-device (the ONLY surviving fallback)
+    - ``fallback_row_coupled`` — pad-unsound (row_independent=False)
+      chains that kept the propagation path for a non-divisible batch
+    """
+
+
+sharding_counters = ShardingCounters()
+metrics_registry.register("sharding", sharding_counters)

@@ -26,6 +26,12 @@ Rule catalog (KG = Keystone Graph):
 - ``KG102 dtype-seam`` — a silent upcast across a node boundary (output
   dtype wider than input), or mixed dtypes meeting at a gather join:
   the upcast doubles bytes/HBM mid-chain without anyone asking for it.
+- ``KG103 shard-pad`` — fitting under ``config.shard_data_batches`` with
+  a dataset whose batch rows can never divide the active data mesh: every
+  fused-chain call over it mask-pads onto the mesh (extra pad rows per
+  call) — the old silent single-device cliff, now caught statically (a
+  pure shape check, no execution) so the operator can pick a divisible
+  batch size instead of paying the padding.
 - ``KG201 dead-node`` — a node in the graph unreachable from the sink
   (composition orphans the pruner should have dropped).
 - ``KG202 cache-advice`` — a non-trivial subchain re-used by >= 2
@@ -37,7 +43,7 @@ Rule catalog (KG = Keystone Graph):
 
 Severity model: serveability rules (KG00x) are *errors* when linting
 with ``serve=True`` (the pre-``compiled()`` gate) and *warnings*
-otherwise; KG101/KG102 are warnings; KG201/KG202/KG203 are info.
+otherwise; KG101/KG102/KG103 are warnings; KG201/KG202/KG203 are info.
 
 Wire-up: ``Pipeline.lint()`` runs this directly; the opt-in env gate
 ``KEYSTONE_LINT=warn|error|off`` (default off) runs it before every
@@ -75,6 +81,7 @@ GRAPH_RULES: Dict[str, str] = {
     "KG003": "gather/multi-input node on the serving chain (not linear)",
     "KG101": "shape-polymorphic input feeds jit consumers without buckets",
     "KG102": "silent dtype upcast / mixed-dtype seam across nodes",
+    "KG103": "dataset batch rows never divide the active data mesh",
     "KG201": "dead node unreachable from the pipeline sink",
     "KG202": "re-used subchain with no cache node",
     "KG203": "stored measured profile exists but auto-cache is model-only",
@@ -417,8 +424,76 @@ def lint_graph(
                          "input dtype",
                 ))
 
-    # -- KG202: cache placement advice -------------------------------------
+    # ONE consumer map shared by KG103 and KG202: the full-graph
+    # traversal is paid once per lint pass, not per rule.
     consumers = graph.consumers([sink])
+
+    # -- KG103: shard-pad (batch rows never divide the data mesh) ----------
+    # A pure static shape check — no execution, no placement: the device
+    # list is only consulted for the mesh width, and failures to resolve
+    # one (deviceless backends) simply skip the rule (the classifier
+    # answers "inert" there). One classifier shared with the runtime
+    # placement (DatasetOperator) and the chain lowering (batch_layout),
+    # so the lint can never drift from what execution actually does.
+    if config.shard_data_batches:
+        from keystone_tpu.utils.mesh import (
+            host_batch_shard_class,
+            num_data_shards,
+        )
+
+        try:
+            shards = int(num_data_shards())
+        except RuntimeError:  # deviceless backend: no mesh to divide
+            shards = 0
+
+        def _feeds_jittable_chain(start: NodeId) -> bool:
+            """Does the dataset's row count reach a jittable chain? Walk
+            downstream through row-preserving transformer stages (host
+            normalizers etc. keep the batch's row count, so the pad cost
+            still lands on the first jittable stage after them); stop at
+            estimators/gathers-of-other-rows — labels/side inputs
+            consumed solely by estimators are re-padded once inside
+            RowMatrix regardless, and warning on them would train
+            operators to ignore the rule."""
+            seen, stack = set(), [start]
+            while stack:
+                nid = stack.pop()
+                for u in consumers.get(nid, ()):
+                    if not isinstance(u, NodeId) or u in seen:
+                        continue
+                    seen.add(u)
+                    u_op = graph.operators.get(u)
+                    if isinstance(u_op, TransformerOperator):
+                        if getattr(u_op.transformer, "jittable", False):
+                            return True
+                        if getattr(u_op.transformer, "row_independent",
+                                   True):
+                            stack.append(u)  # rows survive the host stage
+                    elif getattr(u_op, "persist", False):
+                        stack.append(u)  # identity cache node
+            return False
+
+        for nid in (order if shards > 1 else ()):
+            op = graph.operators[nid]
+            if not isinstance(op, DatasetOperator):
+                continue
+            if host_batch_shard_class(op.data, shards) != "pad":
+                continue
+            if not _feeds_jittable_chain(nid):
+                continue
+            rows = int(op.data.shape[0])
+            pad = (-rows) % shards
+            emit(Diagnostic(
+                "KG103", "warning", _node_label(graph, nid),
+                f"batch of {rows} rows can never divide the "
+                f"{shards}-shard data mesh: every fused-chain call "
+                f"over it mask-pads {pad} row(s) onto the mesh "
+                "(the old silent single-device cliff, now padded)",
+                hint="size batches to a multiple of the mesh "
+                     f"width ({shards}) to shard without padding",
+            ))
+
+    # -- KG202: cache placement advice (consumer map shared with KG103) ----
     for gid, users in consumers.items():
         if not isinstance(gid, NodeId):
             continue

@@ -111,6 +111,7 @@ def _observed_execute(op, deps, tracer, profile, worker=None,
 
     import jax
 
+    from keystone_tpu.utils.mesh import value_data_shards
     from keystone_tpu.utils.metrics import (
         node_cost_analysis,
         peak_hbm_bytes,
@@ -165,6 +166,10 @@ def _observed_execute(op, deps, tracer, profile, worker=None,
         digest=digest,
         out_rows=_out_rows(out),
         out_shape=_span_shape(out),
+        # Mesh-width provenance on every measured row: a 1-shard profile
+        # is visibly 1-shard, and (with the store fingerprint's
+        # device_count) can never size a wider mesh's plan.
+        data_shards=value_data_shards(out),
     )
     if tracer is not None:
         tracer.record(

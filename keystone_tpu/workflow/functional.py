@@ -7,6 +7,12 @@ into ONE XLA computation. This is how the driver's ``entry()`` exposes the
 flagship forward step and how the AOT tests compile the full two-branch
 ImageNet featurizer for a v5e target without a chip (SURVEY.md §7 hard
 part 6: both deep branches fused without blowing compile time).
+
+``layout`` threads the mesh-native SpecLayout convention through the
+replay: the returned function is lowered ONCE under ``jax.jit`` with
+explicit row-sharded ``in_shardings``/``out_shardings``, so the whole
+fused chain is data-parallel by contract — never by whatever placement
+the caller's batch happened to carry.
 """
 
 from __future__ import annotations
@@ -21,7 +27,7 @@ from keystone_tpu.workflow.operators import (
 )
 
 
-def fitted_forward(pipeline, example):
+def fitted_forward(pipeline, example, layout=None):
     """A jittable ``fn(X)`` replaying ``pipeline``'s optimized transformer
     graph over the argument.
 
@@ -29,6 +35,14 @@ def fitted_forward(pipeline, example):
     batch used once to build + optimize the graph (chain fusion, node
     merging) — the returned function is pure and shape-polymorphic over
     the leading batch axis up to what the transformers allow.
+
+    ``layout`` (a ``utils.mesh.SpecLayout``) lowers the replay with the
+    mesh-native explicit shardings instead of returning the un-jitted pure
+    function: rows sharded over the data axis in AND out, one lowering for
+    the whole chain. Batch rows must divide the layout's shard count (pad
+    with ``layout.pad_put`` and trim, the mask-pad idiom, when they
+    don't). ``None`` keeps the legacy behavior: the caller jits (and
+    places) the pure function however it likes.
     """
     ds = pipeline(example)
     g = PipelineEnv.get().optimizer.execute(ds.graph, [ds.sink])
@@ -51,4 +65,6 @@ def fitted_forward(pipeline, example):
                 raise TypeError(f"unexpected op in fitted graph: {op!r}")
         return values[ds.sink]
 
-    return fn
+    if layout is None:
+        return fn
+    return layout.jit(fn)

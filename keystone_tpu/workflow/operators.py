@@ -54,10 +54,16 @@ class Operator:
 class DatasetOperator(Operator):
     """A constant batch of data spliced into the graph (the RDD analog).
 
-    Array batches are row-sharded over the default mesh on execution (when
-    the row count divides it), so the jittable transformer chain downstream
-    runs data-parallel across chips by sharding propagation — the
-    per-partition map of the reference, done by GSPMD.
+    Array batches are row-sharded over the default mesh on execution, so
+    the jittable transformer chain downstream runs data-parallel across
+    chips — the per-partition map of the reference. Divisible batches are
+    placed here with the explicit data sharding; non-divisible batches are
+    deferred to the fused chain's mask-pad path (``Transformer.batch_call``
+    pads onto the mesh and trims, the pad-inert idiom), so a batch that
+    doesn't divide the mesh no longer silently degrades to single-device.
+    The only surviving fallback — batches below ``config.shard_min_rows``
+    — is counted in the metrics registry (``sharding.fallback_small_batch``)
+    so it is visible, never silent.
     """
 
     def __init__(self, data: Any):
@@ -67,37 +73,48 @@ class DatasetOperator(Operator):
         import logging
 
         import jax
-        import numpy as np
 
         from keystone_tpu.config import config
 
         data = self.data
         if not config.shard_data_batches:
             return data
-        # Only host numpy batches are auto-placed; a jax.Array already has a
-        # placement (explicit or default) that we must not override, and
-        # non-numeric arrays (strings/objects) belong to host transformers.
-        if (
-            not isinstance(data, np.ndarray)
-            or data.ndim < 1
-            or data.dtype.kind not in "biufc"
-        ):
-            return data
-        from keystone_tpu.utils.mesh import data_sharding, num_data_shards
+        # One classifier shared with batch_layout and the KG103 lint
+        # (utils.mesh.host_batch_shard_class), so placement, lowering,
+        # and static analysis can never drift apart. A jax.Array already
+        # has a placement (explicit or default) that we must not
+        # override; non-numeric arrays belong to host transformers —
+        # both are "inert" here.
+        from keystone_tpu.utils.mesh import (
+            data_sharding,
+            host_batch_shard_class,
+        )
+        from keystone_tpu.utils.metrics import sharding_counters
 
-        shards = num_data_shards()
-        if shards <= 1 or data.shape[0] < config.shard_min_rows:
+        klass = host_batch_shard_class(data)
+        if klass == "inert":
             return data
-        if data.shape[0] % shards != 0:
-            # Padding would change the row count the rest of the graph (and
-            # the evaluators) see, so fall back — but say so.
+        if klass == "small":
+            # The ONLY surviving single-device fallback: placement overhead
+            # beats the win below the row floor. Counted AND logged so a
+            # fit that quietly ran narrow is visible in the registry.
+            sharding_counters.bump("fallback_small_batch")
             logging.getLogger("keystone_tpu").info(
-                "batch of %d rows does not divide the %d-device mesh; "
-                "running this dataset single-device",
+                "batch of %d rows is below shard_min_rows=%d; running this "
+                "dataset single-device",
                 data.shape[0],
-                shards,
+                config.shard_min_rows,
             )
             return data
+        if klass == "pad":
+            # Deferred, not dropped: jax refuses an uneven device_put, so
+            # the fused chain's sharded call mask-pads this batch onto the
+            # mesh (mesh.SpecLayout.pad_put) and trims the pad rows back
+            # out — downstream row counts are unchanged and the chain
+            # still lowers with explicit shardings.
+            sharding_counters.bump("batches_deferred_pad")
+            return data
+        sharding_counters.bump("batches_sharded")
         return jax.device_put(data, data_sharding())
 
     def signature(self):

@@ -103,6 +103,13 @@ class Transformer:
         bucket shape, and sliced — the jit cache then only ever sees ladder
         shapes, so variable-size traffic stops recompiling once the ladder
         is warm. Empty ladder = per-shape jit, exactly as before.
+
+        Under ``config.shard_data_batches``, a batch carrying (or owed)
+        the mesh's data-parallel layout lowers the WHOLE chain once with
+        explicit ``in_shardings``/``out_shardings`` (``mesh.SpecLayout``)
+        instead of inheriting whatever placement the input happened to
+        carry — and a non-divisible host batch is mask-padded onto the
+        mesh and trimmed, never silently run single-device.
         """
         if self.jittable and _is_array(X):
             from keystone_tpu.config import config
@@ -111,6 +118,12 @@ class Transformer:
                 from keystone_tpu.workflow.serving import bucketed_call
 
                 return bucketed_call(self, X)
+            if config.shard_data_batches:
+                from keystone_tpu.utils.mesh import batch_layout
+
+                layout = batch_layout(X)
+                if layout is not None:
+                    return self._sharded_call(X, layout)
             return self._jitted()(X)
         return self.apply_batch(X)
 
@@ -121,12 +134,53 @@ class Transformer:
             object.__setattr__(self, "_jit_cache", fn)
         return fn
 
+    def _jitted_sharded(self, layout) -> Callable:
+        """The chain lowered ONCE per mesh layout with the SpecLayout
+        convention's explicit shardings (rows sharded in, rows sharded
+        out) — memoized per (transformer, layout) like ``_jitted``."""
+        cache = getattr(self, "_shard_jit_cache", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(self, "_shard_jit_cache", cache)
+        fn = cache.get(layout)
+        if fn is None:
+            fn = cache[layout] = layout.jit(self.apply_batch)
+        return fn
+
+    def _sharded_call(self, X, layout):
+        """Run the chain data-parallel under ``layout``: divisible batches
+        go straight through the explicitly-specced jit; non-divisible host
+        batches are mask-padded onto the mesh, run at the padded shape,
+        and trimmed back — row-independence makes the pad rows inert, so
+        outputs are bit-identical to the unsharded walk while the compute
+        spans every shard. Row-coupled chains (padding unsound) keep the
+        propagation path, counted so the narrow run is visible."""
+        from keystone_tpu.utils.metrics import sharding_counters
+
+        n = int(X.shape[0])
+        if n % layout.num_shards == 0:
+            # Only reachable with X already sharded: batch_layout hands
+            # host arrays here solely for the pad class (divisible host
+            # batches were placed by DatasetOperator upstream).
+            sharding_counters.bump("sharded_chain_calls")
+            return self._jitted_sharded(layout)(X)
+        if not self.row_independent:
+            sharding_counters.bump("fallback_row_coupled")
+            return self._jitted()(X)
+        padded, n = layout.pad_put(X)
+        sharding_counters.bump("sharded_chain_calls")
+        sharding_counters.bump("batches_padded")
+        sharding_counters.bump("pad_rows_added", padded.shape[0] - n)
+        out = self._jitted_sharded(layout)(padded)
+        return out[:n]
+
     def __getstate__(self):
-        """Pickle without the per-instance jit cache (jitted callables are
+        """Pickle without the per-instance jit caches (jitted callables are
         unpicklable; they rebuild lazily after load). Non-mutating, so
         persisting a live fitted transformer keeps its warm compilation."""
         state = dict(self.__dict__)
         state.pop("_jit_cache", None)
+        state.pop("_shard_jit_cache", None)
         return state
 
     def signature(self) -> Any:

@@ -681,10 +681,21 @@ class PlanResourcesRule(Rule):
 
     def _plan_chunk_rows(self, graph, targets, measured, plan) -> None:
         from keystone_tpu.workflow.graph import structural_digest
+        from keystone_tpu.utils.mesh import num_data_shards
         from keystone_tpu.utils.metrics import device_hbm_bytes
 
         dmemo: Dict[GraphId, Any] = {}
         budget = device_hbm_bytes() // self.CHUNK_BUDGET_FRAC
+        # A solver chunk is row-sharded over the mesh, so each device
+        # holds rows/shards of it: the per-device HBM budget prices
+        # bytes_per_row ÷ shard_count, not the whole chunk. The stored
+        # profile's fingerprint already pins device_count at load
+        # (ProfileFingerprintError), so a 1-device profile can never
+        # reach this sizing under an 8-device mesh.
+        try:
+            shards = max(1, int(num_data_shards()))
+        except RuntimeError:  # deviceless backend: plan as one shard
+            shards = 1
         for nid in graph.reachable(targets):
             op = graph.operators[nid]
             if not isinstance(op, EstimatorOperator):
@@ -700,7 +711,7 @@ class PlanResourcesRule(Rule):
             if rows <= 0 or nbytes <= 0:
                 continue
             bytes_per_row = nbytes / rows
-            planned = int(budget // max(1.0, bytes_per_row))
+            planned = int(budget // max(1.0, bytes_per_row / shards))
             if planned >= rows or planned < 1:
                 # The whole measured input fits the chunk budget: nothing
                 # to plan (streams smaller than the budget never split).
@@ -714,11 +725,12 @@ class PlanResourcesRule(Rule):
                 action=f"solve_chunk_rows={planned}",
                 provenance="measured",
                 reason=(
-                    f"measured {bytes_per_row:.0f} B/row vs "
-                    f"{budget} B chunk budget — planned split replaces "
-                    "reactive OOM-halving"
+                    f"measured {bytes_per_row:.0f} B/row over "
+                    f"{shards} shard(s) vs {budget} B per-device chunk "
+                    "budget — planned split replaces reactive OOM-halving"
                 ),
                 cost={"bytes_per_row": round(bytes_per_row, 1),
                       "chunk_budget_bytes": budget,
+                      "data_shards": shards,
                       "measured_rows": rows},
             )

@@ -37,9 +37,15 @@ def rng():
 
 @pytest.fixture(autouse=True)
 def fresh_env():
-    """Fresh PipelineEnv per test — the analog of a fresh SparkContext."""
+    """Fresh PipelineEnv per test — the analog of a fresh SparkContext.
+    The default-mesh memo resets too, so a test that installed a narrow
+    mesh via ``set_default_mesh`` (fake device counts) can never leak a
+    memoized 1-device mesh into a later 8-device test."""
+    from keystone_tpu.utils.mesh import reset_default_mesh
     from keystone_tpu.workflow.executor import PipelineEnv
 
     PipelineEnv.reset()
+    reset_default_mesh()
     yield
     PipelineEnv.reset()
+    reset_default_mesh()

@@ -529,21 +529,28 @@ def test_plan_workers_serial_on_one_core(store, monkeypatch):
 
 def test_plan_chunk_rows_from_measured_bytes_per_row(store, monkeypatch):
     """Measured bytes-per-row vs a (shrunk) HBM budget turns into a
-    planned chunk size: PR-3's reactive OOM-halving becomes a plan."""
+    planned chunk size: PR-3's reactive OOM-halving becomes a plan. The
+    chunk is row-sharded over the mesh, so the budget prices bytes/row ÷
+    shard count: 64 rows per DEVICE x the 8-shard test mesh."""
     import keystone_tpu.utils.metrics as metrics_mod
 
     X, Y = _data(n=512, d=128)
     p = build_reused_subchain(X, Y, LeastSquaresEstimator(lam=1e-3))
     _profiled_fit(p)
     # Estimator input: 512 rows x 256 features f32 = 1024 B/row. An HBM
-    # of 512 KiB / CHUNK_BUDGET_FRAC=8 budgets 65536 B -> 64-row chunks.
-    monkeypatch.setattr(metrics_mod, "device_hbm_bytes", lambda: 524288)
+    # of 256 KiB / CHUNK_BUDGET_FRAC=8 budgets 32768 B -> 32 rows per
+    # device -> 256 planned rows across the 8-shard mesh (< the 512
+    # measured rows, so the plan actually lands).
+    monkeypatch.setattr(metrics_mod, "device_hbm_bytes", lambda: 262144)
     PipelineEnv.reset()
     rules.clear_decisions()
     p2 = build_reused_subchain(X, Y, LeastSquaresEstimator(lam=1e-3))
     PipelineEnv.get().optimizer.execute(p2.graph, [p2.sink])
     plan = PipelineEnv.get().resource_plan
-    assert plan.get("solve_chunk_rows") == 64
+    from keystone_tpu.utils.mesh import num_data_shards
+
+    assert num_data_shards() == 8
+    assert plan.get("solve_chunk_rows") == 32 * 8
     planned = [d for d in rules.optimizer_decisions()
                if d.action.startswith("solve_chunk_rows=")]
     assert planned and planned[0].provenance == "measured"

@@ -321,7 +321,9 @@ def test_dataset_sharding_respects_placement_and_dtype():
     # String array: untouched (host transformer input).
     s = np.asarray(["a"] * 64)
     assert DatasetOperator(s).execute([]) is s
-    # Non-divisible rows: single-device fallback, data unchanged.
+    # Non-divisible rows: placement DEFERRED to the fused chain's
+    # mask-pad path (jax refuses an uneven device_put) — the operator
+    # hands the host batch through unchanged and counts the deferral.
     odd = np.ones((65, 4), dtype=np.float32)
     assert DatasetOperator(odd).execute([]) is odd
 

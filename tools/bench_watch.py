@@ -80,16 +80,20 @@ MAX_MARGIN = 0.9
 BLESS_TOL = 0.1
 
 #: Leaf-name fragments that mark a lower-is-better series (latency,
-#: durations, overheads).
+#: durations, overheads). ``fallback``/``pad_rows`` cover the
+#: fit_multichip family: silent single-device fallbacks and pad overhead
+#: creeping up are regressions.
 LOWER_BETTER = (
     "latency", "p50_", "p95_", "p99_", "_ms", "ms_", "seconds", "wall",
     "overhead", "expired", "dropped", "stalls", "deaths", "residual",
+    "fallback", "pad_rows", "rel_err",
 )
 #: Leaf-name fragments that mark a higher-is-better series (rates,
-#: speedups, utilization).
+#: speedups, utilization). ``scaling`` covers the fit_multichip rows/s
+#: scaling value; ``rows_per`` its per-width throughput leaves.
 HIGHER_BETTER = (
     "tflops", "throughput", "per_s", "per_sec", "speedup", "img_per",
-    "rows_per", "mfu",
+    "rows_per", "mfu", "scaling",
 )
 
 
@@ -238,9 +242,11 @@ def load_series(
 
     # JSONL histories: one fingerprinted row per line, chronological.
     # BENCH_serve.json keeps one latest row per serving metric;
-    # BENCH_fit.json accumulates every `make bench-fit` run of the
-    # stage-parallel executor bench (wall-like leaves up = regress,
-    # speedup down = regress, bit_identical true->false = regress).
+    # BENCH_fit.json accumulates every `make bench-fit` / `make bench-opt`
+    # / `make bench-multichip` run (fit_parallel_walk, fit_optimizer,
+    # and fit_multichip families: wall-like leaves up = regress,
+    # speedup/scaling/rows_per_s down = regress, silent-fallback counts
+    # up = regress, bit_identical true->false = regress).
     for family, fname in (("serve", "BENCH_serve.json"),
                           ("fit", "BENCH_fit.json")):
         jsonl_path = os.path.join(root, fname)
