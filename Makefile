@@ -1,6 +1,6 @@
 # Developer entry points. Pipelines launch via bin/run-pipeline.sh.
 
-.PHONY: test t1 chaos native bench bench-serve bench-serve-overload bench-serve-replicas bench-serve-daemon bench-fit bench-opt bench-multichip trace-demo obs-serve serve-daemon profile-demo bench-watch lint dryrun clean tpu-checkride sentinel northstar acceptance
+.PHONY: test t1 chaos native bench bench-serve bench-serve-overload bench-serve-replicas bench-serve-daemon bench-serve-precision bench-fit bench-opt bench-multichip trace-demo obs-serve serve-daemon profile-demo bench-watch lint dryrun clean tpu-checkride sentinel northstar acceptance
 
 # The canonical tier-1 verify (ROADMAP.md), verbatim at the defaults —
 # builders and CI invoke this one entry point instead of hand-copying the
@@ -92,6 +92,16 @@ serve-daemon:
 # `make bench-watch` regresses against.
 bench-serve-daemon:
 	JAX_PLATFORMS=cpu python tools/bench_serve.py --daemon --out BENCH_serve.json
+
+# Memory-bounded precision A/B: f32 hand-picked single-bucket ladder vs
+# HBM-planned ladder + bf16 through the same trained canonical head.
+# Hard gates on any backend: wall AND p99 beat the baseline, planned f32
+# bit-identical to hand-picked f32, quality within the declared
+# tolerance of the f32 oracle (qualify() refuses otherwise), zero
+# post-warmup compiles. APPENDS the fingerprinted serve_precision row to
+# the BENCH_serve.json history `make bench-watch` regresses against.
+bench-serve-precision:
+	JAX_PLATFORMS=cpu python tools/bench_serve.py --precision --out BENCH_serve.json
 
 # Observability smoke: a small fit + streamed solve + serve under
 # KEYSTONE_TRACE=1, Chrome-trace exported to /tmp/keystone_trace.json,

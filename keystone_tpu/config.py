@@ -176,8 +176,12 @@ class Config:
     # map_batches featurization — runs on a background thread up to this
     # many batches ahead, so host ingest leaves the device's critical path
     # while peak host residency stays bounded by depth × batch bytes.
-    # 0 restores fully synchronous single-thread ingestion.
-    # Env: KEYSTONE_PREFETCH_DEPTH.
+    # 0 restores fully synchronous single-thread ingestion. This is the
+    # hand-picked ceiling: on a measured-profile hit PlanResourcesRule
+    # CLAMPS the effective depth down when depth × measured per-batch
+    # bytes would overrun its budget share (the session plan; an
+    # exported KEYSTONE_PREFETCH_DEPTH — including 0 — always wins, see
+    # resolved_prefetch_depth). Env: KEYSTONE_PREFETCH_DEPTH.
     prefetch_depth: int = field(
         default_factory=lambda: _env_int("KEYSTONE_PREFETCH_DEPTH", 2)
     )
@@ -196,6 +200,27 @@ class Config:
     # Env: KEYSTONE_SERVE_MAX_BATCH.
     serve_max_batch: int = field(
         default_factory=lambda: _env_int("KEYSTONE_SERVE_MAX_BATCH", 1024)
+    )
+    # Serving precision ladder (workflow/serving.py CompiledPipeline):
+    # the storage/accumulate mode every serve bucket AOT-warms at.
+    # "f32" (default) is byte-for-byte today's path — the engine's jit
+    # wrapper is constructed exactly as before, so outputs stay
+    # bit-identical when the knob is off. "f32h" traces the chain under
+    # matmul precision HIGH (3-pass bf16 emulation — ~2x MXU throughput
+    # at ~f32-ish accuracy; a no-op on CPU). "bf16" is the MXU-native
+    # throughput mode: the request batch is cast to bfloat16 at the
+    # chain boundary (bf16 storage) and every matmul traces at DEFAULT
+    # precision (one bf16 pass, f32 accumulation — the
+    # tests/test_bf16_mode.py storage/accumulate contract); fitted
+    # weights stay f32 and any bf16 leaf is cast back to the request
+    # dtype at the boundary. Non-f32 modes should be gated per pipeline
+    # with CompiledPipeline.qualify() — evaluation/ metrics within a
+    # declared tolerance of the f32 oracle, or the knob refuses with a
+    # typed PrecisionQualityError. Env: KEYSTONE_SERVE_PRECISION.
+    serve_precision: str = field(
+        default_factory=lambda: _env_choice(
+            "KEYSTONE_SERVE_PRECISION", ("f32", "f32h", "bf16"), "f32"
+        )
     )
     # Serving replica pool width: how many local devices CompiledPipeline
     # AOT-warms its bucket ladder onto (one replica per device, each owning
@@ -559,6 +584,30 @@ def resolved_solve_chunk_rows() -> int | None:
     against the planner's session plan."""
     if "KEYSTONE_SOLVE_CHUNK_ROWS" in os.environ:
         return _env_int("KEYSTONE_SOLVE_CHUNK_ROWS", 0)
+    return None
+
+
+def resolved_serve_buckets() -> tuple | None:
+    """The LIVE env value of KEYSTONE_SERVE_BUCKETS when it is exported
+    non-empty, else None — the serve-ladder planner's env pin: an
+    explicitly exported bucket list always wins over the HBM-planned
+    ladder (the resolved_exec_workers convention). An exported EMPTY
+    value reads as unset here (it spells "no in-graph bucketing", not a
+    ladder pin). Lives here so the env read stays inside config.py
+    (keystone-lint KL003)."""
+    if "KEYSTONE_SERVE_BUCKETS" in os.environ:
+        return _env_buckets() or None
+    return None
+
+
+def resolved_prefetch_depth() -> int | None:
+    """The LIVE env value of KEYSTONE_PREFETCH_DEPTH when exported, else
+    None — presence over truthiness: an explicitly exported 0 pins the
+    synchronous ingest path against the planner's session clamp
+    (PlanResourcesRule); only the unset default falls through to the
+    plan, then to ``config.prefetch_depth``."""
+    if "KEYSTONE_PREFETCH_DEPTH" in os.environ:
+        return _env_int("KEYSTONE_PREFETCH_DEPTH", 2)
     return None
 
 

@@ -35,6 +35,29 @@ logger = logging.getLogger("keystone_tpu")
 Batch = Tuple[np.ndarray, Optional[np.ndarray]]
 
 
+def resolved_prefetch_depth_value(depth: Optional[int] = None) -> int:
+    """THE effective prefetch depth, one resolution order for every
+    consumer: an explicit ``depth`` argument > a live-exported
+    KEYSTONE_PREFETCH_DEPTH (presence wins, including an explicit 0 —
+    the synchronous-ingest pin) > the session resource plan's clamp
+    (``PlanResourcesRule`` caps depth × measured per-batch bytes against
+    the HBM budget share; the plan only ever clamps the hand-picked
+    value DOWN) > ``config.prefetch_depth``."""
+    if depth is not None:
+        return int(depth)
+    from keystone_tpu.config import resolved_prefetch_depth
+
+    env = resolved_prefetch_depth()
+    if env is not None:
+        return env
+    from keystone_tpu.workflow.executor import PipelineEnv
+
+    planned = PipelineEnv.get().resource_plan.get("prefetch_depth")
+    if planned:
+        return min(int(planned), int(config.prefetch_depth))
+    return int(config.prefetch_depth)
+
+
 class PrefetchIterator:
     """Runs an upstream batch producer on a background thread into a
     bounded queue — the ingest-overlap seam of the framework.
@@ -86,9 +109,7 @@ class PrefetchIterator:
         depth: Optional[int] = None,
         retry_policy: Optional[RetryPolicy] = None,
     ):
-        if depth is None:
-            depth = config.prefetch_depth
-        depth = int(depth)
+        depth = resolved_prefetch_depth_value(depth)
         if depth < 1:
             raise ValueError(
                 f"prefetch depth must be >= 1, got {depth} (use "
@@ -338,8 +359,10 @@ def prefetch_batches(batches: Iterable, depth: Optional[int] = None):
 
     depth > 0 wraps ``batches`` in a background-thread prefetcher; depth 0
     returns ``batches`` itself — a true passthrough, so the synchronous
-    path is byte-for-byte today's behavior, not a degenerate queue."""
-    depth = config.prefetch_depth if depth is None else int(depth)
+    path is byte-for-byte today's behavior, not a degenerate queue.
+    Depth resolution (env pin > session plan clamp > config):
+    ``resolved_prefetch_depth_value``."""
+    depth = resolved_prefetch_depth_value(depth)
     if depth <= 0:
         return batches
     return PrefetchIterator(batches, depth)

@@ -1755,3 +1755,36 @@ class ShardingCounters(CounterSet):
 
 sharding_counters = ShardingCounters()
 metrics_registry.register("sharding", sharding_counters)
+
+
+class ServePlanCounters(CounterSet):
+    """Process-wide serve-planner observability: every memory-bounded
+    serving decision lands here, so "the planner trimmed the ladder" is
+    a counter assertion instead of a log line someone may have read —
+    the no-silent-trim contract of the HBM-planned bucket ladder.
+    Thread-safe (CounterSet).
+
+    Well-known keys:
+
+    - ``ladders_planned`` — ladder plans priced by the HBM planner at
+      warmup: one per (engine, traffic signature) — a re-warm at a new
+      feature shape/dtype re-prices and counts again
+    - ``ladders_pinned`` — plans skipped because the ladder was explicit
+      (buckets=, KEYSTONE_SERVE_BUCKETS, or config.serve_buckets — the
+      env-pin-wins convention); per (engine, signature) like
+      ``ladders_planned``
+    - ``buckets_trimmed`` — ladder rungs dropped because their AOT-warmed
+      executables could not coexist under the HBM headroom
+    - ``top_bucket_capped`` — plans whose LARGEST rung was among the
+      trims (oversize batches now chunk through a smaller top bucket)
+    - ``plans_unpriced`` — plans skipped because no bytes-per-row could
+      be priced (no measured profile and no abstract estimate)
+    - ``plans_over_budget`` — plans still over budget after trimming to
+      the minimum one-rung ladder (serving proceeds; KG104 flags it)
+    - ``prefetch_clamped`` — session plans that clamped the hand-picked
+      prefetch depth down against the budget share
+    """
+
+
+serve_plan_counters = ServePlanCounters()
+metrics_registry.register("serve_plan", serve_plan_counters)

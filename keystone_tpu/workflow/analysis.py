@@ -32,6 +32,14 @@ Rule catalog (KG = Keystone Graph):
   call) — the old silent single-device cliff, now caught statically (a
   pure shape check, no execution) so the operator can pick a divisible
   batch size instead of paying the padding.
+- ``KG104 plan-over-budget`` — a memory plan whose priced HBM exceeds
+  the budget, caught at lint time instead of at warmup/trace time: a
+  pinned serve bucket ladder (ladder × replicas × storage dtype — the
+  AOT-warmed executables all coexist) beyond the ladder budget share,
+  or a pinned solve chunk (rows × bytes/row from the propagated spec)
+  beyond the chunk budget share. Shape-only pricing off the propagated
+  specs — no execution, no compile; the un-pinned defaults stay silent
+  because the warmup/plan path auto-sizes those.
 - ``KG201 dead-node`` — a node in the graph unreachable from the sink
   (composition orphans the pruner should have dropped).
 - ``KG202 cache-advice`` — a non-trivial subchain re-used by >= 2
@@ -43,7 +51,8 @@ Rule catalog (KG = Keystone Graph):
 
 Severity model: serveability rules (KG00x) are *errors* when linting
 with ``serve=True`` (the pre-``compiled()`` gate) and *warnings*
-otherwise; KG101/KG102/KG103 are warnings; KG201/KG202/KG203 are info.
+otherwise; KG101/KG102/KG103/KG104 are warnings; KG201/KG202/KG203 are
+info.
 
 Wire-up: ``Pipeline.lint()`` runs this directly; the opt-in env gate
 ``KEYSTONE_LINT=warn|error|off`` (default off) runs it before every
@@ -82,6 +91,7 @@ GRAPH_RULES: Dict[str, str] = {
     "KG101": "shape-polymorphic input feeds jit consumers without buckets",
     "KG102": "silent dtype upcast / mixed-dtype seam across nodes",
     "KG103": "dataset batch rows never divide the active data mesh",
+    "KG104": "pinned serve ladder / solve chunk priced beyond the HBM budget",
     "KG201": "dead node unreachable from the pipeline sink",
     "KG202": "re-used subchain with no cache node",
     "KG203": "stored measured profile exists but auto-cache is model-only",
@@ -492,6 +502,103 @@ def lint_graph(
                 hint="size batches to a multiple of the mesh "
                      f"width ({shards}) to shard without padding",
             ))
+
+    # -- KG104: pinned memory plan priced beyond the HBM budget ------------
+    # Shape-only pricing off the propagated specs — no execution, no
+    # compile, no device work. Only PINNED plans are priced (an explicit
+    # serve bucket ladder / an explicit solve chunk size): the un-pinned
+    # defaults go through the warmup/optimizer planners, which auto-size
+    # them under the same budget fractions, so flagging those would warn
+    # about a plan that will never run as written.
+    from keystone_tpu.config import (
+        resolved_serve_buckets,
+        resolved_solve_chunk_rows,
+    )
+    from keystone_tpu.utils.metrics import device_hbm_bytes
+
+    def _row_bytes(spec, itemsize=None) -> int:
+        import numpy as np
+
+        shape = tuple(spec.shape[1:])
+        size = itemsize if itemsize is not None else spec.dtype.itemsize
+        return int(np.prod(shape, dtype=np.int64)) * int(size)
+
+    budget = device_hbm_bytes()
+    ladder = resolved_serve_buckets() or config.serve_buckets
+    if ladder and source_spec is not None:
+        from keystone_tpu.workflow.rules import SERVE_LADDER_BUDGET_FRAC
+
+        # The storage dtype the ladder warms at: bf16 serving stores the
+        # request batch at half the bytes (the precision-ladder boundary
+        # cast); f32/f32h keep the spec's dtype.
+        in_itemsize = (
+            2 if config.serve_precision == "bf16"
+            else source_spec.dtype.itemsize
+        )
+        replicas = config.serve_devices
+        if replicas == 0:
+            import jax
+
+            try:
+                replicas = len(jax.local_devices())
+            except Exception:  # lint: broad-ok deviceless backend: price a one-replica pool
+                replicas = 1
+        # Per-row price = input + EVERY known node output (the runtime
+        # planner's conservative all-activations-resident price — the
+        # 512-feature intermediate of a featurize chain dominates, and
+        # pricing only the in/out boundary would systematically miss
+        # genuinely over-budget ladders).
+        bpr = _row_bytes(source_spec, in_itemsize) + sum(
+            _row_bytes(s) for s in (specs.get(nid) for nid in order)
+            if s is not None
+        )
+        ladder_bytes = (
+            sum(int(b) * bpr for b in ladder) * max(1, int(replicas))
+        )
+        ladder_budget = budget // SERVE_LADDER_BUDGET_FRAC
+        if ladder_bytes > ladder_budget:
+            emit(Diagnostic(
+                "KG104", "warning", "-",
+                f"pinned serve ladder {tuple(int(b) for b in ladder)} x "
+                f"{replicas} replica(s) at serve_precision="
+                f"{config.serve_precision} prices {ladder_bytes} resident "
+                f"bytes — beyond the {ladder_budget}-byte ladder budget "
+                f"(device HBM {budget} // {SERVE_LADDER_BUDGET_FRAC}); "
+                "warmup would pin more executables than the device holds",
+                hint="drop rungs from KEYSTONE_SERVE_BUCKETS, serve fewer "
+                     "replicas, or unset the ladder so the HBM planner "
+                     "sizes it",
+            ))
+    chunk_rows = resolved_solve_chunk_rows()
+    if chunk_rows is None:
+        chunk_rows = config.solve_chunk_rows
+    if chunk_rows and chunk_rows > 0:
+        from keystone_tpu.workflow.rules import PlanResourcesRule
+
+        chunk_budget = budget // PlanResourcesRule.CHUNK_BUDGET_FRAC
+        for nid in order:
+            if not isinstance(graph.operators[nid], EstimatorOperator):
+                continue
+            deps = graph.dependencies[nid]
+            d0 = deps[0] if deps else None
+            spec = (
+                specs.get(d0) if isinstance(d0, NodeId) else source_spec
+            )
+            if spec is None:
+                continue
+            chunk_bytes = int(chunk_rows) * _row_bytes(spec)
+            if chunk_bytes > chunk_budget:
+                emit(Diagnostic(
+                    "KG104", "warning", _node_label(graph, nid),
+                    f"pinned solve chunk of {int(chunk_rows)} rows x "
+                    f"{_row_bytes(spec)} B/row prices {chunk_bytes} bytes "
+                    f"per H2D transfer — beyond the {chunk_budget}-byte "
+                    f"chunk budget (device HBM {budget} // "
+                    f"{PlanResourcesRule.CHUNK_BUDGET_FRAC}); the solve "
+                    "would fall back to reactive OOM-halving",
+                    hint="lower KEYSTONE_SOLVE_CHUNK_ROWS, or unset it so "
+                         "the profile-guided planner sizes the chunk",
+                ))
 
     # -- KG202: cache placement advice (consumer map shared with KG103) ----
     for gid, users in consumers.items():

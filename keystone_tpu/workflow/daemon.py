@@ -1335,6 +1335,7 @@ class ServingDaemon:
         admission = self._admission.stats()
         if redact_tenants:
             admission["tenants"] = len(admission["tenants"])
+        engine_stats = g.engine.stats()
         return {
             "name": self.name,
             "generation": g.number,
@@ -1348,6 +1349,14 @@ class ServingDaemon:
             "http_port": self.http_port,
             "socket_port": self.socket_port,
             "feature_shape": list(self._feature_shape),
+            # What the memory planner chose for the live generation's
+            # engine — resolved ladder, serving precision, per-bucket
+            # planned bytes, HBM budget/headroom, trims — so an operator
+            # can see the plan on the wire without digging into the
+            # nested service stats.
+            "serve_plan": {
+                k: engine_stats[k] for k in ("ladder", "precision", "plan")
+            },
             "tier_deadline_ms": dict(self._tier_deadline_ms),
             "admission": admission,
             "outcomes": self._outcomes.snapshot(),

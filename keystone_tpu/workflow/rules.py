@@ -576,6 +576,122 @@ class AutoCacheRule(Rule):
         return Graph(ops, dps) if changed else graph
 
 
+# ---------------------------------------------------------------------------
+# Serve-ladder planning — the memory-bounded serving half of the planner
+# ---------------------------------------------------------------------------
+
+#: Fraction of the device budget the AOT-warmed serve ladder may pin:
+#: request buffers, the in-flight window, and XLA scratch live alongside
+#: the resident executables.
+SERVE_LADDER_BUDGET_FRAC = 2
+
+
+def plan_serve_ladder(
+    ladder: Sequence[int],
+    bytes_per_row: float,
+    replicas: int,
+    budget_bytes: Optional[int] = None,
+    provenance: str = "model",
+    node: str = "-",
+) -> tuple:
+    """Trim a candidate bucket ladder against an HBM budget BEFORE any
+    rung compiles ("Memory Safe Computations with XLA", arXiv:2206.14148
+    — plan memory, don't react to OOM).
+
+    Every rung of the ladder AOT-warms into a resident executable on
+    every replica, so the whole set coexists: a rung's priced residency
+    is ``bytes_per_row × rung × replicas`` (conservative — on a real
+    multi-HBM pool each replica's ladder lives on its own device; on the
+    CPU/forced-host pools the replicas genuinely share one memory).
+    Rungs are kept smallest-first while the cumulative priced bytes fit
+    ``budget_bytes`` (default ``device_hbm_bytes() //
+    SERVE_LADDER_BUDGET_FRAC``); the rungs that don't fit are trimmed
+    top-down — capping the top bucket, so oversize batches chunk through
+    a smaller rung instead of OOMing a bigger one. The smallest rung is
+    always kept (serving must stay possible; a plan still over budget at
+    one rung is counted and left for KG104 to flag).
+
+    Never silent: every trim is a counted registry decision
+    (``serve_plan`` counters + the optimizer decision ring).
+
+    Returns ``(kept_ladder, trimmed_buckets, plan_info)``.
+    """
+    from keystone_tpu.utils.metrics import (
+        device_hbm_bytes,
+        serve_plan_counters,
+    )
+
+    if budget_bytes is None:
+        budget_bytes = device_hbm_bytes() // SERVE_LADDER_BUDGET_FRAC
+    replicas = max(1, int(replicas))
+    per_bucket = {
+        int(b): int(bytes_per_row * int(b)) * replicas for b in ladder
+    }
+    kept: List[int] = []
+    trimmed: List[int] = []
+    spent = 0
+    for b in sorted(per_bucket):
+        cost = per_bucket[b]
+        if kept and spent + cost > budget_bytes:
+            trimmed.append(b)
+            continue
+        kept.append(b)
+        spent += cost
+    serve_plan_counters.bump("ladders_planned")
+    over_budget = spent > budget_bytes
+    if over_budget:
+        serve_plan_counters.bump("plans_over_budget")
+    for b in trimmed:
+        serve_plan_counters.bump("buckets_trimmed")
+        record_decision(
+            rule="PlanServeLadder", node=node,
+            action=f"trim-bucket={b}",
+            provenance=provenance,
+            reason=(
+                f"bucket {b}'s AOT-warmed executables cannot coexist "
+                f"with the smaller rungs under the HBM headroom "
+                f"({spent + per_bucket[b]} of {budget_bytes} bytes "
+                "would be resident)"
+            ),
+            cost={"bucket_bytes": per_bucket[b],
+                  "ladder_bytes_kept": spent,
+                  "budget_bytes": budget_bytes,
+                  "replicas": replicas},
+        )
+    if trimmed:
+        # Trims are always a top segment of the sorted ladder (per-rung
+        # cost is monotone in rung size and the spent total only grows),
+        # so any trim caps the top bucket.
+        serve_plan_counters.bump("top_bucket_capped")
+    record_decision(
+        rule="PlanServeLadder", node=node,
+        action=f"serve_buckets={','.join(str(b) for b in kept)}",
+        provenance=provenance,
+        reason=(
+            f"{len(kept)} rung(s) priced at {round(bytes_per_row, 1)} "
+            f"B/row x {replicas} replica(s) fit the "
+            f"{budget_bytes}-byte ladder budget"
+            + (f"; {len(trimmed)} rung(s) trimmed" if trimmed else "")
+            + ("; STILL over budget at one rung" if over_budget else "")
+        ),
+        cost={"bytes_per_row": round(float(bytes_per_row), 1),
+              "ladder_bytes": spent, "budget_bytes": budget_bytes,
+              "replicas": replicas, "trimmed": list(trimmed)},
+    )
+    plan_info = {
+        "bytes_per_row": round(float(bytes_per_row), 1),
+        "provenance": provenance,
+        "replicas": replicas,
+        "budget_bytes": int(budget_bytes),
+        "planned_bytes": int(spent),
+        "headroom_bytes": int(budget_bytes - spent),
+        "per_bucket_bytes": {b: per_bucket[b] for b in kept},
+        "trimmed": list(trimmed),
+        "over_budget": over_budget,
+    }
+    return tuple(kept), list(trimmed), plan_info
+
+
 class PlanResourcesRule(Rule):
     """Profile-guided resource planning: on a measured-profile hit, pick
     the executor worker count and the solver chunk rows BEFORE any device
@@ -601,11 +717,17 @@ class PlanResourcesRule(Rule):
     #: live alongside it.
     CHUNK_BUDGET_FRAC = 8
 
+    #: Fraction of the device budget the host prefetch queue may hold in
+    #: flight (depth × per-batch bytes): the queued batches are the next
+    #: H2D transfers, and a hand-picked depth over multi-GB batches would
+    #: stage more than the device can ever accept.
+    PREFETCH_BUDGET_FRAC = 8
+
     def __init__(self, only_if_enabled: bool = False):
         self.only_if_enabled = only_if_enabled
 
     #: The plan keys this rule owns (and therefore clears every pass).
-    PLAN_KEYS = ("exec_workers", "solve_chunk_rows")
+    PLAN_KEYS = ("exec_workers", "solve_chunk_rows", "prefetch_depth")
 
     def apply(self, graph: Graph, targets: Sequence[GraphId]) -> Graph:
         if not targets:
@@ -629,6 +751,7 @@ class PlanResourcesRule(Rule):
             return graph
         self._plan_workers(graph, targets, measured, plan)
         self._plan_chunk_rows(graph, targets, measured, plan)
+        self._plan_prefetch_depth(graph, targets, measured, plan)
         return graph
 
     @staticmethod
@@ -734,3 +857,84 @@ class PlanResourcesRule(Rule):
                       "data_shards": shards,
                       "measured_rows": rows},
             )
+
+    def _plan_prefetch_depth(self, graph, targets, measured, plan) -> None:
+        """Clamp the hand-picked prefetch depth against the budget share:
+        depth × measured per-batch bytes staged in the host queue must
+        not overrun ``device_hbm_bytes() // PREFETCH_BUDGET_FRAC`` —
+        those batches are the next H2D transfers. Only ever clamps DOWN
+        (the hand-picked ``config.prefetch_depth`` stays the ceiling);
+        an exported KEYSTONE_PREFETCH_DEPTH wins outright at the consume
+        site (loaders/stream.py)."""
+        from keystone_tpu.workflow.graph import structural_digest
+        from keystone_tpu.utils.metrics import (
+            device_hbm_bytes,
+            serve_plan_counters,
+        )
+
+        hand_picked = int(config.prefetch_depth)
+        if hand_picked <= 1:
+            return  # depth 0/1 is already minimal: nothing to clamp
+        budget = device_hbm_bytes() // self.PREFETCH_BUDGET_FRAC
+        dmemo: Dict[GraphId, Any] = {}
+        worst = None  # (per-batch bytes, node label, rows per batch)
+        for nid in graph.reachable(targets):
+            op = graph.operators[nid]
+            if not isinstance(op, EstimatorOperator):
+                continue
+            deps = graph.dependencies[nid]
+            if not deps or not isinstance(deps[0], NodeId):
+                continue
+            entry = measured.node(structural_digest(graph, deps[0], dmemo))
+            if entry is None:
+                continue
+            # out_rows/out_bytes are LAST-WRITE per-call sizes (the store
+            # contract, utils/metrics._DIGEST_DELTA_FIELDS), so `rows`
+            # already IS the measured per-batch row count — never divide
+            # by the accumulated call count.
+            rows = int(entry.get("out_rows") or 0)
+            nbytes = int(entry.get("out_bytes") or 0)
+            if rows <= 0 or nbytes <= 0:
+                continue
+            bytes_per_row = nbytes / rows
+            # The prefetcher stages whatever the producer yields: the
+            # planned solver chunk when one exists, else the measured
+            # per-call batch.
+            chunk_rows = int(plan.get("solve_chunk_rows", 0) or 0)
+            batch_rows = chunk_rows if chunk_rows else rows
+            batch_bytes = int(bytes_per_row * batch_rows)
+            if worst is None or batch_bytes > worst[0]:
+                worst = (batch_bytes, op.label(), batch_rows)
+        if worst is None:
+            return  # no measured estimator input: nothing to price
+        batch_bytes, label, batch_rows = worst
+        fit = max(1, int(budget // max(1, batch_bytes)))
+        if fit >= hand_picked:
+            record_decision(
+                rule="PlanResourcesRule", node=label,
+                action=f"prefetch_depth={hand_picked}",
+                provenance="measured",
+                reason=(
+                    f"hand-picked depth {hand_picked} x {batch_bytes} "
+                    f"B/batch fits the {budget} B prefetch budget share"
+                ),
+                cost={"batch_bytes": batch_bytes,
+                      "batch_rows": batch_rows,
+                      "prefetch_budget_bytes": budget},
+            )
+            return
+        plan["prefetch_depth"] = fit
+        serve_plan_counters.bump("prefetch_clamped")
+        record_decision(
+            rule="PlanResourcesRule", node=label,
+            action=f"prefetch_depth={fit}",
+            provenance="measured",
+            reason=(
+                f"hand-picked depth {hand_picked} x {batch_bytes} B/batch "
+                f"overruns the {budget} B prefetch budget share — clamped "
+                f"to {fit}"
+            ),
+            cost={"batch_bytes": batch_bytes, "batch_rows": batch_rows,
+                  "prefetch_budget_bytes": budget,
+                  "hand_picked_depth": hand_picked},
+        )

@@ -70,7 +70,7 @@ from typing import Any, Optional, Sequence, Tuple, Union
 import jax
 import numpy as np
 
-from keystone_tpu.config import config, pow2_ladder
+from keystone_tpu.config import config, pow2_ladder, resolved_serve_buckets
 from keystone_tpu.utils.flight_recorder import FlightRecorder, next_request_id
 from keystone_tpu.utils.metrics import (
     LatencyHistogram,
@@ -120,17 +120,170 @@ class RowDependenceError(TypeError):
     real outputs, so it is refused rather than risked."""
 
 
+#: The serving precision ladder (config.serve_precision /
+#: CompiledPipeline(precision=)): storage/accumulate mode per rung.
+#: "f32" keeps today's byte-identical path; "f32h" traces under matmul
+#: precision HIGH (3-pass); "bf16" casts the request batch to bfloat16 at
+#: the chain boundary and traces matmuls at DEFAULT precision (one MXU
+#: bf16 pass, f32 accumulation — the tests/test_bf16_mode.py contract).
+SERVE_PRECISIONS = ("f32", "f32h", "bf16")
+
+
+class PrecisionQualityError(ValueError):
+    """A non-f32 serving precision failed its per-pipeline quality gate:
+    the evaluation metric drifted beyond the declared tolerance of the
+    f32 oracle. The message names the metric and the measured delta —
+    the knob refuses rather than silently serving degraded answers."""
+
+
+#: Declared default tolerances per quality metric: how far below the f32
+#: oracle a reduced-precision serving mode may score before the knob
+#: refuses. Override per pipeline via ``qualify(tolerance=)``.
+PRECISION_QUALITY_TOLERANCES = {
+    "multiclass": 0.01,   # top-1 accuracy (or oracle agreement) drop
+    "binary": 0.01,       # accuracy drop
+    "map": 0.01,          # mean-average-precision drop
+}
+
+
+def precision_quality_delta(oracle_out, out, y=None, metric="multiclass"):
+    """Quality drop of reduced-precision serving outputs vs the f32
+    oracle's, measured with the evaluation/ metric the pipeline is
+    actually judged by. Returns ``(metric_name, delta, oracle_score,
+    score)`` — positive delta = the precision mode scores WORSE.
+
+    - ``multiclass``: top-1 accuracy against ``y`` when labels are
+      given (``MulticlassClassifierEvaluator``); without labels, 1 -
+      argmax agreement with the oracle (the oracle's predictions ARE the
+      reference).
+    - ``binary``: accuracy of ``scores > 0`` (column 0 when 2-D)
+      against ``y`` resp. the oracle's own thresholded predictions.
+    - ``map``: VOC mean average precision over multilabel ``y``
+      (labels required — AP is undefined without positives).
+    """
+    o = np.asarray(oracle_out)
+    p = np.asarray(out)
+    if o.shape != p.shape:
+        raise ValueError(
+            f"oracle/serving output shapes differ: {o.shape} vs {p.shape}"
+        )
+    if metric == "multiclass":
+        from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+
+        op_, pp = o.argmax(axis=-1), p.argmax(axis=-1)
+        classes = int(o.shape[-1])
+        ev = MulticlassClassifierEvaluator(classes)
+        if y is not None:
+            ref = ev.evaluate(op_, y).total_accuracy
+            got = ev.evaluate(pp, y).total_accuracy
+        else:
+            ref = 1.0
+            got = ev.evaluate(pp, op_).total_accuracy
+        return "multiclass_accuracy", ref - got, ref, got
+    if metric == "binary":
+        from keystone_tpu.evaluation import BinaryClassifierEvaluator
+
+        os_ = o[:, 0] if o.ndim == 2 else o
+        ps = p[:, 0] if p.ndim == 2 else p
+        ref_pred, got_pred = os_ > 0, ps > 0
+        if y is not None:
+            ref = BinaryClassifierEvaluator.evaluate(ref_pred, y).accuracy
+            got = BinaryClassifierEvaluator.evaluate(got_pred, y).accuracy
+        else:
+            ref = 1.0
+            got = BinaryClassifierEvaluator.evaluate(
+                got_pred, ref_pred
+            ).accuracy
+        return "binary_accuracy", ref - got, ref, got
+    if metric == "map":
+        from keystone_tpu.evaluation import MeanAveragePrecisionEvaluator
+
+        if y is None:
+            raise ValueError(
+                "metric='map' needs multilabel ground truth y"
+            )
+        ev = MeanAveragePrecisionEvaluator(int(o.shape[-1]))
+        ref = ev.evaluate(o, y)["map"]
+        got = ev.evaluate(p, y)["map"]
+        return "map", ref - got, ref, got
+    raise ValueError(
+        f"unknown quality metric {metric!r}; expected one of "
+        f"{tuple(PRECISION_QUALITY_TOLERANCES)}"
+    )
+
+
+def check_precision_quality(
+    oracle_out, out, y=None, metric="multiclass",
+    tolerance: Optional[float] = None, precision: str = "?",
+) -> dict:
+    """THE per-pipeline quality gate of the precision ladder: compare
+    reduced-precision serving outputs against the f32 oracle's with the
+    declared evaluation metric and raise a typed
+    ``PrecisionQualityError`` — naming the metric and the delta — when
+    the drop exceeds the declared tolerance. Returns the report dict
+    (metric, scores, delta, tolerance) on a pass."""
+    if tolerance is None:
+        if metric not in PRECISION_QUALITY_TOLERANCES:
+            raise ValueError(
+                f"unknown quality metric {metric!r}; expected one of "
+                f"{tuple(PRECISION_QUALITY_TOLERANCES)}"
+            )
+        tolerance = PRECISION_QUALITY_TOLERANCES[metric]
+    name, delta, ref, got = precision_quality_delta(
+        oracle_out, out, y=y, metric=metric
+    )
+    report = {
+        "metric": name,
+        "precision": precision,
+        "oracle_score": round(float(ref), 6),
+        "score": round(float(got), 6),
+        "quality_delta": round(float(delta), 6),
+        "tolerance": float(tolerance),
+        "within_tolerance": bool(delta <= tolerance),
+    }
+    if delta > tolerance:
+        raise PrecisionQualityError(
+            f"serve_precision={precision} refused: {name} dropped "
+            f"{delta:.6f} below the f32 oracle ({ref:.6f} -> {got:.6f}), "
+            f"beyond the declared tolerance {tolerance:g}. Serve this "
+            "pipeline at f32, or raise the tolerance explicitly if the "
+            "trade is intended."
+        )
+    return report
+
+
 # ---------------------------------------------------------------------------
 # Ladder helpers
 # ---------------------------------------------------------------------------
 
 
+def ladder_is_pinned(buckets: Optional[Sequence[int]] = None) -> bool:
+    """Whether the ladder came from an explicit source the HBM planner
+    must not touch: a ``buckets=`` argument, a live-exported
+    KEYSTONE_SERVE_BUCKETS (the env-pins convention — presence wins),
+    or a programmatic ``config.serve_buckets``. Only the unset default
+    (the pow-2 ladder) is the planner's to size."""
+    return (
+        buckets is not None
+        or resolved_serve_buckets() is not None
+        or bool(config.serve_buckets)
+    )
+
+
 def resolve_ladder(
     buckets: Optional[Sequence[int]] = None, max_batch: Optional[int] = None
 ) -> Tuple[int, ...]:
-    """The bucket ladder to serve with: explicit ``buckets`` >
-    ``config.serve_buckets`` > pow-2 up to ``max_batch`` /
-    ``config.serve_max_batch``. Always sorted, deduplicated, positive."""
+    """The bucket ladder to serve with: explicit ``buckets`` > a
+    live-exported ``KEYSTONE_SERVE_BUCKETS`` > ``config.serve_buckets``
+    > pow-2 up to ``max_batch`` / ``config.serve_max_batch``. Always
+    sorted, deduplicated, positive. An unpinned (pow-2 default) ladder
+    is additionally auto-sized against the HBM budget at engine warmup
+    (``CompiledPipeline`` + ``rules.plan_serve_ladder``); a pinned one
+    never is — see ``ladder_is_pinned``."""
+    if buckets is None:
+        env = resolved_serve_buckets()
+        if env is not None:
+            buckets = env
     if buckets is None and config.serve_buckets:
         buckets = config.serve_buckets
     if buckets is None:
@@ -326,24 +479,58 @@ def bucketed_call(transformer, X):
 
 
 def _serving_transformer(target):
-    """Lower a Pipeline / Transformer to the single jittable transformer the
-    serving engine compiles (fitting estimators and fusing the chain)."""
+    """Lower a Pipeline / Transformer to ``(transformer,
+    measured_bytes_per_row)``: the single jittable transformer the
+    serving engine compiles (fitting estimators and fusing the chain),
+    plus — when the pipeline has a measured profile in the store — the
+    summed per-row activation bytes of its recorded nodes, the
+    measured-provenance input to the HBM ladder planner (None when no
+    usable profile exists; the planner falls back to the abstract AOT
+    ``memory_analysis`` estimate)."""
     from keystone_tpu.workflow.executor import PipelineEnv
     from keystone_tpu.workflow.pipeline import Pipeline, Transformer
 
     if isinstance(target, Pipeline):
         fitted = target.fit()
-        return PipelineEnv.get().executor.serving_chain(
+        chain = PipelineEnv.get().executor.serving_chain(
             fitted.graph, fitted.source, fitted.sink
         )
+        return chain, _measured_bytes_per_row(fitted)
     if isinstance(target, Transformer):
         if not target.jittable:
             raise TypeError(
                 f"{type(target).__name__} is not jittable; the AOT serving "
                 "path compiles the whole chain as one XLA program"
             )
-        return target
+        return target, None
     raise TypeError(f"cannot serve a {type(target).__name__}")
+
+
+def _measured_bytes_per_row(fitted) -> Optional[float]:
+    """Per-row activation bytes of a fitted pipeline from its stored
+    measured profile: the sum of ``out_bytes / out_rows`` over every
+    recorded node — a conservative all-activations-resident price (the
+    high-water is at most this), matched by the same
+    ``pipeline_profile_digest`` key the optimizer rules consume. None
+    when no store is configured, no entry matches, or no row carries
+    usable bytes/rows."""
+    from keystone_tpu.workflow.profile_store import (
+        lookup_measured,
+        pipeline_profile_digest,
+    )
+
+    prof = lookup_measured(
+        pipeline_profile_digest(fitted.graph, fitted.sink)
+    )
+    if prof is None:
+        return None
+    total = 0.0
+    for entry in prof.digests.values():
+        rows = int(entry.get("out_rows") or 0)
+        nbytes = int(entry.get("out_bytes") or 0)
+        if rows > 0 and nbytes > 0:
+            total += nbytes / rows
+    return total or None
 
 
 class _Replica:
@@ -518,16 +705,33 @@ class CompiledPipeline:
         devices=None,
         inflight: Optional[int] = None,
         name: Optional[str] = None,
+        precision: Optional[str] = None,
     ):
-        self.transformer = _serving_transformer(target)
+        self.transformer, self._measured_bpr = _serving_transformer(target)
         check_row_independent(self.transformer)
         self.ladder = resolve_ladder(buckets, max_batch)
         self.max_batch = self.ladder[-1]
+        # An explicit ladder (buckets=, KEYSTONE_SERVE_BUCKETS, or
+        # config.serve_buckets) is a pin the HBM planner never touches;
+        # only the unset pow-2 default is the planner's to size — at
+        # warmup, when the traffic signature prices the rungs.
+        self._ladder_pinned = ladder_is_pinned(buckets)
+        self._base_ladder = self.ladder  # the pre-plan candidate rungs
+        self._planned: Optional[dict] = None
         if donate is None:
             donate = jax.default_backend() != "cpu"
         self.donate = bool(donate)
+        # `is None`, not truthiness: the config default is the knob.
+        self.precision = (
+            config.serve_precision if precision is None else str(precision)
+        )
+        if self.precision not in SERVE_PRECISIONS:
+            raise ValueError(
+                f"serve precision must be one of {SERVE_PRECISIONS}, got "
+                f"{self.precision!r}"
+            )
         self._jit = jax.jit(
-            self.transformer.apply_batch,
+            self._serve_fn(),
             donate_argnums=(0,) if self.donate else (),
         )
         self.devices = resolve_serve_devices(devices)
@@ -573,6 +777,39 @@ class CompiledPipeline:
     def dtype(self):
         return self._dtype
 
+    def _serve_fn(self):
+        """The function every bucket executable compiles, at the engine's
+        precision. ``f32`` returns ``apply_batch`` ITSELF — the
+        pre-precision-ladder path, byte for byte, so the default mode is
+        bit-identical by construction, not by test. ``f32h`` traces the
+        chain under matmul precision HIGH (3-pass bf16 emulation; a
+        numeric no-op on CPU). ``bf16`` casts the request batch to
+        bfloat16 at the chain boundary (bf16 storage — on the MXU every
+        matmul then runs its native one-pass bf16 multiply with f32
+        accumulation, matmul precision DEFAULT) while fitted weights
+        stay f32; any bf16 output leaf is cast back to the request dtype
+        so downstream consumers see the same signature as f32 serving."""
+        apply_batch = self.transformer.apply_batch
+        if self.precision == "f32":
+            return apply_batch
+        if self.precision == "f32h":
+            def serve_f32h(X):
+                with jax.default_matmul_precision("high"):
+                    return apply_batch(X)
+            return serve_f32h
+
+        def serve_bf16(X):
+            import jax.numpy as jnp
+
+            dt = X.dtype
+            with jax.default_matmul_precision("default"):
+                out = apply_batch(X.astype(jnp.bfloat16))
+            return jax.tree_util.tree_map(
+                lambda a: a.astype(dt) if a.dtype == jnp.bfloat16 else a,
+                out,
+            )
+        return serve_bf16
+
     # -- warmup ------------------------------------------------------------
 
     def warmup(
@@ -612,10 +849,20 @@ class CompiledPipeline:
                 self.feature_shape is not None
                 and (self.feature_shape, self._dtype) != (feature_shape, dt)
             ):
-                # New traffic signature: previous executables can't serve it.
+                # New traffic signature: previous executables can't serve
+                # it — and the ladder plan was priced at the old shape, so
+                # the candidate rungs go back through the planner too.
                 for r in self.replicas:
                     r.executables.clear()
+                self._planned = None
+                self.ladder = self._base_ladder
+                self.max_batch = self.ladder[-1]
             self.feature_shape, self._dtype = feature_shape, dt
+            # Size the ladder against the HBM budget BEFORE any rung
+            # compiles (arXiv:2206.14148: plan memory, don't react): only
+            # now is the traffic signature known, so per-rung bytes can
+            # be priced. Pinned ladders and a disabled planner skip this.
+            self._plan_ladder_locked()
             t0 = time.perf_counter()
             targets = (
                 self.replicas if replica is None
@@ -645,6 +892,139 @@ class CompiledPipeline:
         self.compiles_by_bucket[b] = self.compiles_by_bucket.get(b, 0) + 1
         serving_counters.record_compile(b)
         return replica.executables[b]
+
+    # -- HBM ladder planning -----------------------------------------------
+
+    def _plan_ladder_locked(self) -> None:
+        """Auto-size the bucket ladder against the HBM budget (caller
+        holds the lock; the traffic signature is set). One plan per
+        signature; every trim is a counted ``serve_plan`` registry
+        decision plus an optimizer decision-ring entry — never silent.
+        Pinned ladders (explicit buckets / KEYSTONE_SERVE_BUCKETS /
+        config.serve_buckets) and a disabled planner
+        (``config.plan_resources``) are recorded and left untouched."""
+        from keystone_tpu.utils.metrics import serve_plan_counters
+
+        if self._planned is not None:
+            return
+        if self._ladder_pinned:
+            serve_plan_counters.bump("ladders_pinned")
+            self._planned = {"enabled": False, "reason": "ladder pinned"}
+            return
+        if not config.plan_resources:
+            self._planned = {
+                "enabled": False, "reason": "config.plan_resources off",
+            }
+            return
+        bpr, provenance = self._bytes_per_row_locked()
+        if bpr is None:
+            from keystone_tpu.workflow.rules import record_decision
+
+            serve_plan_counters.bump("plans_unpriced")
+            record_decision(
+                rule="PlanServeLadder", node=self.name,
+                action="serve_buckets=unplanned", provenance="model",
+                reason=(
+                    "no measured profile and no abstract memory estimate "
+                    "— the hand-picked ladder serves as-is"
+                ),
+            )
+            self._planned = {"enabled": False, "reason": "unpriced"}
+            return
+        from keystone_tpu.workflow.rules import plan_serve_ladder
+
+        kept, _trimmed, info = plan_serve_ladder(
+            self._base_ladder, bpr, len(self.replicas),
+            provenance=provenance, node=self.name,
+        )
+        self.ladder = kept
+        self.max_batch = kept[-1]
+        self._planned = dict(info, enabled=True)
+
+    def _bytes_per_row_locked(self):
+        """Per-row resident bytes of one serve call, provenance-laddered
+        like every planner price (measured → model): the stored measured
+        profile's summed activation bytes/row when the pipeline has one,
+        else the abstract AOT ``memory_analysis`` of the SMALLEST rung
+        (argument + output + temp bytes — an executable the warmup would
+        compile anyway, and ``node_cost_analysis`` memoizes it), else an
+        ``eval_shape`` input+output estimate (no compile). ``(None,
+        provenance)`` when nothing can price it."""
+        if self._measured_bpr:
+            return float(self._measured_bpr), "measured"
+        from keystone_tpu.utils.metrics import node_cost_analysis
+
+        b0 = self._base_ladder[0]
+        spec = jax.ShapeDtypeStruct(
+            (b0,) + self.feature_shape, self._dtype
+        )
+        est = node_cost_analysis(self.transformer, spec) or {}
+        total = sum(
+            est.get(k) or 0.0
+            for k in ("argument_bytes", "output_bytes", "temp_bytes")
+        )
+        if total > 0:
+            return total / b0, "model"
+        try:
+            out = jax.eval_shape(self.transformer.apply_batch, spec)
+            out_bytes = sum(
+                int(np.prod(leaf.shape[1:], dtype=np.int64))
+                * np.dtype(leaf.dtype).itemsize
+                for leaf in jax.tree_util.tree_leaves(out)
+                if getattr(leaf, "shape", None)
+            )
+            in_bytes = (
+                int(np.prod(self.feature_shape, dtype=np.int64))
+                * self._dtype.itemsize
+            )
+            return float(in_bytes + out_bytes), "model"
+        except Exception:  # lint: broad-ok abstract eval is best-effort; unpriced, not fatal
+            return None, "model"
+
+    # -- precision quality gate --------------------------------------------
+
+    def qualify(
+        self,
+        X,
+        y=None,
+        metric: str = "multiclass",
+        tolerance: Optional[float] = None,
+    ) -> dict:
+        """The per-pipeline quality gate of the precision ladder: serve
+        ``X`` through THIS engine and through a fresh f32 oracle engine
+        on the same transformer and ladder, score both with the declared
+        ``evaluation/`` metric (against labels ``y`` when given, else
+        against the oracle's own predictions), and raise a typed
+        ``PrecisionQualityError`` — naming the metric and the measured
+        delta — when the drop exceeds the declared tolerance
+        (``PRECISION_QUALITY_TOLERANCES[metric]`` unless overridden).
+        Returns the quality report on a pass; for an f32 engine the gate
+        is the identity check (delta 0) and always passes."""
+        X = np.asarray(X)
+        if self.precision == "f32":
+            out = self(X)
+            return check_precision_quality(
+                out, out, y=y, metric=metric, tolerance=tolerance,
+                precision=self.precision,
+            )
+        mine = self(X)  # lazily warms this engine off X's signature
+        # The throwaway oracle warms ONE rung — the bucket the probe
+        # needs (its own top bucket when the probe is oversize, so both
+        # engines chunk at the same boundaries) — not the whole ladder:
+        # a probe never touches the other rungs, and the cold-bucket
+        # path would compile on demand anyway.
+        probe_bucket = bucket_for(X.shape[0], self.ladder) or self.max_batch
+        oracle = CompiledPipeline(
+            self.transformer,
+            buckets=[probe_bucket],
+            devices=self.devices[:1],
+            precision="f32",
+            name=f"{self.name}-f32-oracle",
+        ).warmup(self.feature_shape, dtype=self._dtype)
+        return check_precision_quality(
+            oracle(X), mine, y=y, metric=metric, tolerance=tolerance,
+            precision=self.precision,
+        )
 
     # -- hot path ----------------------------------------------------------
 
@@ -833,6 +1213,11 @@ class CompiledPipeline:
         return {
             "name": self.name,
             "ladder": list(self.ladder),
+            "precision": self.precision,
+            # What the HBM planner chose (per-bucket planned bytes,
+            # budget, headroom, trims) — or why it didn't run (pinned /
+            # disabled / unpriced). None until warmup prices the plan.
+            "plan": dict(self._planned) if self._planned else None,
             "devices": [d.id for d in self.devices],
             "inflight": self.inflight,
             "compile_count": self.compile_count,

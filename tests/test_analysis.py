@@ -312,3 +312,68 @@ def test_lint_report_demo_in_process():
     assert verdict["canonical_clean"], verdict
     assert verdict["control_refused"], verdict
     assert "KG002" in verdict["control_rules"]
+
+
+# ---------------------------------------------------------------------------
+# KG104: pinned memory plan priced beyond the HBM budget (shape-only)
+# ---------------------------------------------------------------------------
+
+
+def test_kg104_flags_over_budget_pinned_ladder(monkeypatch):
+    """A pinned serve ladder whose priced residency (ladder x replicas x
+    dtype) exceeds the ladder budget share is flagged statically — no
+    execution, no compile, no device work (the Boom-estimator test above
+    already pins that lint never executes)."""
+    config.serve_buckets = (1024,)
+    monkeypatch.setattr(config, "hbm_budget_bytes", 50_000)
+    hits = _fused_head().lint(example=(8,), have_ladder=True).by_rule(
+        "KG104"
+    )
+    assert hits and hits[0].severity == "warning"
+    assert "serve ladder" in hits[0].message
+    assert "1024" in hits[0].message
+    assert "KEYSTONE_SERVE_BUCKETS" in hits[0].hint
+
+
+def test_kg104_silent_on_in_budget_plans():
+    """The other way: an in-budget pinned ladder — and the unpinned
+    default (no ladder configured at all) — stay silent."""
+    p = _fused_head()
+    assert not p.lint(example=(8,), have_ladder=True).by_rule("KG104")
+    config.serve_buckets = (8, 64)  # tiny ladder, default 12 GiB budget
+    assert not p.lint(example=(8,), have_ladder=True).by_rule("KG104")
+
+
+def test_kg104_flags_over_budget_pinned_solve_chunk(monkeypatch):
+    from keystone_tpu.nodes.learning import LinearMapEstimator
+
+    X = np.zeros((4, 8), np.float32)
+    y = np.zeros((4, 3), np.float32)
+    p = L2Normalizer().and_then(LinearMapEstimator(lam=1e-3), X, y)
+    monkeypatch.setattr(config, "solve_chunk_rows", 1 << 22)
+    monkeypatch.setattr(config, "hbm_budget_bytes", 1 << 20)
+    hits = p.lint(example=(8,), have_ladder=True).by_rule("KG104")
+    assert hits and hits[0].severity == "warning"
+    assert "solve chunk" in hits[0].message
+    assert "OOM-halving" in hits[0].message
+    # Unpinned chunk (the planner's to size): silent under any budget.
+    monkeypatch.setattr(config, "solve_chunk_rows", 0)
+    assert not p.lint(example=(8,), have_ladder=True).by_rule("KG104")
+
+
+def test_kg104_env_pin_reads_live(monkeypatch):
+    """The env-pins live-read convention: an exported
+    KEYSTONE_SOLVE_CHUNK_ROWS=0 retires a programmatic pin, and an
+    exported value prices instead of the config snapshot."""
+    from keystone_tpu.nodes.learning import LinearMapEstimator
+
+    X = np.zeros((4, 8), np.float32)
+    y = np.zeros((4, 3), np.float32)
+    p = L2Normalizer().and_then(LinearMapEstimator(lam=1e-3), X, y)
+    monkeypatch.setattr(config, "solve_chunk_rows", 1 << 22)
+    monkeypatch.setattr(config, "hbm_budget_bytes", 1 << 20)
+    monkeypatch.setenv("KEYSTONE_SOLVE_CHUNK_ROWS", "0")
+    assert not p.lint(example=(8,), have_ladder=True).by_rule("KG104")
+    monkeypatch.setenv("KEYSTONE_SOLVE_CHUNK_ROWS", str(1 << 22))
+    monkeypatch.setattr(config, "solve_chunk_rows", 0)
+    assert p.lint(example=(8,), have_ladder=True).by_rule("KG104")

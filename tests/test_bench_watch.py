@@ -239,3 +239,32 @@ def test_unreadable_history_fails_loudly(history):
     with pytest.raises(RuntimeError, match="unreadable history row"):
         bench_watch.run(str(history))
     assert bench_watch.main(["--root", str(history)]) == 2
+
+
+def test_serve_precision_family_judged(history):
+    """The serve_precision family's three regression axes: speedup down,
+    quality_delta up (the LOWER_BETTER fragment), and the knob-off
+    bit-identity flag flipping true -> false."""
+    def mutate(row):
+        row["speedup"]["throughput"] /= 3.0
+        row["quality"]["quality_delta"] += 0.5
+        row["bit_identical_f32"] = False
+
+    _append_serve_row(history, mutate, metric="serve_precision")
+    result = bench_watch.run(str(history))
+    assert not result["ok"]
+    names = {v["series"] for v in result["regressions"]}
+    assert "serve:serve_precision:speedup.throughput" in names
+    assert "serve:serve_precision:quality.quality_delta" in names
+    assert "serve:serve_precision:bit_identical_f32" in names
+
+
+def test_serve_precision_healthy_rerun_passes(history):
+    """A same-fingerprint re-run inside the noise band gates green."""
+    def mutate(row):
+        row["speedup"]["throughput"] *= 1.05
+        row["planned_bf16"]["p99_ms"] *= 1.1
+
+    _append_serve_row(history, mutate, metric="serve_precision")
+    result = bench_watch.run(str(history))
+    assert result["ok"], result["regressions"]

@@ -1,0 +1,636 @@
+"""Memory-bounded serving: HBM-planned bucket ladders + the serving
+precision ladder with quality gates (ROADMAP item 4).
+
+Pinned here, both ways each:
+
+1. ``rules.plan_serve_ladder`` — rungs kept smallest-first under the
+   budget, trims top-down (the top bucket caps), the smallest rung never
+   trims, every trim is a counted ``serve_plan`` registry decision plus
+   an optimizer decision-ring entry (never silent);
+2. engine warmup planning — an UNPINNED (pow-2 default) ladder auto-sizes
+   against the HBM budget at warmup; explicit ``buckets=``, a
+   live-exported KEYSTONE_SERVE_BUCKETS, and ``config.plan_resources =
+   False`` all pin the ladder untouched; measured-profile provenance
+   beats the abstract AOT estimate;
+3. the oversize-batch sharding path under a planner-TRIMMED ladder:
+   chunks land on the shared rung, outputs BIT-identical to the same
+   batch served on the hand-picked ladder, zero silent fallbacks
+   (counter-verified: every call on a ladder bucket, zero post-warmup
+   compiles);
+4. the precision ladder — ``f32`` is the legacy path ITSELF (the serve
+   fn is ``apply_batch``, identity-pinned), ``f32h`` is bit-identical on
+   CPU, ``bf16`` differs-but-tracks, and the per-pipeline quality gate
+   (``qualify``/``check_precision_quality``) passes a trained head and
+   REFUSES with a typed error naming the metric and delta;
+5. the prefetch-depth satellite — env pin (incl. explicit 0) > session
+   plan clamp > config, and ``PlanResourcesRule`` clamps from measured
+   per-batch bytes with a logged decision;
+6. the plan/precision observability surface — engine + service stats and
+   the daemon ``/stats`` endpoint;
+7. the ``bench_serve --precision`` harness in-process: every hard gate
+   green at a reduced size.
+"""
+
+import importlib.util
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from keystone_tpu.config import config
+from keystone_tpu.utils.metrics import (
+    metrics_registry,
+    serve_plan_counters,
+    serving_counters,
+)
+from keystone_tpu.workflow import rules
+from keystone_tpu.workflow.executor import PipelineEnv
+from keystone_tpu.workflow.serving import (
+    PRECISION_QUALITY_TOLERANCES,
+    CompiledPipeline,
+    PipelineService,
+    PrecisionQualityError,
+    check_precision_quality,
+    ladder_is_pinned,
+    precision_quality_delta,
+    resolve_ladder,
+)
+
+
+@pytest.fixture(autouse=True)
+def _restore_knobs():
+    prior = (
+        config.hbm_budget_bytes,
+        config.plan_resources,
+        config.serve_precision,
+        config.serve_buckets,
+        config.prefetch_depth,
+    )
+    yield
+    (
+        config.hbm_budget_bytes,
+        config.plan_resources,
+        config.serve_precision,
+        config.serve_buckets,
+        config.prefetch_depth,
+    ) = prior
+
+
+def _head(d=8, D=16, k=3, seed=0):
+    """The canonical fused serving head (test_serving.py shape)."""
+    from keystone_tpu.nodes.learning.linear_mapper import LinearMapper
+    from keystone_tpu.nodes.stats.hellinger import SignedHellingerMapper
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+    from keystone_tpu.nodes.stats.random_features import CosineRandomFeatures
+    from keystone_tpu.nodes.stats.scalers import StandardScalerModel
+    from keystone_tpu.workflow.pipeline import FusedTransformer
+
+    rng = np.random.default_rng(seed)
+    return FusedTransformer([
+        StandardScalerModel(
+            rng.normal(size=d).astype(np.float32),
+            (1.0 + rng.uniform(size=d)).astype(np.float32),
+        ),
+        CosineRandomFeatures.create(d, D, seed=seed),
+        SignedHellingerMapper(),
+        L2Normalizer(),
+        LinearMapper(rng.normal(size=(D, k)).astype(np.float32)),
+    ])
+
+
+def _counters():
+    return dict(serve_plan_counters.snapshot())
+
+
+def _delta(before, key):
+    return _counters().get(key, 0) - before.get(key, 0)
+
+
+# ---------------------------------------------------------------------------
+# plan_serve_ladder: the pure planner
+# ---------------------------------------------------------------------------
+
+
+def test_plan_serve_ladder_trims_top_down_under_budget():
+    rules.clear_decisions()
+    before = _counters()
+    # 100 B/row x 2 replicas: rungs cost 200/400/800/1600/3200; a 1500 B
+    # budget keeps (1, 2, 4) = 1400 and trims 8, 16.
+    kept, trimmed, info = rules.plan_serve_ladder(
+        (1, 2, 4, 8, 16), 100.0, 2, budget_bytes=1500,
+        provenance="measured", node="t",
+    )
+    assert kept == (1, 2, 4)
+    assert trimmed == [8, 16]
+    assert info["planned_bytes"] == 1400
+    assert info["headroom_bytes"] == 100
+    assert info["per_bucket_bytes"] == {1: 200, 2: 400, 4: 800}
+    assert not info["over_budget"]
+    assert _delta(before, "buckets_trimmed") == 2
+    assert _delta(before, "top_bucket_capped") == 1
+    assert _delta(before, "ladders_planned") == 1
+    decisions = [d for d in rules.optimizer_decisions()
+                 if d.rule == "PlanServeLadder"]
+    trims = [d for d in decisions if d.action.startswith("trim-bucket=")]
+    assert {d.action for d in trims} == {"trim-bucket=8", "trim-bucket=16"}
+    assert all(d.provenance == "measured" for d in trims)
+    (summary,) = [d for d in decisions
+                  if d.action == "serve_buckets=1,2,4"]
+    assert "2 rung(s) trimmed" in summary.reason
+
+
+def test_plan_serve_ladder_never_trims_the_last_rung():
+    before = _counters()
+    kept, trimmed, info = rules.plan_serve_ladder(
+        (4, 8), 1000.0, 1, budget_bytes=1,
+    )
+    assert kept == (4,)  # serving must stay possible
+    assert trimmed == [8]
+    assert info["over_budget"]
+    assert _delta(before, "plans_over_budget") == 1
+
+
+# ---------------------------------------------------------------------------
+# Engine warmup planning: unpinned sized, pinned untouched
+# ---------------------------------------------------------------------------
+
+
+def test_warmup_plans_unpinned_ladder_against_budget():
+    before = _counters()
+    # The abstract AOT estimate prices this head at a few KB/row; a tiny
+    # budget must cap the pow-2 ladder below its top.
+    config.hbm_budget_bytes = 4096
+    cp = CompiledPipeline(_head(), max_batch=64, devices=1, name="sp-t1")
+    assert cp.ladder == (1, 2, 4, 8, 16, 32, 64)  # planned at WARMUP
+    cp.warmup((8,))
+    plan = cp.stats()["plan"]
+    assert plan["enabled"] and plan["provenance"] == "model"
+    assert plan["trimmed"], "tiny budget must trim rungs"
+    assert cp.ladder[-1] < 64 and cp.max_batch == cp.ladder[-1]
+    assert plan["planned_bytes"] <= plan["budget_bytes"]
+    assert set(map(int, plan["per_bucket_bytes"])) == set(cp.ladder)
+    assert _delta(before, "buckets_trimmed") == len(plan["trimmed"])
+    # Serving still works end to end on the trimmed ladder.
+    out = cp(np.ones((5, 8), np.float32))
+    assert out.shape == (5, 3)
+
+
+def test_ample_budget_keeps_every_rung():
+    cp = CompiledPipeline(_head(), max_batch=16, devices=1, name="sp-t2")
+    cp.warmup((8,))
+    plan = cp.stats()["plan"]
+    assert plan["enabled"] and plan["trimmed"] == []
+    assert cp.ladder == (1, 2, 4, 8, 16)
+
+
+def test_explicit_buckets_pin_the_ladder():
+    before = _counters()
+    config.hbm_budget_bytes = 1
+    cp = CompiledPipeline(
+        _head(), buckets=[8, 64], devices=1, name="sp-t3"
+    ).warmup((8,))
+    assert cp.ladder == (8, 64)  # untouched under an impossible budget
+    assert cp.stats()["plan"] == {"enabled": False,
+                                  "reason": "ladder pinned"}
+    assert _delta(before, "ladders_pinned") == 1
+    assert _delta(before, "buckets_trimmed") == 0
+
+
+def test_env_exported_buckets_pin_the_ladder(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_SERVE_BUCKETS", "4,32")
+    assert resolve_ladder() == (4, 32)
+    assert ladder_is_pinned()
+    config.hbm_budget_bytes = 1
+    cp = CompiledPipeline(_head(), devices=1, name="sp-t4").warmup((8,))
+    assert cp.ladder == (4, 32)
+    assert cp.stats()["plan"]["reason"] == "ladder pinned"
+
+
+def test_plan_resources_off_skips_planning():
+    config.plan_resources = False
+    config.hbm_budget_bytes = 1
+    cp = CompiledPipeline(
+        _head(), max_batch=16, devices=1, name="sp-t5"
+    ).warmup((8,))
+    assert cp.ladder == (1, 2, 4, 8, 16)
+    assert cp.stats()["plan"]["reason"] == "config.plan_resources off"
+
+
+def test_measured_profile_prices_the_plan(monkeypatch):
+    """A stored measured profile beats the abstract estimate: the plan's
+    provenance is 'measured' and its bytes/row is the profile's summed
+    activation bytes per row."""
+    from keystone_tpu.workflow import profile_store, serving
+    from keystone_tpu.workflow.pipeline import Pipeline
+
+    fake = profile_store.StoredProfile(
+        pipeline_digest="d", fingerprint={},
+        digests={
+            "a": {"out_rows": 10, "out_bytes": 1000},   # 100 B/row
+            "b": {"out_rows": 10, "out_bytes": 280},    # 28 B/row
+            "c": {"out_rows": 0, "out_bytes": 999},     # unusable: skipped
+        },
+    )
+    monkeypatch.setattr(
+        profile_store, "lookup_measured", lambda digest: fake
+    )
+    monkeypatch.setattr(
+        profile_store, "pipeline_profile_digest", lambda g, s: "d"
+    )
+    pipe = _head().to_pipeline()
+    assert isinstance(pipe, Pipeline)
+    cp = CompiledPipeline(pipe, max_batch=8, devices=1, name="sp-t6")
+    assert cp._measured_bpr == 128.0
+    cp.warmup((8,))
+    plan = cp.stats()["plan"]
+    assert plan["provenance"] == "measured"
+    assert plan["bytes_per_row"] == 128.0
+
+
+def test_replan_on_new_traffic_signature():
+    """A re-warm at a new signature re-prices from the ORIGINAL candidate
+    rungs (a trimmed ladder must not monotonically shrink across
+    signatures)."""
+    config.hbm_budget_bytes = 4096
+    cp = CompiledPipeline(_head(d=8), max_batch=64, devices=1,
+                          name="sp-t7").warmup((8,))
+    trimmed_first = list(cp.stats()["plan"]["trimmed"])
+    assert trimmed_first
+    config.hbm_budget_bytes = 12 * (1 << 30)
+    cp.warmup((8,), dtype=np.float16)  # a genuinely new signature
+    assert cp.ladder == (1, 2, 4, 8, 16, 32, 64)
+    assert cp.stats()["plan"]["trimmed"] == []
+
+
+# ---------------------------------------------------------------------------
+# Oversize sharding under a trimmed ladder: bit-identity, no fallbacks
+# ---------------------------------------------------------------------------
+
+
+def test_oversize_batch_on_trimmed_ladder_bit_identical(rng):
+    """The satellite gate: a planner-trimmed ladder serves an oversize
+    batch bit-identically to the hand-picked ladder — the chunks land on
+    the shared top rung — with zero silent fallbacks (every call a
+    ladder bucket, zero post-warmup compiles), including through the
+    replica-pool sharding path."""
+    d = 8
+    # Price so the pow-2-to-64 ladder trims to top out at 8: the head
+    # prices ~600 B/row abstractly; rungs 1+2+4+8 cost ~9KB.
+    config.hbm_budget_bytes = 2 * 10000
+    trimmed = CompiledPipeline(
+        _head(d=d), max_batch=64, devices=2, name="sp-o1"
+    ).warmup((d,))
+    assert trimmed.ladder[-1] == 8, trimmed.stats()["plan"]
+    handpicked = CompiledPipeline(
+        _head(d=d), buckets=[8], devices=1, name="sp-o2"
+    ).warmup((d,))
+    compiles_before = (trimmed.compile_count, handpicked.compile_count)
+    serving_before = serving_counters.snapshot()
+    for n in (3, 8, 16, 48):  # in-ladder and oversize (chunked) batches
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        a, b = trimmed(X), handpicked(X)
+        assert np.array_equal(a, b), n
+    # Oversize chunks spread over the pool (the sharding path ran).
+    dispatches = trimmed.stats()["replica_dispatches"]
+    assert sum(1 for v in dispatches.values() if v > 0) == 2
+    # Counter-verified no silent fallback: zero new compiles (nothing
+    # served off-ladder or re-traced), and every recorded call landed on
+    # a bucket of the trimmed ladder.
+    assert (trimmed.compile_count, handpicked.compile_count) \
+        == compiles_before
+    hits_before = serving_before["bucket_hits"]
+    new_hits = {
+        b: n - hits_before.get(b, 0)
+        for b, n in serving_counters.snapshot()["bucket_hits"].items()
+        if n - hits_before.get(b, 0) > 0
+    }
+    # ...and on nothing outside the two engines' ladders: the oversize
+    # chunks all rode the shared top rung (8), the in-ladder batch its
+    # own rung — no per-shape escape hatch served anything.
+    assert set(new_hits) <= set(trimmed.ladder) | set(handpicked.ladder)
+    assert 8 in new_hits
+
+
+# ---------------------------------------------------------------------------
+# Precision ladder
+# ---------------------------------------------------------------------------
+
+
+def test_f32_serve_fn_is_apply_batch_itself():
+    """The knob-off contract by construction: at f32 the compiled fn IS
+    the transformer's apply_batch — no wrapper, no cast, byte-for-byte
+    the pre-precision-ladder path. The default mode is f32."""
+    head = _head()
+    cp = CompiledPipeline(head, max_batch=8, devices=1, name="sp-p1")
+    assert cp.precision == "f32"
+    assert cp._serve_fn() == head.apply_batch  # the same bound method
+    cp32 = CompiledPipeline(head, max_batch=8, devices=1, name="sp-p2",
+                            precision="f32")
+    assert cp32._serve_fn() == head.apply_batch
+
+
+def test_config_knob_selects_engine_default():
+    config.serve_precision = "bf16"
+    cp = CompiledPipeline(_head(), max_batch=8, devices=1, name="sp-p3")
+    assert cp.precision == "bf16"
+
+
+def test_invalid_precision_refused():
+    with pytest.raises(ValueError, match="serve precision"):
+        CompiledPipeline(_head(), max_batch=8, devices=1,
+                         precision="fp8", name="sp-p4")
+
+
+def test_bf16_differs_but_tracks_and_stays_f32_out(rng):
+    d = 8
+    X = rng.normal(size=(5, d)).astype(np.float32)
+    f32 = CompiledPipeline(_head(d=d), max_batch=8, devices=1,
+                           name="sp-p5").warmup((d,))
+    b16 = CompiledPipeline(_head(d=d), max_batch=8, devices=1,
+                           precision="bf16", name="sp-p6").warmup((d,))
+    of, ob = f32(X), b16(X)
+    assert ob.dtype == np.float32  # boundary cast back
+    assert not np.array_equal(of, ob)  # the knob really engages
+    denom = max(np.abs(of).max(), 1e-6)
+    assert np.abs(of - ob).max() / denom < 3e-2  # bf16-rounding scale
+
+
+def test_f32h_bit_identical_on_cpu(rng):
+    """Matmul precision HIGH only changes TPU gemm pass counts; on the
+    CPU backend the mode must be a numeric no-op (the bench's
+    fingerprint-gated expectation)."""
+    import jax
+
+    if jax.default_backend() != "cpu":
+        pytest.skip("CPU-only expectation")
+    d = 8
+    X = rng.normal(size=(5, d)).astype(np.float32)
+    f32 = CompiledPipeline(_head(d=d), max_batch=8, devices=1,
+                           name="sp-p7").warmup((d,))
+    h = CompiledPipeline(_head(d=d), max_batch=8, devices=1,
+                         precision="f32h", name="sp-p8").warmup((d,))
+    assert np.array_equal(f32(X), h(X))
+
+
+# ---------------------------------------------------------------------------
+# Quality gates
+# ---------------------------------------------------------------------------
+
+
+def _trained_head(d=16, features=64, classes=4, seed=0,
+                  n_train=512, n_eval=256):
+    """A head whose linear map is least-squares trained on separable
+    synthetic classes — argmax margins far above quantization noise, the
+    scenario a precision ladder actually serves."""
+    from keystone_tpu.nodes.learning.linear_mapper import LinearMapper
+    from keystone_tpu.workflow.pipeline import FusedTransformer
+
+    base = _head(d=d, D=features, k=classes, seed=seed)
+    prefix = FusedTransformer(base.stages[:-1])
+    rng = np.random.default_rng(seed + 1)
+    centroids = rng.normal(size=(classes, d)).astype(np.float32) * 2.0
+    y = rng.integers(0, classes, n_train)
+    X = (centroids[y] + 0.3 * rng.normal(size=(n_train, d))).astype(
+        np.float32
+    )
+    F = np.asarray(prefix.batch_call(X))
+    W, *_ = np.linalg.lstsq(
+        F, np.eye(classes, dtype=np.float32)[y], rcond=None
+    )
+    chain = FusedTransformer(
+        base.stages[:-1] + [LinearMapper(W.astype(np.float32))]
+    )
+    ye = rng.integers(0, classes, n_eval)
+    Xe = (centroids[ye] + 0.3 * rng.normal(size=(n_eval, d))).astype(
+        np.float32
+    )
+    return chain, Xe, ye
+
+
+def test_qualify_passes_trained_head_within_declared_tolerance():
+    chain, Xe, ye = _trained_head()
+    cp = CompiledPipeline(chain, max_batch=256, devices=1,
+                          precision="bf16", name="sp-q1")
+    report = cp.qualify(Xe, y=ye, metric="multiclass")
+    assert report["within_tolerance"]
+    assert report["tolerance"] == PRECISION_QUALITY_TOLERANCES["multiclass"]
+    assert report["quality_delta"] <= report["tolerance"]
+    assert report["metric"] == "multiclass_accuracy"
+
+
+def test_qualify_refuses_naming_metric_and_delta(rng):
+    """The knob must REFUSE, typed, naming the metric and the measured
+    delta — a random (margin-free) head at zero tolerance reliably
+    breaches."""
+    cp = CompiledPipeline(_head(d=16, D=64, k=4), max_batch=64, devices=1,
+                          precision="bf16", name="sp-q2")
+    X = rng.normal(size=(64, 16)).astype(np.float32)
+    with pytest.raises(PrecisionQualityError,
+                       match=r"multiclass_accuracy dropped 0\.\d+"):
+        cp.qualify(X, tolerance=0.0)
+    try:
+        cp.qualify(X, tolerance=0.0)
+    except PrecisionQualityError as e:
+        assert "serve_precision=bf16" in str(e)
+        assert "tolerance" in str(e)
+
+
+def test_qualify_f32_is_the_identity_gate(rng):
+    cp = CompiledPipeline(_head(), max_batch=8, devices=1,
+                          name="sp-q3").warmup((8,))
+    report = cp.qualify(rng.normal(size=(5, 8)).astype(np.float32),
+                        tolerance=0.0)
+    assert report["quality_delta"] == 0.0 and report["within_tolerance"]
+
+
+def test_check_precision_quality_binary_and_map(rng):
+    scores = rng.normal(size=(200, 4)).astype(np.float32)
+    # binary, no labels: oracle's own thresholded predictions are the
+    # reference; one flipped sign near zero = a measurable delta.
+    degraded = scores.copy()
+    flip = np.argsort(np.abs(scores[:, 0]))[:10]
+    degraded[flip, 0] = -scores[flip, 0]
+    name, delta, ref, got = precision_quality_delta(
+        scores, degraded, metric="binary"
+    )
+    assert name == "binary_accuracy" and ref == 1.0
+    assert abs(delta - 10 / 200) < 1e-9
+    # map needs multilabel ground truth
+    y = (rng.uniform(size=(200, 4)) < 0.3)
+    rep = check_precision_quality(
+        scores, scores, y=y, metric="map", tolerance=0.0,
+        precision="bf16",
+    )
+    assert rep["metric"] == "map" and rep["quality_delta"] == 0.0
+    with pytest.raises(ValueError, match="multilabel"):
+        check_precision_quality(scores, scores, metric="map")
+    with pytest.raises(ValueError, match="unknown quality metric"):
+        check_precision_quality(scores, scores, metric="psnr")
+
+
+# ---------------------------------------------------------------------------
+# Prefetch depth: env pin > session plan clamp > config
+# ---------------------------------------------------------------------------
+
+
+def test_prefetch_depth_resolution_order(monkeypatch):
+    from keystone_tpu.loaders.stream import (
+        prefetch_batches,
+        resolved_prefetch_depth_value,
+    )
+
+    config.prefetch_depth = 3
+    assert resolved_prefetch_depth_value(None) == 3       # config default
+    assert resolved_prefetch_depth_value(7) == 7          # explicit arg
+    PipelineEnv.get().resource_plan["prefetch_depth"] = 1
+    assert resolved_prefetch_depth_value(None) == 1       # plan clamps
+    PipelineEnv.get().resource_plan["prefetch_depth"] = 9
+    assert resolved_prefetch_depth_value(None) == 3       # only DOWN
+    monkeypatch.setenv("KEYSTONE_PREFETCH_DEPTH", "5")
+    assert resolved_prefetch_depth_value(None) == 5       # env beats plan
+    monkeypatch.setenv("KEYSTONE_PREFETCH_DEPTH", "0")
+    assert resolved_prefetch_depth_value(None) == 0       # explicit 0 pin
+    src = [1, 2, 3]
+    assert prefetch_batches(src) is src  # 0 = synchronous passthrough
+
+
+def test_plan_prefetch_depth_clamps_from_measured_bytes(monkeypatch):
+    """The rule satellite: measured per-batch bytes vs the budget share
+    turns the hand-picked depth into a clamp, decision-logged."""
+    import keystone_tpu.utils.metrics as metrics_mod
+    from keystone_tpu.nodes.learning import LinearMapEstimator
+    from keystone_tpu.workflow.graph import structural_digest
+    from keystone_tpu.workflow.profile_store import StoredProfile
+
+    X = np.ones((64, 32), np.float32)
+    Y = np.ones((64, 4), np.float32)
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+
+    p = L2Normalizer().and_then(LinearMapEstimator(lam=1e-3), X, Y)
+    # Feed the rule a measured profile for the estimator's input node:
+    # 64 rows x 8192 B = 128 B/row, one call = 8192 B/batch.
+    from keystone_tpu.workflow.operators import EstimatorOperator
+
+    est_nid = next(
+        nid for nid, op in p.graph.operators.items()
+        if isinstance(op, EstimatorOperator)
+    )
+    dep0 = p.graph.dependencies[est_nid][0]
+    digest = structural_digest(p.graph, dep0)
+    # out_rows/out_bytes are LAST-WRITE per-call sizes (the store
+    # contract) — calls=10 must price identically to calls=1, never
+    # divide the per-batch rows by the accumulated call count.
+    measured = StoredProfile(
+        pipeline_digest="d", fingerprint={},
+        digests={digest: {"out_rows": 64, "out_bytes": 8192, "calls": 10}},
+    )
+    # Budget share 16384 B -> 2 batches fit; hand-picked depth 4 clamps.
+    monkeypatch.setattr(metrics_mod, "device_hbm_bytes",
+                        lambda: 16384 * rules.PlanResourcesRule
+                        .PREFETCH_BUDGET_FRAC)
+    config.prefetch_depth = 4
+    rules.clear_decisions()
+    before = _counters()
+    plan: dict = {}
+    rules.PlanResourcesRule()._plan_prefetch_depth(
+        p.graph, [p.sink], measured, plan
+    )
+    assert plan["prefetch_depth"] == 2
+    assert _delta(before, "prefetch_clamped") == 1
+    (d,) = [d for d in rules.optimizer_decisions()
+            if d.action == "prefetch_depth=2"]
+    assert d.provenance == "measured" and "clamped" in d.reason
+    # In-budget: the hand-picked depth stands, decision says so.
+    config.prefetch_depth = 2
+    plan2: dict = {}
+    rules.clear_decisions()
+    rules.PlanResourcesRule()._plan_prefetch_depth(
+        p.graph, [p.sink], measured, plan2
+    )
+    assert "prefetch_depth" not in plan2
+    (keep,) = [d for d in rules.optimizer_decisions()
+               if d.action.startswith("prefetch_depth=")]
+    assert "fits" in keep.reason
+
+
+# ---------------------------------------------------------------------------
+# Observability surface
+# ---------------------------------------------------------------------------
+
+
+def test_service_stats_expose_plan_and_precision():
+    config.hbm_budget_bytes = 4096
+    cp = CompiledPipeline(_head(), max_batch=64, devices=1,
+                          precision="bf16", name="sp-s1").warmup((8,))
+    with PipelineService(cp, max_delay_ms=0.5, name="sp-s1-svc") as svc:
+        stats = svc.stats()["compiled"]
+    assert stats["precision"] == "bf16"
+    assert stats["plan"]["enabled"] and stats["plan"]["trimmed"]
+    assert stats["ladder"] == list(cp.ladder)
+
+
+def test_daemon_stats_expose_serve_plan(tmp_path):
+    """Operators see the planner's choices on the wire: the daemon's
+    /stats carries resolved ladder, precision, and the plan dict."""
+    import json
+    import urllib.request
+
+    from keystone_tpu.workflow.daemon import ServingDaemon
+    from keystone_tpu.workflow.serialization import save_artifact
+
+    d = 8
+    pipe = _head(d=d).to_pipeline().fit()
+    art = os.path.join(tmp_path, "m.kart")
+    save_artifact(pipe, art, feature_shape=(d,), dtype="float32")
+    with ServingDaemon(artifact=art, devices=1, buckets=(4,),
+                       name="sp-daemon") as daemon:
+        sp = daemon.stats()["serve_plan"]
+        assert sp["ladder"] == [4]
+        assert sp["precision"] == "f32"
+        assert sp["plan"] == {"enabled": False, "reason": "ladder pinned"}
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{daemon.http_port}/stats", timeout=10
+        ) as resp:
+            doc = json.loads(resp.read())
+        assert doc["serve_plan"] == sp
+
+
+# ---------------------------------------------------------------------------
+# The bench harness, in-process
+# ---------------------------------------------------------------------------
+
+
+def _tools(name):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "tools", f"{name}.py")
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    finally:
+        sys.path.pop(0)
+
+
+def test_bench_serve_precision_harness_green():
+    """Every hard gate of `make bench-serve-precision` at a reduced size:
+    wall AND p99 beat the hand-picked baseline, knob-off bit-identity,
+    ladder-change within float noise, quality within tolerance, planner
+    ran, zero post-warmup compiles."""
+    import argparse
+
+    bench = _tools("bench_serve")
+    args = argparse.Namespace(
+        requests=24, max_batch=32, d=16, features=128, classes=4, seed=0,
+        provisioned_max=256, quality_tolerance=None,
+    )
+    result = bench.run_precision_bench(args)
+    assert result["ok"], result["pass"]
+    assert result["handpicked_ladder"] == [256]
+    assert result["plan"]["enabled"]
+    assert result["quality"]["within_tolerance"]
+    assert result["speedup"]["throughput"] >= 1.5
+    assert result["speedup"]["p99"] >= 1.5

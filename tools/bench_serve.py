@@ -33,6 +33,19 @@ no-stranded-future invariant. The gate: excess load turns into fast
 failures while accepted p99 stays bounded by the deadline — degradation,
 not a cliff.
 
+``--precision`` runs the memory-bounded precision A/B instead: the f32
+HAND-PICKED ladder (one bucket at the provisioned maximum — the
+pad-everything-to-max config) vs the HBM-PLANNED ladder served at bf16
+through the same trained canonical head. Gates hard on any backend:
+planned+bf16 beats the baseline on wall AND p99 (pad-overhead structure,
+not core count), the default-built engine serves bit-identically to the
+explicit-f32 engine on the same ladder (the knob-off contract), the
+ladder change itself moves answers at most float noise, the multiclass
+quality gate stays within its declared tolerance of the f32 oracle
+(``CompiledPipeline.qualify`` refuses otherwise), zero post-warmup
+compiles; the appended ``serve_precision`` row carries the planner's
+per-bucket bytes + provenance under bench_watch.
+
 ``--devices N`` runs the replica-scaling bench instead: the same uniform
 mixed-size trace is served at devices=1 and devices=N through the
 pipelined micro-batcher (``make bench-serve-replicas`` forces the
@@ -485,6 +498,200 @@ def run_daemon_bench(args) -> dict:
         daemon.close()
 
 
+def build_trained_chain(d: int, features: int, classes: int, seed: int,
+                        n_train: int = 2048, n_eval: int = 512):
+    """The quality-gated serving head: the canonical featurize chain with
+    its linear map TRAINED (least squares on margin-separated synthetic
+    classes) instead of random — random weights leave argmax margins at
+    quantization scale, which is not the scenario a precision ladder
+    serves. Returns ``(chain, X_eval, y_eval)``."""
+    from keystone_tpu.nodes.learning.linear_mapper import LinearMapper
+    from keystone_tpu.workflow.pipeline import FusedTransformer
+
+    base = build_chain(d, features, classes, seed)
+    prefix = FusedTransformer(base.stages[:-1])
+    rng = np.random.default_rng(seed + 1)
+    centroids = rng.normal(size=(classes, d)).astype(np.float32) * 2.0
+    y = rng.integers(0, classes, n_train)
+    X = (centroids[y] + 0.3 * rng.normal(size=(n_train, d))).astype(
+        np.float32
+    )
+    F = np.asarray(prefix.batch_call(X))
+    Y = np.eye(classes, dtype=np.float32)[y]
+    W, *_ = np.linalg.lstsq(F, Y, rcond=None)
+    chain = FusedTransformer(
+        base.stages[:-1] + [LinearMapper(W.astype(np.float32))]
+    )
+    ye = rng.integers(0, classes, n_eval)
+    Xe = (centroids[ye] + 0.3 * rng.normal(size=(n_eval, d))).astype(
+        np.float32
+    )
+    return chain, Xe, ye
+
+
+def run_precision_bench(args) -> dict:
+    """Memory-bounded serving A/B: the f32 HAND-PICKED ladder (one bucket
+    at the provisioned maximum — the classic pad-everything-to-max AOT
+    config, config.serve_buckets-style) vs the HBM-PLANNED ladder served
+    at bf16 precision, on the same mixed-size trace through the same
+    trained canonical head.
+
+    Gates (hard on any backend — the win is pad-overhead structure, not
+    core count): planned+bf16 beats the hand-picked f32 baseline on wall
+    AND p99; the default-built engine is BIT-identical to the explicit
+    f32 engine on the same ladder (the knob-off contract — the default
+    path is today's construction, untouched) while the ladder change
+    itself moves answers at most float noise (bit-identity across
+    DIFFERENT bucket shapes is a backend property; shared-rung chunks
+    stay bit-identical, pinned in tests); the bf16 quality gate
+    (multiclass accuracy vs the f32 oracle, evaluation/ metrics) stays
+    within its declared tolerance or ``qualify()`` refuses; zero
+    post-warmup compiles on every engine; and the planner's evidence
+    (per-bucket planned bytes, provenance, trims) rides the row."""
+    from keystone_tpu.utils.metrics import CompileEventCounter
+    from keystone_tpu.workflow.serving import CompiledPipeline
+
+    d, features, classes = args.d, args.features, args.classes
+    provisioned = args.provisioned_max or 4 * args.max_batch
+    rng = np.random.default_rng(args.seed)
+    sizes = rng.integers(1, args.max_batch + 1, size=args.requests)
+    trace = [
+        rng.normal(size=(int(n), d)).astype(np.float32) for n in sizes
+    ]
+    rows = int(sizes.sum())
+    chain, X_eval, y_eval = build_trained_chain(
+        d, features, classes, args.seed
+    )
+    compile_events = CompileEventCounter()
+
+    def serve_phase(cp):
+        cp.warmup((d,))
+        ev0 = compile_events.count
+        lats, outs = [], []
+        t0 = time.perf_counter()
+        for x in trace:
+            t1 = time.perf_counter()
+            outs.append(cp(x))
+            lats.append(time.perf_counter() - t1)
+        wall = time.perf_counter() - t0
+        return {
+            "lats": lats,
+            "wall": wall,
+            "outs": outs,
+            "post_warmup_compiles": compile_events.count - ev0,
+            "stats": cp.stats(),
+        }
+
+    # -- baseline: f32, hand-picked single provisioned-max bucket (every
+    # request pads to the bucket someone sized for the biggest batch
+    # they could imagine).
+    base = serve_phase(CompiledPipeline(
+        chain, buckets=[provisioned], devices=1, precision="f32",
+        name="prec-handpicked-f32",
+    ))
+    # -- planned ladder, knob off: must be bit-identical to the baseline.
+    planned = serve_phase(CompiledPipeline(
+        chain, max_batch=provisioned, devices=1, precision="f32",
+        name="prec-planned-f32",
+    ))
+    # -- knob-off contract: the engine built WITHOUT the precision knob
+    # (today's construction) must serve bit-identically to the explicit
+    # f32 engine on the same ladder — the default path is untouched.
+    cp_default = CompiledPipeline(
+        chain, max_batch=provisioned, devices=1,
+        name="prec-planned-default",
+    ).warmup((d,))
+    bit_identical = all(
+        np.array_equal(cp_default(x), out)
+        for x, out in zip(trace, planned["outs"])
+    )
+    # Cross-ladder agreement is NUMERIC, not bit-level: a different
+    # bucket shape legitimately changes gemm tiling (reduction order)
+    # on some backends, so the evidence is the max relative error.
+    ladder_rel_err = max(
+        float(np.abs(a - b).max() / max(np.abs(a).max(), 1e-12))
+        for a, b in zip(base["outs"], planned["outs"])
+    )
+    # -- planned ladder + bf16: the throughput mode under quality gates.
+    cp_bf16 = CompiledPipeline(
+        chain, max_batch=provisioned, devices=1, precision="bf16",
+        name="prec-planned-bf16",
+    )
+    quality = cp_bf16.qualify(
+        X_eval, y=y_eval, metric="multiclass",
+        tolerance=args.quality_tolerance,
+    )
+    bf16 = serve_phase(cp_bf16)
+    base_p99 = nearest_rank_ms(base["lats"], 99)
+    bf16_p99 = nearest_rank_ms(bf16["lats"], 99)
+    plan = planned["stats"]["plan"]
+    result = {
+        "metric": "serve_precision",
+        "unit": "ms",
+        "requests": args.requests,
+        "rows": rows,
+        "d": d,
+        "features": features,
+        "classes": classes,
+        "provisioned_max": provisioned,
+        "handpicked_ladder": base["stats"]["ladder"],
+        "planned_ladder": planned["stats"]["ladder"],
+        "plan": plan,
+        "precision": "bf16",
+        "quality": quality,
+        "handpicked_f32": {
+            **lat_stats(base["lats"]),
+            "rows_per_s": round(rows / base["wall"], 1),
+            "pad_rows_per_request": round(
+                sum(provisioned - s for s in sizes) / len(sizes), 1
+            ),
+            "post_warmup_compiles": base["post_warmup_compiles"],
+        },
+        "planned_f32": {
+            **lat_stats(planned["lats"]),
+            "rows_per_s": round(rows / planned["wall"], 1),
+            "post_warmup_compiles": planned["post_warmup_compiles"],
+        },
+        "planned_bf16": {
+            **lat_stats(bf16["lats"]),
+            "rows_per_s": round(rows / bf16["wall"], 1),
+            "post_warmup_compiles": bf16["post_warmup_compiles"],
+        },
+        "speedup": {
+            # "throughput" (wall ratio), matching the main serve row's
+            # leaf naming — "wall" is a lower-better fragment in
+            # bench_watch, and a speedup must judge higher-better.
+            "throughput": round(base["wall"] / bf16["wall"], 2),
+            "p99": round(base_p99 / bf16_p99, 2),
+            "throughput_planned_f32": round(
+                base["wall"] / planned["wall"], 2
+            ),
+        },
+        "bit_identical_f32": bit_identical,
+        "ladder_change_max_rel_err": ladder_rel_err,
+        "pass": {
+            # Structural pad-overhead win: hard on every backend.
+            "wall_speedup_ge_1p5": base["wall"] / bf16["wall"] >= 1.5,
+            "p99_speedup_ge_1p5": base_p99 / bf16_p99 >= 1.5,
+            "bit_identical_when_knob_off": bit_identical,
+            # A ladder change must not move answers beyond float noise
+            # (bit-identity across DIFFERENT bucket shapes is a backend
+            # property — gemm tiling follows the batch dim; shared-rung
+            # chunks stay bit-identical, pinned in tests).
+            "ladder_change_within_noise": ladder_rel_err <= 1e-5,
+            "quality_within_tolerance": quality["within_tolerance"],
+            "planner_ran": bool(plan and plan.get("enabled")),
+            "zero_post_warmup_compiles": (
+                base["post_warmup_compiles"] == 0
+                and planned["post_warmup_compiles"] == 0
+                and bf16["post_warmup_compiles"] == 0
+            ),
+        },
+    }
+    result["ok"] = all(result["pass"].values())
+    return result
+
+
 def run_replica_bench(args) -> dict:
     """Replica-pool scaling: serve the same uniform mixed-size trace at
     devices=1 and devices=N through the pipelined micro-batcher, with
@@ -661,6 +868,17 @@ def main() -> None:
     ap.add_argument("--overload-max-rows", type=int, default=4,
                     help="rows per service flush in the overload phase — "
                     "the capacity-limited-device stand-in")
+    ap.add_argument("--precision", action="store_true",
+                    help="run the memory-bounded precision bench instead: "
+                    "f32 hand-picked single-bucket ladder vs HBM-planned "
+                    "ladder + bf16 under the evaluation/ quality gate")
+    ap.add_argument("--provisioned-max", type=int, default=0,
+                    help="the hand-picked baseline's provisioned bucket "
+                    "(0 = 4x --max-batch): the pad-everything-to-max "
+                    "config the planner replaces")
+    ap.add_argument("--quality-tolerance", type=float, default=None,
+                    help="override the declared quality-gate tolerance "
+                    "(default: serving.PRECISION_QUALITY_TOLERANCES)")
     ap.add_argument("--daemon", action="store_true",
                     help="run the networked-daemon bench instead: open-loop "
                     "load at 2x capacity through the REAL socket ingress, "
@@ -695,7 +913,34 @@ def main() -> None:
     # The baseline phase must measure TRUE per-shape jit: an inherited
     # KEYSTONE_SERVE_BUCKETS would silently route batch_call through
     # bucketing and collapse the comparison to bucketed-vs-bucketed.
+    # The env var must go too, not just the config snapshot: the ladder
+    # resolution reads it LIVE (env-pins-win), so an exported value
+    # would pin every engine's ladder — hard-failing the --precision
+    # mode's planner_ran gate and turning its "planned ladder" column
+    # into the operator's env ladder (the KEYSTONE_PROFILE_STORE bench
+    # isolation precedent). Same for an ambient serving precision: the
+    # A/B names its precision per engine explicitly, and the knob-off
+    # phase must really be the default f32 path.
+    os.environ.pop("KEYSTONE_SERVE_BUCKETS", None)
+    os.environ.pop("KEYSTONE_SERVE_PRECISION", None)
     config.serve_buckets = ()
+    config.serve_precision = "f32"
+    # Same class: an ambient KEYSTONE_PLAN_RESOURCES=0 (the documented
+    # programmatic-pin workaround) snapshots config.plan_resources False
+    # at import and would hard-fail the --precision planner_ran gate.
+    config.plan_resources = True
+
+    if args.precision:
+        with maybe_trace("bench_serve_precision"):
+            result = run_precision_bench(args)
+        result["backend"] = backend
+        result["host_cores"] = os.cpu_count()
+        result["env"] = environment_fingerprint()
+        line = json.dumps(result)
+        print(line)
+        if args.out:
+            write_result(args.out, line, result["metric"])
+        sys.exit(0 if result["ok"] else 1)
 
     if args.daemon:
         with maybe_trace("bench_serve_daemon"):

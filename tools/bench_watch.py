@@ -83,10 +83,13 @@ BLESS_TOL = 0.1
 #: durations, overheads). ``fallback``/``pad_rows`` cover the
 #: fit_multichip family: silent single-device fallbacks and pad overhead
 #: creeping up are regressions.
+#: ``quality_delta`` covers the serve_precision family: a reduced-
+#: precision mode drifting further from its f32 oracle is a regression
+#: even while the latency side still wins.
 LOWER_BETTER = (
     "latency", "p50_", "p95_", "p99_", "_ms", "ms_", "seconds", "wall",
     "overhead", "expired", "dropped", "stalls", "deaths", "residual",
-    "fallback", "pad_rows", "rel_err",
+    "fallback", "pad_rows", "rel_err", "quality_delta",
 )
 #: Leaf-name fragments that mark a higher-is-better series (rates,
 #: speedups, utilization). ``scaling`` covers the fit_multichip rows/s
