@@ -1,6 +1,6 @@
 # Developer entry points. Pipelines launch via bin/run-pipeline.sh.
 
-.PHONY: test t1 chaos native bench bench-serve bench-serve-overload bench-serve-replicas bench-serve-daemon bench-serve-precision bench-fit bench-opt bench-multichip trace-demo obs-serve serve-daemon profile-demo bench-watch lint dryrun clean tpu-checkride sentinel northstar acceptance
+.PHONY: test t1 chaos native bench bench-serve bench-serve-overload bench-serve-replicas bench-serve-daemon bench-serve-precision bench-fit bench-opt bench-multichip bench-online trace-demo obs-serve serve-daemon profile-demo bench-watch lint dryrun clean tpu-checkride sentinel northstar acceptance
 
 # The canonical tier-1 verify (ROADMAP.md), verbatim at the defaults —
 # builders and CI invoke this one entry point instead of hand-copying the
@@ -169,6 +169,19 @@ bench-opt:
 # BENCH_fit.json history `make bench-watch` regresses against.
 bench-multichip:
 	JAX_PLATFORMS=cpu python tools/bench_multichip.py --out BENCH_fit.json
+
+# Online-learning drift gate: a label-shifted synthetic stream folds
+# into the retained gram/AtB accumulators with time-decay, re-solves,
+# and hot-swaps the refreshed model into a LIVE daemon mid-traffic.
+# Hard gates: post-refresh accuracy (measured through the wire on the
+# new generation) recovers to within tolerance of a full refit over the
+# shifted data, the online re-solve wall sits >=2x below the full-refit
+# wall, and the swap-under-refresh leaves zero dropped requests /
+# unresolved journeys. APPENDS the fingerprinted fit_online row to the
+# BENCH_fit.json history `make bench-watch` regresses against. Tier-1
+# runs the same harness in-process (tests/test_online.py).
+bench-online:
+	JAX_PLATFORMS=cpu python tools/bench_online.py --out BENCH_fit.json
 
 # Bench regression sentinel: parse every BENCH_*/MULTICHIP_*/BENCH_serve/
 # BENCH_fit history row, fit per-metric noise bands from

@@ -535,6 +535,33 @@ class Config:
     swap_token: str = field(
         default_factory=lambda: os.environ.get("KEYSTONE_SWAP_TOKEN", "")
     )
+    # Online learning (workflow/online.py OnlineTrainer) — refresh
+    # cadence in milliseconds: the trainer's _refresh_loop thread
+    # re-solves the retained accumulators, writes a versioned artifact,
+    # and hot-swaps it into the wired daemon whenever new batches were
+    # folded since the last tick. 0 = no background thread (manual
+    # refresh() only — the bench/test mode).
+    # Env: KEYSTONE_ONLINE_REFRESH_MS.
+    online_refresh_ms: float = field(
+        default_factory=lambda: _env_float("KEYSTONE_ONLINE_REFRESH_MS",
+                                           5000.0)
+    )
+    # Online time-decay γ ∈ (0, 1]: each partial_fit call scales the
+    # retained sums by γ first, so a batch folded a calls ago carries
+    # weight γ^a (exponentially-weighted ridge — the drift-tracking
+    # mode). 1.0 = no forgetting. Exclusive with online_window.
+    # Env: KEYSTONE_ONLINE_DECAY.
+    online_decay: float = field(
+        default_factory=lambda: _env_float("KEYSTONE_ONLINE_DECAY", 1.0)
+    )
+    # Online sliding window (batches): keep a per-window accumulator
+    # ring of the most recent k partial_fit calls, subtracting the
+    # oldest window's sums on evict (counted as windows_evicted).
+    # 0 = unbounded horizon. Exclusive with online_decay.
+    # Env: KEYSTONE_ONLINE_WINDOW.
+    online_window: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_ONLINE_WINDOW", 0)
+    )
     # Pipeline-graph lint gate (workflow/analysis.py): run the static
     # graph linter before every fit()/compiled(). "off" (default) = never;
     # "warn" = log findings at their severity; "error" = additionally

@@ -129,6 +129,26 @@ class BlockLeastSquaresEstimator(LabelEstimator):
     def _weights(self, Y: jnp.ndarray) -> Optional[jax.Array]:
         return None
 
+    def partial_fit(self, data, labels, state=None, decay=None,
+                    window=None, chunk_rows=None):
+        """Fold one labeled batch into retained normal-equation
+        accumulators (``workflow.online.OnlineState``). The online
+        re-solve is the EXACT dense normal-equation solution (not the
+        BCD approximation), so it requires the (d, d) gram to be
+        materializable — the usual online regime (features already
+        reduced by the frozen featurize prefix)."""
+        from keystone_tpu.workflow.online import partial_fit_step
+
+        return partial_fit_step(state, data, labels, decay=decay,
+                                window=window, chunk_rows=chunk_rows)
+
+    def solve_online(self, state) -> BlockLinearMapper:
+        """Re-solve the retained accumulators as one dense block (the
+        exact solution of the streamed problem), wrapped in the same
+        ``BlockLinearMapper`` interface the batch fit produces."""
+        W, b = state.solve(self.lam, fit_intercept=self.fit_intercept)
+        return BlockLinearMapper([W], [(0, state.d)], b)
+
     def fit(self, data, labels) -> BlockLinearMapper:
         from keystone_tpu.utils.sparse import SparseBatch
 
@@ -361,6 +381,13 @@ class BlockWeightedLeastSquaresEstimator(BlockLeastSquaresEstimator):
             parallelism,
         )
         self.mixture_weight = mixture_weight
+
+    # Class-rebalanced weights need the class counts of the FULL label
+    # set — a per-batch fold cannot know them, so the online contract is
+    # nulled out (supports_partial_fit -> False; Pipeline.refit_stream
+    # falls back to the counted full refit and KG105 warns statically).
+    partial_fit = None
+    solve_online = None
 
     def _weights(self, Y: jnp.ndarray) -> Optional[jax.Array]:
         if self.mixture_weight == 0.0:

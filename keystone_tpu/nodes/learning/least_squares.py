@@ -128,6 +128,27 @@ class LeastSquaresEstimator(LabelEstimator):
             )
         raise ValueError(f"unknown solver choice {choice.name!r}")
 
+    def partial_fit(self, data, labels, state=None, decay=None,
+                    window=None, chunk_rows=None):
+        """Fold one labeled batch into retained normal-equation
+        accumulators. The fold is solver-independent (gram/AᵀB running
+        sums); ``solve_online`` always re-solves via the normal-equation
+        Cholesky path — the one incremental-exact member of the solver
+        menu — regardless of what the batch cost model would pick."""
+        from keystone_tpu.workflow.online import partial_fit_step
+
+        return partial_fit_step(state, data, labels, decay=decay,
+                                window=window, chunk_rows=chunk_rows)
+
+    def solve_online(self, state):
+        from keystone_tpu.nodes.learning.linear_mapper import LinearMapper
+
+        self.last_choice = SolverChoice(
+            "normal", "online partial_fit re-solve (gram/AᵀB running sums)"
+        )
+        W, b = state.solve(self.lam)
+        return LinearMapper(W, b)
+
     def fit(self, data, labels) -> Transformer:
         X = jnp.asarray(data)
         Y = jnp.asarray(labels)

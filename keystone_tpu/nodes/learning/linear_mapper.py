@@ -51,6 +51,26 @@ class LinearMapEstimator(LabelEstimator):
         self.lam = lam
         self.method = method
 
+    def partial_fit(self, data, labels, state=None, decay=None,
+                    window=None, chunk_rows=None):
+        """Fold one labeled batch into retained normal-equation
+        accumulators (``workflow.online.OnlineState``) — create the
+        state on first call, mutate-and-return it after. The fold is
+        grouping-invariant: K calls are bit-identical to one call over
+        the concatenation. ``solve_online`` re-solves cheaply."""
+        from keystone_tpu.workflow.online import partial_fit_step
+
+        return partial_fit_step(state, data, labels, decay=decay,
+                                window=window, chunk_rows=chunk_rows)
+
+    def solve_online(self, state) -> LinearMapper:
+        """Re-solve the retained accumulators through the existing
+        Cholesky path: the intercept rides the retained weighted means
+        (exact rank-one centering correction), matching the batch fit's
+        b = ȳ − x̄ᵀW semantics."""
+        W, b = state.solve(self.lam)
+        return LinearMapper(W, b)
+
     def fit(self, data, labels) -> LinearMapper:
         from keystone_tpu.linalg.row_matrix import storage_dtype
 

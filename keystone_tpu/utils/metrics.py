@@ -1788,3 +1788,37 @@ class ServePlanCounters(CounterSet):
 
 serve_plan_counters = ServePlanCounters()
 metrics_registry.register("serve_plan", serve_plan_counters)
+
+
+class OnlineCounters(CounterSet):
+    """Process-wide online-learning observability: every incremental-fit
+    decision (workflow/online.py) lands here, so "the model is current"
+    is a counter assertion — folds happened, re-solves ran, refreshes
+    reached the daemon — instead of a log line. Thread-safe (CounterSet);
+    rides ``/metrics`` like every registry family.
+
+    Well-known keys:
+
+    - ``batches_folded`` — labeled batches folded into retained
+      gram/AᵀB/mean accumulators (``OnlineState.fold``; both the trainer
+      path and direct ``partial_fit`` calls)
+    - ``resolves`` — cheap re-solves of the retained state through the
+      Cholesky path (``OnlineState.solve``)
+    - ``refreshes_pushed`` — completed trainer refreshes: re-solve +
+      versioned artifact + (when wired) daemon hot-swap
+    - ``refreshes_failed`` — refreshes that died anywhere (fault sites,
+      failed swap, full disk): serving keeps the old generation, the
+      accumulators are untouched, the next cadence tick retries
+    - ``windows_evicted`` — sliding-window units whose sums were
+      subtracted from the running totals (subtract-on-evict)
+    - ``full_refits`` — ``Pipeline.refit_stream`` cadence ticks that
+      fell back to a FULL head refit because the head estimator lacks
+      ``partial_fit`` (the KG105 hazard, counted at runtime)
+    - ``batches_buffered`` — batches a partial_fit-less
+      ``refit_stream`` buffered for those full refits (distinct from
+      ``batches_folded``: nothing reached retained accumulators)
+    """
+
+
+online_counters = OnlineCounters()
+metrics_registry.register("online", online_counters)

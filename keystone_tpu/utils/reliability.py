@@ -109,6 +109,14 @@ class SwapAborted(RuntimeError):
     old generation — an aborted swap is a rollback, never an outage."""
 
 
+class RefreshAborted(RuntimeError):
+    """An online-learning refresh died before publishing (the harness's
+    ``refresh_abort`` site, or a real solve/serialize failure). Serving
+    keeps answering on the current generation and the retained
+    accumulators (plus their checkpoint) are untouched — the next
+    cadence tick retries from identical state."""
+
+
 class RecordCorruptError(ValueError):
     """A record is irrecoverably corrupt — no retry can fix it. The stream
     quarantines (skips + counts) it instead of dying."""
@@ -176,6 +184,9 @@ class FaultPlan:
         ),
         "swap_abort": lambda: SwapAborted(
             "injected mid-swap abort (KEYSTONE_FAULTS swap_abort)"
+        ),
+        "refresh_abort": lambda: RefreshAborted(
+            "injected mid-refresh abort (KEYSTONE_FAULTS refresh_abort)"
         ),
     }
 

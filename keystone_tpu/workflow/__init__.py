@@ -30,6 +30,12 @@ from keystone_tpu.workflow.serialization import (
     save_artifact,
     save_pipeline,
 )
+from keystone_tpu.workflow.online import (
+    OnlineState,
+    OnlineStateError,
+    OnlineTrainer,
+    supports_partial_fit,
+)
 from keystone_tpu.workflow.serving import (
     CompiledPipeline,
     DeadlineExceeded,
@@ -65,6 +71,10 @@ __all__ = [
     "load_artifact",
     "ModelArtifact",
     "ArtifactVersionError",
+    "OnlineState",
+    "OnlineStateError",
+    "OnlineTrainer",
+    "supports_partial_fit",
     "Diagnostic",
     "LintError",
     "LintReport",

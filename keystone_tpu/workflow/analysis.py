@@ -40,6 +40,11 @@ Rule catalog (KG = Keystone Graph):
   beyond the chunk budget share. Shape-only pricing off the propagated
   specs — no execution, no compile; the un-pinned defaults stay silent
   because the warmup/plan path auto-sizes those.
+- ``KG105 refit-full-head`` — linting with ``refit=True`` (the
+  ``Pipeline.refit_stream`` contract) against a pipeline whose head
+  estimator does not implement ``partial_fit``: every cadence tick then
+  silently costs a FULL head refit over the buffered stream instead of
+  a cheap accumulator re-solve.
 - ``KG201 dead-node`` — a node in the graph unreachable from the sink
   (composition orphans the pruner should have dropped).
 - ``KG202 cache-advice`` — a non-trivial subchain re-used by >= 2
@@ -51,8 +56,8 @@ Rule catalog (KG = Keystone Graph):
 
 Severity model: serveability rules (KG00x) are *errors* when linting
 with ``serve=True`` (the pre-``compiled()`` gate) and *warnings*
-otherwise; KG101/KG102/KG103/KG104 are warnings; KG201/KG202/KG203 are
-info.
+otherwise; KG101/KG102/KG103/KG104/KG105 are warnings; KG201/KG202/KG203
+are info.
 
 Wire-up: ``Pipeline.lint()`` runs this directly; the opt-in env gate
 ``KEYSTONE_LINT=warn|error|off`` (default off) runs it before every
@@ -92,6 +97,8 @@ GRAPH_RULES: Dict[str, str] = {
     "KG102": "silent dtype upcast / mixed-dtype seam across nodes",
     "KG103": "dataset batch rows never divide the active data mesh",
     "KG104": "pinned serve ladder / solve chunk priced beyond the HBM budget",
+    "KG105": "refit_stream head estimator lacks partial_fit (full refit "
+             "per cadence tick)",
     "KG201": "dead node unreachable from the pipeline sink",
     "KG202": "re-used subchain with no cache node",
     "KG203": "stored measured profile exists but auto-cache is model-only",
@@ -315,6 +322,7 @@ def lint_graph(
     example: Any = None,
     serve: bool = False,
     have_ladder: Optional[bool] = None,
+    refit: bool = False,
 ) -> LintReport:
     """Run every graph rule over ``graph`` and return a ``LintReport``.
 
@@ -322,7 +330,9 @@ def lint_graph(
     the pre-``compiled()`` contract. ``example`` feeds the shape/dtype
     propagation (see ``_input_spec``); ``have_ladder`` overrides the
     bucket-ladder detection for KG101 (None = read
-    ``config.serve_buckets``).
+    ``config.serve_buckets``); ``refit=True`` additionally checks the
+    ``Pipeline.refit_stream`` contract (KG105: head estimator without
+    ``partial_fit`` — every cadence tick is a full head refit).
     """
     from keystone_tpu.config import config
 
@@ -600,6 +610,31 @@ def lint_graph(
                          "the profile-guided planner sizes the chunk",
                 ))
 
+    # -- KG105: refit-stream head without partial_fit ----------------------
+    # Only under the refit contract (refit=True): a batch-only head is a
+    # perfectly fine BATCH pipeline — the hazard exists solely when the
+    # operator intends to stream refits through it. One head definition
+    # shared with refit_stream/OnlineTrainer (workflow.online), so the
+    # lint can never disagree with what the runtime would do.
+    if refit:
+        from keystone_tpu.workflow.online import (
+            refit_head_estimator,
+            supports_partial_fit,
+        )
+
+        head_est = refit_head_estimator(graph, sink)
+        if head_est is not None and not supports_partial_fit(head_est):
+            emit(Diagnostic(
+                "KG105", "warning", type(head_est).__name__,
+                f"{type(head_est).__name__} does not implement "
+                "partial_fit: refit_stream will fall back to a FULL head "
+                "refit (over the whole buffered stream) on every cadence "
+                "tick instead of a cheap accumulator re-solve",
+                hint="use a normal-equation head (LinearMapEstimator / "
+                     "BlockLeastSquaresEstimator / LeastSquaresEstimator) "
+                     "or accept the counted online.full_refits cost",
+            ))
+
     # -- KG202: cache placement advice (consumer map shared with KG103) ----
     for gid, users in consumers.items():
         if not isinstance(gid, NodeId):
@@ -656,7 +691,8 @@ def lint_graph(
 
 
 def enforce_lint(pipeline, stage: str, serve: bool = False,
-                 have_ladder: Optional[bool] = None) -> Optional[LintReport]:
+                 have_ladder: Optional[bool] = None,
+                 refit: bool = False) -> Optional[LintReport]:
     """Run the graph lint as a gate when ``KEYSTONE_LINT`` asks for it.
 
     ``off`` (default): no-op, zero cost beyond one config read.
@@ -671,7 +707,7 @@ def enforce_lint(pipeline, stage: str, serve: bool = False,
         return None
     report = lint_graph(
         pipeline.graph, pipeline.source, pipeline.sink,
-        serve=serve, have_ladder=have_ladder,
+        serve=serve, have_ladder=have_ladder, refit=refit,
     )
     for d in report:
         log = logger.error if d.severity == "error" else (

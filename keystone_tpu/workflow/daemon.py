@@ -48,6 +48,19 @@ ingress over the existing replica-pool service:
   generation keeps serving — and force-dumps the flight recorder naming
   the generation and every in-flight request id.
 
+- **A/B serving: two generations from one replica pool.**
+  ``ab_swap(path, tenants=[...])`` stands up a CANDIDATE generation next
+  to the live one — same device pool, its own AOT-warmed engine/service
+  — and routes only the named tenants' traffic to it (the per-tenant
+  routing the admission table already provides). Every other tenant
+  keeps the live generation; responses carry the generation that served
+  them, so an experiment is attributable per response. ``promote_ab()``
+  makes the candidate live for everyone (the old generation drains with
+  the zero-dropped-requests guarantee of a normal swap);
+  ``abort_ab()`` drains the candidate and routes everyone back. A full
+  ``request_swap`` is refused while an A/B is active — resolve the
+  experiment first.
+
 - **Failure semantics on the wire.** Journeys now carry the network
   leg: every data-plane request gets an always-on flight-recorder
   record with ``accepted → parsed → admitted → submitted → resolved``
@@ -751,6 +764,17 @@ class ServingDaemon:
         self._closed = False
         self.swaps = 0
         self.swap_failures = 0
+        # A/B experiment state: a candidate Generation serving only the
+        # named tenants (None = no experiment active).
+        self._ab_gen: Optional[Generation] = None
+        self._ab_tenants: frozenset = frozenset()
+        # Highest generation number that ever SERVED traffic (live or as
+        # an A/B candidate): numbers are never reused once responses
+        # were tagged with them — an aborted candidate's number stays
+        # burned so per-response attribution stays unambiguous. (A swap
+        # that failed BEFORE install served nothing; its number may
+        # recycle.)
+        self._gen_hwm = 0
         # Generation 0: load/verify the artifact (or wrap the given
         # pipeline), AOT-warm the whole ladder, stand up the service.
         if artifact is not None and not isinstance(artifact, ModelArtifact):
@@ -1074,7 +1098,7 @@ class ServingDaemon:
                 return terr(400, "bad_request",
                             f"deadline_ms must be a number, got "
                             f"{deadline_ms!r}")
-        g = self._gen
+        _closed, g = self._route(tenant)
         try:
             x = np.asarray(x_payload, dtype=g.engine.dtype)
         except (TypeError, ValueError) as e:
@@ -1100,10 +1124,14 @@ class ServingDaemon:
                     return terr(504, "expired",
                                 f"deadline {deadline_ms:.0f}ms passed "
                                 "while landing on a live generation")
-            with self._lock:
-                if self._closed:
-                    return terr(503, "closed", "daemon is closed")
-            g = self._gen
+            # Per-attempt re-read, one lock hit (closed + routing in the
+            # same acquisition): an A/B tenant chases its candidate
+            # generation (which falls back to the live one the moment
+            # the experiment promotes/aborts); everyone else chases the
+            # live generation across swaps, exactly as before.
+            closed, g = self._route(tenant)
+            if closed:
+                return terr(503, "closed", "daemon is closed")
             try:
                 fut = g.service.submit(x, deadline_ms=remaining_ms)
             except QueueFullError as e:
@@ -1215,15 +1243,29 @@ class ServingDaemon:
 
     def _do_swap(self, path: str,
                  expect_fingerprint: Optional[str] = None) -> int:
-        old = self._gen
         with self._lock:
+            # Captured UNDER the lock: promote_ab() flips self._gen
+            # outside the serialized swap worker, so an unlocked read
+            # here could drain/rollback a generation that is no longer
+            # the live one.
+            old = self._gen
             if self._closed:
                 raise ServiceClosed("daemon closed; swap abandoned")
+            if self._ab_gen is not None:
+                # A full swap would strand the experiment's candidate
+                # (and its tenants' routing) mid-flight: resolve the A/B
+                # first — promote it or abort it, explicitly.
+                raise RuntimeError(
+                    "an A/B experiment is active; promote_ab() or "
+                    "abort_ab() before a full swap"
+                )
             self._draining = True
+            # Past any number that ever served (an aborted A/B burned
+            # its number): attribution stays unambiguous.
+            number = max(old.number, self._gen_hwm) + 1
         retired: List[int] = []
         try:
             art = load_artifact(path, expect_fingerprint=expect_fingerprint)
-            number = old.number + 1
             engine = self._build_engine(art.pipeline, number)
             for i in range(len(engine.replicas)):
                 if self._plan is not None:
@@ -1245,6 +1287,7 @@ class ServingDaemon:
                 closed = self._closed
                 if not closed:
                     self._gen = new
+                    self._gen_hwm = max(self._gen_hwm, number)
                     self._draining = False
                     self.swaps += 1
             if closed:
@@ -1290,6 +1333,140 @@ class ServingDaemon:
             )
             raise
 
+    # -- A/B serving ---------------------------------------------------------
+
+    def _route(self, tenant: Optional[Tenant]) -> Tuple[bool, Generation]:
+        """(closed, generation answering this tenant) under ONE lock
+        acquisition — THE tenant→generation routing rule (A/B candidate
+        for enrolled tenants while an experiment is active, the live
+        generation otherwise), shared by the dtype read and every submit
+        attempt so the two can never diverge."""
+        with self._lock:
+            closed = self._closed
+            ab = self._ab_gen
+            if (
+                ab is not None and tenant is not None
+                and tenant.name in self._ab_tenants
+            ):
+                return closed, ab
+            return closed, self._gen
+
+    def ab_swap(self, artifact_path: str, tenants,
+                expect_fingerprint: Optional[str] = None) -> int:
+        """Serve a CANDIDATE artifact to only the named tenants — two
+        generations answering from one replica pool. The candidate's
+        ladder AOT-warms fully before any routed traffic; nothing about
+        the live generation changes. Returns the candidate generation
+        number. Resolve with :meth:`promote_ab` / :meth:`abort_ab`."""
+        # Accept tenant NAMES or Tenant objects — str(Tenant) is an
+        # object repr that would match nobody, silently serving the
+        # candidate zero traffic.
+        names = frozenset(
+            t.name if isinstance(t, Tenant) else str(t) for t in tenants
+        )
+        if not names:
+            raise ValueError("ab_swap needs at least one tenant name")
+        # Validate against the admission table: a typo'd name would pass
+        # every guard yet enroll nobody — an experiment silently serving
+        # the candidate zero traffic while stats() claims it is active.
+        known = (
+            {self._admission._anonymous.name} if self._admission.open_mode
+            else {t.name for t in self._admission.tenants.values()}
+        )
+        unknown = names - known
+        if unknown:
+            raise ValueError(
+                f"ab_swap tenant(s) {sorted(unknown)} not in the "
+                f"admission table (known: {sorted(known)})"
+            )
+        with self._lock:
+            if self._closed:
+                raise ServiceClosed("daemon is closed")
+            if self._draining:
+                raise RuntimeError("a full swap is in progress")
+            if self._ab_gen is not None:
+                raise RuntimeError(
+                    "an A/B experiment is already active; promote_ab() "
+                    "or abort_ab() first"
+                )
+        art = load_artifact(str(artifact_path),
+                            expect_fingerprint=expect_fingerprint)
+        with self._lock:
+            number = max(self._gen.number, self._gen_hwm) + 1
+        engine = self._build_engine(art.pipeline, number)
+        engine.warmup(self._feature_shape, dtype=self._dtype)
+        service = self._build_service(engine, number)
+        cand = Generation(number, art.fingerprint, engine, service,
+                          art.header())
+        with self._lock:
+            closed = self._closed
+            # Re-checked in the COMMIT section: a full swap that ran
+            # during the slow load/warmup above advanced the live
+            # generation (or is mid-drain) — installing the candidate
+            # now would reuse a number and bypass the
+            # refused-mid-experiment invariant from the other side.
+            raced = (
+                closed or self._ab_gen is not None or self._draining
+                or max(self._gen.number, self._gen_hwm) + 1 != number
+            )
+            if not raced:
+                self._ab_gen = cand
+                self._ab_tenants = names
+                # The candidate starts serving NOW: its number is burned.
+                self._gen_hwm = max(self._gen_hwm, number)
+        if raced:
+            service.close(drain=False)
+            if closed:
+                raise ServiceClosed("daemon closed during ab_swap")
+            raise RuntimeError(
+                "a concurrent swap or A/B landed during ab_swap; the "
+                "candidate was discarded — retry against the new live "
+                "generation"
+            )
+        logger.info(
+            "daemon %s: A/B candidate generation %d (artifact %s) serving "
+            "tenant(s) %s; generation %d stays live for the rest",
+            self.name, number, art.fingerprint[:12], sorted(names),
+            self._gen.number,
+        )
+        return number
+
+    def promote_ab(self) -> int:
+        """Make the A/B candidate the live generation for EVERY tenant.
+        The outgoing generation drains with the normal swap guarantee
+        (stragglers replay on the successor; zero dropped requests)."""
+        with self._lock:
+            cand = self._ab_gen
+            if cand is None:
+                raise RuntimeError("no A/B experiment is active")
+            self._ab_gen = None
+            self._ab_tenants = frozenset()
+            old = self._gen
+            self._gen = cand
+            self.swaps += 1
+        old.service.close(drain=True, join_s=config.swap_drain_ms / 1e3)
+        logger.info(
+            "daemon %s: A/B candidate promoted — generation %d -> %d",
+            self.name, old.number, cand.number,
+        )
+        return cand.number
+
+    def abort_ab(self) -> None:
+        """End the experiment: drain the candidate (its in-flight
+        requests replay on the live generation) and route every tenant
+        back to the live generation."""
+        with self._lock:
+            cand = self._ab_gen
+            self._ab_gen = None
+            self._ab_tenants = frozenset()
+        if cand is None:
+            return
+        cand.service.close(drain=True, join_s=config.swap_drain_ms / 1e3)
+        logger.info(
+            "daemon %s: A/B candidate generation %d aborted; generation "
+            "%d serves everyone", self.name, cand.number, self._gen.number,
+        )
+
     # -- surfaces ------------------------------------------------------------
 
     @property
@@ -1332,9 +1509,20 @@ class ServingDaemon:
             closed = self._closed
             swaps = self.swaps
             swap_failures = self.swap_failures
+            ab_gen = self._ab_gen
+            ab_tenants = sorted(self._ab_tenants)
         admission = self._admission.stats()
         if redact_tenants:
             admission["tenants"] = len(admission["tenants"])
+        ab = None
+        if ab_gen is not None:
+            ab = {
+                "generation": ab_gen.number,
+                "artifact_fingerprint": ab_gen.fingerprint,
+                # Tenant names are admission metadata: redacted to a
+                # count for anonymous /stats callers like the table.
+                "tenants": len(ab_tenants) if redact_tenants else ab_tenants,
+            }
         engine_stats = g.engine.stats()
         return {
             "name": self.name,
@@ -1345,6 +1533,7 @@ class ServingDaemon:
             "closed": closed,
             "swaps": swaps,
             "swap_failures": swap_failures,
+            "ab": ab,
             "active_requests": active,
             "http_port": self.http_port,
             "socket_port": self.socket_port,
@@ -1414,6 +1603,12 @@ class ServingDaemon:
         # an empty queue (a stale sentinel in an already-exited worker's
         # queue is harmless).
         self._swap_q.put(None)
+        with self._lock:
+            ab = self._ab_gen
+            self._ab_gen = None
+            self._ab_tenants = frozenset()
+        if ab is not None:
+            ab.service.close(drain=True)
         self._gen.service.close(drain=True)
 
     def __enter__(self) -> "ServingDaemon":

@@ -1,0 +1,1064 @@
+"""Online learning: incremental fit on live streams + continuous refresh.
+
+KeystoneML's normal-equation solvers carry their sufficient statistics as
+running sums — the ``gram``/``atb`` accumulators of
+``linalg/normal_equations.py``, exactly the state the streaming-solve
+checkpoints already snapshot. This module is the subsystem that keeps a
+model *current* from those sums: fold new labeled batches into retained
+accumulators (the streamed map-reduce shape DrJAX formalizes,
+arXiv:2403.07128, with the psum'd per-chunk gram of arXiv:2112.09017),
+re-solve cheaply through the existing Cholesky path, and push refreshed
+weights through the serving daemon's hot-swap with zero dropped requests.
+
+Three layers:
+
+- :class:`OnlineState` — the retained sufficient statistic
+  (gram / AᵀB / column sums / effective row count) plus the identity that
+  guards it (feature width, label tail, dtypes, mesh manifest). The fold
+  is **grouping-invariant by construction**: rows buffer host-side and
+  accumulate in fixed ``chunk_rows`` pieces at absolute stream phase, so
+  ``partial_fit`` over K batches is bit-identical to one ``partial_fit``
+  over their concatenation — no matter how the stream was batched, and
+  no matter whether batches arrived sharded or on one device (every
+  chunk re-shards through ``RowMatrix``, the placement-invariance rule
+  of the data-parallel fit).
+- estimator ``partial_fit`` / ``solve_online`` (``LinearMapEstimator``,
+  ``BlockLeastSquaresEstimator``, ``LeastSquaresEstimator``) — thin
+  wrappers over :func:`partial_fit_step` + :meth:`OnlineState.solve`.
+- :class:`OnlineTrainer` — the refresh loop: folds submitted batches,
+  and on a cadence (``KEYSTONE_ONLINE_REFRESH_MS``) re-solves,
+  serializes a versioned ``ModelArtifact``, and hot-swaps it into a
+  live ``ServingDaemon`` via ``request_swap``. A failed refresh (the
+  ``refresh_abort``/``swap_abort`` fault sites, a bad artifact) is
+  counted and the old generation keeps serving; with a
+  ``checkpoint_dir`` the accumulator state snapshots after every fold
+  and a killed trainer resumes **bit-identically** (the
+  ``_stream_fingerprint`` contract: state folded under one mesh width
+  refuses to resume under another, typed, never a wrong answer).
+
+Forgetting modes (exclusive):
+
+- **time-decay** (``decay=γ``, ``KEYSTONE_ONLINE_DECAY``): each
+  ``partial_fit`` call first scales every retained sum by γ, so a batch
+  folded a calls ago carries weight γ^a — the exponentially-weighted
+  ridge problem (oracle-pinned in tests/test_online.py).
+- **sliding window** (``window=k``, ``KEYSTONE_ONLINE_WINDOW``): each
+  ``partial_fit`` call is one window unit kept in a per-window
+  accumulator ring; when the ring exceeds k the oldest unit's sums are
+  subtracted from the running totals (subtract-on-evict, counted as
+  ``windows_evicted``). Note K-vs-concat bit-identity intentionally
+  does not apply here: the window unit IS the call.
+
+Observability: every fold / re-solve / refresh / eviction lands in the
+``online`` registry family (:class:`~keystone_tpu.utils.metrics.OnlineCounters`),
+riding ``/metrics`` like every other counter set.
+
+Typed refusal: :class:`OnlineStateError` when a fold's feature width,
+label tail, dtype identity, or mesh manifest mismatches the retained
+state — folding apples into orange accumulators is never a warning.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("keystone_tpu")
+
+_STATE_KEY = "online_state"
+
+#: Canonical fold granularity (rows) — part of the state's identity:
+#: two states with different chunking produce different (both valid)
+#: accumulation groupings, so the chunk size rides the fingerprint.
+DEFAULT_CHUNK_ROWS = 512
+
+
+class OnlineStateError(ValueError):
+    """A fold (or solve) cannot proceed: the batch's feature width,
+    label tail, dtype identity, or mesh manifest does not match the
+    retained accumulators, decay/window were combined, or the state is
+    empty. Typed so callers can distinguish 'wrong data for this state'
+    from a numerical failure."""
+
+
+def supports_partial_fit(est: Any) -> bool:
+    """True when ``est`` implements the online contract
+    (``partial_fit`` + ``solve_online``, both callable). Estimators that
+    inherit the methods but cannot honor them (class-weighted problems
+    whose weights need the full label set) null them out."""
+    return callable(getattr(est, "partial_fit", None)) and callable(
+        getattr(est, "solve_online", None)
+    )
+
+
+def _online_counters():
+    from keystone_tpu.utils.metrics import online_counters
+
+    return online_counters
+
+
+class OnlineState:
+    """Retained normal-equation sufficient statistics for one problem.
+
+    Accumulators are host ``float64`` (exact round-trip through
+    checkpoints; per-chunk device contributions are f32 — adding them in
+    f64 in a fixed order is what makes the fold deterministic). The
+    device work per chunk is the placement-invariant ``RowMatrix``
+    program set: fused gram+AᵀB plus the psum'd column sums the
+    intercept means ride — sharded and single-device folds are
+    bit-identical because both re-shard onto the same mesh.
+
+    Thread-safety: instances are NOT internally locked; the
+    ``OnlineTrainer`` (the one concurrent consumer) serializes access
+    under its own lock.
+    """
+
+    def __init__(self, d: int, b_tail: Tuple[int, ...],
+                 chunk_rows: Optional[int] = None,
+                 window: Optional[int] = None):
+        from keystone_tpu.config import config
+        from keystone_tpu.utils.mesh import num_data_shards
+
+        if window is not None and int(window) <= 0:
+            raise OnlineStateError("window must be a positive batch count")
+        self.d = int(d)
+        self.b_tail = tuple(int(t) for t in b_tail)
+        if len(self.b_tail) > 1:
+            # The intercept's rank-one centering (np.outer) supports
+            # scalar and vector label tails — refuse what solve() could
+            # not honor rather than crashing there later.
+            raise OnlineStateError(
+                f"online fits take scalar or vector labels per row, got "
+                f"label tail {self.b_tail}"
+            )
+        self.chunk_rows = int(chunk_rows or DEFAULT_CHUNK_ROWS)
+        self.window = None if window is None else int(window)
+        # Mesh manifest + dtype identity, captured at creation: a fold or
+        # resume under a different mesh/dtype regime is refused, never
+        # silently blended (the _stream_fingerprint rule).
+        self.device_count = int(num_data_shards())
+        self.data_axis = str(config.data_axis)
+        self.default_dtype = str(config.default_dtype)
+        self.accum_dtype = str(config.accum_dtype)
+        k_shape = self.b_tail or ()
+        self.gram = np.zeros((self.d, self.d), dtype=np.float64)
+        self.atb = np.zeros((self.d,) + k_shape, dtype=np.float64)
+        self.x_sum = np.zeros((self.d,), dtype=np.float64)
+        self.y_sum = np.zeros(k_shape, dtype=np.float64)
+        #: Effective row count of the FOLDED chunks (a float: decay
+        #: turns it into Σ weights). Rows still buffered pending a full
+        #: chunk are not in here — ``total_rows`` counts both.
+        self.rows = 0.0
+        self.folds = 0
+        self.decays = 0
+        # Pending rows not yet a full chunk (host copies, < chunk_rows).
+        self._pend_x: List[np.ndarray] = []
+        self._pend_y: List[np.ndarray] = []
+        self._pend_rows = 0
+        # Sliding-window ring: one (gram, atb, x_sum, y_sum, rows) tuple
+        # per partial_fit call, newest last.
+        self._ring: List[Tuple] = []
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def for_batch(cls, X, Y, chunk_rows: Optional[int] = None,
+                  window: Optional[int] = None) -> "OnlineState":
+        """A fresh state shaped for (X, Y)'s problem."""
+        X = np.asarray(X)
+        Y = np.asarray(Y)
+        if X.ndim != 2:
+            raise OnlineStateError(
+                f"online fits take 2-D feature batches, got shape {X.shape}"
+            )
+        return cls(X.shape[1], tuple(Y.shape[1:]), chunk_rows=chunk_rows,
+                   window=window)
+
+    @property
+    def total_rows(self) -> float:
+        """Effective rows including the pending (not-yet-chunked) buffer
+        — the emptiness test every solve/refresh guard uses."""
+        return self.rows + float(self._pend_rows)
+
+    # -- identity ----------------------------------------------------------
+
+    def fingerprint(self) -> dict:
+        """The state's problem + mesh identity (the checkpoint binding,
+        shaped like ``_stream_fingerprint`` so the one mesh-manifest
+        refusal rule covers it)."""
+        return {
+            "d": self.d,
+            "b_tail": tuple(self.b_tail),
+            "chunk_rows": self.chunk_rows,
+            "window": self.window,
+            "default_dtype": self.default_dtype,
+            "accum_dtype": self.accum_dtype,
+            "device_count": self.device_count,
+            "data_axis": self.data_axis,
+        }
+
+    def _check_fold(self, X: np.ndarray, Y: np.ndarray) -> None:
+        from keystone_tpu.config import config
+        from keystone_tpu.utils.mesh import num_data_shards
+
+        if X.ndim != 2 or X.shape[1] != self.d:
+            raise OnlineStateError(
+                f"fold of feature width {X.shape[1:]} into retained "
+                f"width-{self.d} accumulators refused"
+            )
+        if tuple(Y.shape[1:]) != self.b_tail:
+            raise OnlineStateError(
+                f"fold of label tail {tuple(Y.shape[1:])} into retained "
+                f"{self.b_tail} accumulators refused"
+            )
+        if X.shape[0] != Y.shape[0]:
+            raise OnlineStateError(
+                f"feature/label row mismatch: {X.shape[0]} vs {Y.shape[0]}"
+            )
+        mesh_now = (int(num_data_shards()), str(config.data_axis))
+        if mesh_now != (self.device_count, self.data_axis):
+            raise OnlineStateError(
+                f"fold under mesh {mesh_now} into accumulators folded "
+                f"under ({self.device_count}, {self.data_axis!r}) refused "
+                "— re-shard state via a checkpoint on the recording mesh "
+                "or start a fresh state"
+            )
+        dtypes_now = (str(config.default_dtype), str(config.accum_dtype))
+        if dtypes_now != (self.default_dtype, self.accum_dtype):
+            raise OnlineStateError(
+                f"fold under dtypes {dtypes_now} into accumulators folded "
+                f"under ({self.default_dtype}, {self.accum_dtype}) refused"
+            )
+
+    # -- folding -----------------------------------------------------------
+
+    def _chunk_stats(self, Xc: np.ndarray, Yc: np.ndarray) -> Tuple:
+        """One canonical chunk's device-computed contribution, pulled to
+        host f64. The RowMatrix programs re-shard onto the default mesh
+        (per-shard gemm + psum), so the bits do not depend on where the
+        caller's batch lived."""
+        from keystone_tpu.linalg.row_matrix import RowMatrix
+
+        A = RowMatrix.from_array(Xc)
+        B = RowMatrix.from_array(Yc)
+        g, ab = A.gram_and_atb(B)
+        xs = A.col_sums()
+        ys = B.col_sums()
+        return (
+            np.asarray(g, dtype=np.float64),
+            np.asarray(ab, dtype=np.float64),
+            np.asarray(xs, dtype=np.float64),
+            np.asarray(ys, dtype=np.float64),
+            float(Xc.shape[0]),
+        )
+
+    def _add(self, stats: Tuple) -> None:
+        g, ab, xs, ys, n = stats
+        self.gram += g
+        self.atb += ab
+        self.x_sum += xs
+        self.y_sum += ys
+        self.rows += n
+
+    def _sub(self, stats: Tuple) -> None:
+        g, ab, xs, ys, n = stats
+        self.gram -= g
+        self.atb -= ab
+        self.x_sum -= xs
+        self.y_sum -= ys
+        self.rows -= n
+
+    def fold(self, X, Y) -> "OnlineState":
+        """Fold one labeled batch into the retained accumulators.
+
+        Infinite-horizon mode buffers rows and accumulates full
+        ``chunk_rows`` pieces at absolute stream phase — the mechanism
+        behind the K-batches-vs-concatenation bit-identity contract.
+        Window mode folds the call as one self-contained window unit
+        (phase resets per call) and evicts the oldest unit past the
+        window length.
+        """
+        X = np.asarray(X)
+        Y = np.asarray(Y)
+        self._check_fold(X, Y)
+        if X.shape[0] == 0:
+            raise OnlineStateError("empty batch fold refused")
+        if self.window is not None:
+            stats = self._call_stats(X, Y)
+            self._ring.append(stats)
+            self._add(stats)
+            while len(self._ring) > self.window:
+                self._sub(self._ring.pop(0))
+                _online_counters().bump("windows_evicted")
+        else:
+            # Defensive copies: a sub-chunk batch stays BUFFERED past
+            # this call, and np.asarray of a host array is a view — a
+            # caller reusing one preallocated batch buffer would
+            # otherwise silently corrupt the pending rows before they
+            # fold (and break the grouping-invariance contract).
+            self._pend_x.append(np.array(X, copy=True))
+            self._pend_y.append(np.array(Y, copy=True))
+            self._pend_rows += int(X.shape[0])
+            self._drain_pending()
+        self.folds += 1
+        _online_counters().bump("batches_folded")
+        return self
+
+    def _call_stats(self, X: np.ndarray, Y: np.ndarray) -> Tuple:
+        """One call's total contribution via the same canonical chunk
+        decomposition, phase 0 (window units are self-contained)."""
+        total = None
+        for s in range(0, X.shape[0], self.chunk_rows):
+            stats = self._chunk_stats(X[s:s + self.chunk_rows],
+                                      Y[s:s + self.chunk_rows])
+            if total is None:
+                total = list(stats)
+            else:
+                total = [a + b for a, b in zip(total, stats)]
+        return tuple(total)
+
+    def _drain_pending(self) -> None:
+        while self._pend_rows >= self.chunk_rows:
+            Xc, Yc = self._take_pending(self.chunk_rows)
+            self._add(self._chunk_stats(Xc, Yc))
+
+    def _take_pending(self, n: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Pop exactly n rows off the pending buffer (n <= pending)."""
+        xs, ys, got = [], [], 0
+        while got < n:
+            X0, Y0 = self._pend_x[0], self._pend_y[0]
+            take = min(n - got, X0.shape[0])
+            xs.append(X0[:take])
+            ys.append(Y0[:take])
+            if take == X0.shape[0]:
+                self._pend_x.pop(0)
+                self._pend_y.pop(0)
+            else:
+                self._pend_x[0] = X0[take:]
+                self._pend_y[0] = Y0[take:]
+            got += take
+        self._pend_rows -= n
+        return (
+            xs[0] if len(xs) == 1 else np.concatenate(xs, axis=0),
+            ys[0] if len(ys) == 1 else np.concatenate(ys, axis=0),
+        )
+
+    def flush(self) -> None:
+        """Fold any pending partial chunk now (a short chunk). Resets
+        the absolute phase — only decay (which rescales history anyway)
+        and checkpoint-independent callers should force this."""
+        if self._pend_rows > 0:
+            Xc, Yc = self._take_pending(self._pend_rows)
+            self._add(self._chunk_stats(Xc, Yc))
+
+    # -- forgetting --------------------------------------------------------
+
+    def decay(self, gamma: float) -> "OnlineState":
+        """Scale every retained sum by γ ∈ (0, 1]: data folded a calls
+        ago ends up weighted γ^a — the exponentially-weighted ridge
+        problem. Pending rows flush first (they belong to the
+        pre-decay regime). Exclusive with the window ring."""
+        gamma = float(gamma)
+        if not 0.0 < gamma <= 1.0:
+            raise OnlineStateError(f"decay must be in (0, 1], got {gamma}")
+        if self.window is not None:
+            raise OnlineStateError(
+                "decay and window are exclusive forgetting modes"
+            )
+        if gamma == 1.0:
+            return self
+        self.flush()
+        self.gram *= gamma
+        self.atb *= gamma
+        self.x_sum *= gamma
+        self.y_sum *= gamma
+        self.rows *= gamma
+        self.decays += 1
+        return self
+
+    # -- solving -----------------------------------------------------------
+
+    def _totals_with_pending(self) -> Tuple:
+        """Current totals INCLUDING pending rows, computed on copies so
+        the live buffer keeps its phase for future folds."""
+        if self._pend_rows == 0:
+            return (self.gram, self.atb, self.x_sum, self.y_sum, self.rows)
+        xs = (self._pend_x[0] if len(self._pend_x) == 1
+              else np.concatenate(self._pend_x, axis=0))
+        ys = (self._pend_y[0] if len(self._pend_y) == 1
+              else np.concatenate(self._pend_y, axis=0))
+        tail = self._chunk_stats(xs, ys)
+        return (
+            self.gram + tail[0], self.atb + tail[1],
+            self.x_sum + tail[2], self.y_sum + tail[3],
+            self.rows + tail[4],
+        )
+
+    def solve(self, lam: float = 0.0, refine_steps: int = 1,
+              fit_intercept: bool = True):
+        """Re-solve the retained problem via the existing Cholesky path
+        (``linalg.normal_equations._chol_solve``). Returns ``(W, b)``
+        (``b`` None without an intercept). Centering is applied as the
+        exact f64 rank-one correction of the uncentered sums — the
+        weighted-mean form, so decay/window states solve their weighted
+        problem with the matching intercept."""
+        import jax.numpy as jnp
+
+        from keystone_tpu.linalg.normal_equations import _chol_solve
+
+        gram, atb, x_sum, y_sum, n = self._totals_with_pending()
+        if n <= 0:
+            raise OnlineStateError("solve on an empty online state refused")
+        _online_counters().bump("resolves")
+        if fit_intercept:
+            x_mean = x_sum / n
+            y_mean = y_sum / n
+            gram_c = gram - np.outer(x_sum, x_sum) / n
+            if atb.ndim == 1:
+                atb_c = atb - x_sum * (float(y_sum) / n)
+            else:
+                atb_c = atb - np.outer(x_sum, y_sum) / n
+        else:
+            gram_c, atb_c = gram, atb
+        cdtype = jnp.dtype(self.accum_dtype)
+        W = _chol_solve(
+            jnp.asarray(gram_c, dtype=cdtype),
+            jnp.asarray(atb_c, dtype=cdtype),
+            jnp.asarray(lam, dtype=cdtype),
+            int(refine_steps),
+        )
+        if not fit_intercept:
+            return W, None
+        b = (jnp.asarray(y_mean, dtype=W.dtype)
+             - jnp.asarray(x_mean, dtype=W.dtype) @ W)
+        return W, b
+
+    # -- checkpointing -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Exact-resume snapshot: fingerprint + f64 accumulators + the
+        pending row bytes + the window ring. NumPy round-trips bit-exact,
+        which is what makes resumed folds bit-identical."""
+        return {
+            "fingerprint": self.fingerprint(),
+            "gram": np.array(self.gram),
+            "atb": np.array(self.atb),
+            "x_sum": np.array(self.x_sum),
+            "y_sum": np.array(self.y_sum),
+            "rows": float(self.rows),
+            "folds": int(self.folds),
+            "decays": int(self.decays),
+            "pend_x": [np.array(x) for x in self._pend_x],
+            "pend_y": [np.array(y) for y in self._pend_y],
+            "ring": [tuple(np.array(a) if isinstance(a, np.ndarray) else a
+                           for a in entry) for entry in self._ring],
+        }
+
+    @classmethod
+    def from_snapshot(cls, snap: dict) -> "OnlineState":
+        """Rebuild a state from :meth:`snapshot`. The snapshot's mesh
+        manifest must match the CURRENT mesh — resuming accumulator
+        state across a mesh-width change is refused with the typed
+        ``MeshMismatchError`` (the one rule every checkpointing solver
+        shares), never a wrong-answer resume."""
+        from keystone_tpu.utils.mesh import refuse_mesh_mismatch
+
+        fp = dict(snap["fingerprint"])
+        state = cls(
+            fp["d"], tuple(fp["b_tail"]), chunk_rows=fp["chunk_rows"],
+            window=fp.get("window"),
+        )
+        expected = state.fingerprint()
+        if fp != expected:
+            refuse_mesh_mismatch(fp, expected, "online state")
+            raise OnlineStateError(
+                f"online-state snapshot holds a different problem "
+                f"({fp} != {expected}); delete it to start fresh"
+            )
+        state.gram = np.asarray(snap["gram"], dtype=np.float64)
+        state.atb = np.asarray(snap["atb"], dtype=np.float64)
+        state.x_sum = np.asarray(snap["x_sum"], dtype=np.float64)
+        state.y_sum = np.asarray(snap["y_sum"], dtype=np.float64)
+        state.rows = float(snap["rows"])
+        state.folds = int(snap["folds"])
+        state.decays = int(snap.get("decays", 0))
+        state._pend_x = [np.asarray(x) for x in snap.get("pend_x", [])]
+        state._pend_y = [np.asarray(y) for y in snap.get("pend_y", [])]
+        state._pend_rows = int(sum(x.shape[0] for x in state._pend_x))
+        state._ring = [tuple(e) for e in snap.get("ring", [])]
+        return state
+
+    def save(self, directory: str) -> None:
+        """Persist the snapshot through the atomic DiskCache (a kill
+        mid-save leaves the previous complete snapshot)."""
+        save_state_snapshot(directory, self.snapshot())
+
+    @classmethod
+    def load(cls, directory: str) -> Optional["OnlineState"]:
+        """The checkpointed state, or None when none exists. A snapshot
+        recorded under a different mesh width raises the typed
+        ``MeshMismatchError`` (see :meth:`from_snapshot`)."""
+        from keystone_tpu.workflow.disk_cache import DiskCache
+
+        snap = DiskCache(directory, suffix=".online.pkl").get(_STATE_KEY)
+        if snap is None:
+            return None
+        state = cls.from_snapshot(snap)
+        from keystone_tpu.utils.metrics import reliability_counters
+
+        reliability_counters.bump("checkpoints_resumed")
+        return state
+
+
+def save_state_snapshot(directory: str, snap: dict) -> None:
+    """Write one already-taken :meth:`OnlineState.snapshot` through the
+    atomic DiskCache — THE checkpoint write shared by ``state.save`` and
+    the trainer's off-lock writer (one key, one suffix, no drift)."""
+    from keystone_tpu.workflow.disk_cache import DiskCache
+
+    DiskCache(directory, suffix=".online.pkl").put(
+        _STATE_KEY, snap, overwrite=True
+    )
+    from keystone_tpu.utils.metrics import reliability_counters
+
+    reliability_counters.bump("checkpoints_written")
+
+
+def partial_fit_step(state: Optional[OnlineState], X, Y,
+                     decay: Optional[float] = None,
+                     window: Optional[int] = None,
+                     chunk_rows: Optional[int] = None) -> OnlineState:
+    """THE partial_fit implementation every estimator wrapper delegates
+    to: create-or-reuse the state, apply per-call decay, fold. Mutates
+    and returns ``state`` (one object across the stream)."""
+    if state is None:
+        state = OnlineState.for_batch(X, Y, chunk_rows=chunk_rows,
+                                      window=window)
+    elif window is not None and window != state.window:
+        raise OnlineStateError(
+            f"window={window} conflicts with the retained state's "
+            f"window={state.window}; the mode is fixed at state creation"
+        )
+    elif chunk_rows is not None and chunk_rows != state.chunk_rows:
+        # Same refusal as window: the fold granularity is part of the
+        # state's fingerprint identity, never silently dropped.
+        raise OnlineStateError(
+            f"chunk_rows={chunk_rows} conflicts with the retained "
+            f"state's chunk_rows={state.chunk_rows}; the granularity is "
+            "fixed at state creation"
+        )
+    if decay is not None:
+        state.decay(decay)
+    return state.fold(X, Y)
+
+
+# ---------------------------------------------------------------------------
+# Refit-head discovery (shared by Pipeline.refit_stream, OnlineTrainer,
+# and the KG105 lint rule — one definition of "the head")
+# ---------------------------------------------------------------------------
+
+
+def _skip_persist(graph, gid):
+    """See through identity cache nodes (the executor convention)."""
+    while getattr(graph.operators.get(gid), "persist", False):
+        gid = graph.dependencies[gid][0]
+    return gid
+
+
+def _head_estimator_node(graph, sink):
+    """THE definition of "the refit head": the sink must be a lazily-fit
+    estimator application (DelegatingOperator over an EstimatorOperator
+    — the ``featurize.and_then(est, data, labels)`` shape). Returns the
+    EstimatorOperator's graph id, or None for any other shape. Shared by
+    the KG105 lint rule, the runtime fallback, and the seeding path so
+    they can never disagree about what the head is."""
+    from keystone_tpu.workflow.operators import (
+        DelegatingOperator,
+        EstimatorOperator,
+    )
+
+    gid = _skip_persist(graph, sink)
+    if not isinstance(graph.operators.get(gid), DelegatingOperator):
+        return None
+    est_dep = _skip_persist(graph, graph.dependencies[gid][0])
+    if not isinstance(graph.operators.get(est_dep), EstimatorOperator):
+        return None
+    return est_dep
+
+
+def head_fit_values(graph, sink):
+    """The (features, labels) values the head estimator is fitted on,
+    evaluated through the session-cached executor walk (a pipeline that
+    already ``fit()`` in this session pays ~nothing). This is what seeds
+    a fresh online state so the FIRST refresh re-solves the whole
+    problem, not just the streamed tail."""
+    from keystone_tpu.workflow.pipeline import PipelineDataset
+
+    est_gid = _head_estimator_node(graph, sink)
+    if est_gid is None:
+        raise ValueError("not a refit-able pipeline shape")
+    feats_gid, labels_gid = graph.dependencies[est_gid]
+    feats = PipelineDataset(graph, feats_gid).get()
+    labels = PipelineDataset(graph, labels_gid).get()
+    return feats, labels
+
+
+def refit_head_estimator(graph, sink):
+    """The head estimator of a refit-able pipeline (see
+    ``_head_estimator_node``), or None when the graph has a different
+    shape (the caller decides whether that is an error or a lint
+    silence)."""
+    est_gid = _head_estimator_node(graph, sink)
+    if est_gid is None:
+        return None
+    return graph.operators[est_gid].estimator
+
+
+def combine_head(prefix, head_t):
+    """Re-attach a (re-solved) head transformer to its frozen featurize
+    prefix — THE recombination used by refit_stream ticks, trainer
+    refreshes, and resolve(), so the three surfaces can never diverge
+    on how a refreshed pipeline is assembled."""
+    if prefix is not None:
+        return prefix.and_then(head_t)
+    return head_t.to_pipeline()
+
+
+def split_fitted_head(fitted):
+    """Split a FITTED pipeline into (frozen featurize prefix or None,
+    head transformer node): the sink transformer is the head, everything
+    upstream is the frozen prefix. Returns ``(prefix_pipeline_or_None,
+    head_transformer)``."""
+    from keystone_tpu.workflow.graph import SourceId
+    from keystone_tpu.workflow.operators import TransformerOperator
+    from keystone_tpu.workflow.pipeline import Pipeline
+
+    graph, source, sink = fitted.graph, fitted.source, fitted.sink
+    gid = _skip_persist(graph, sink)
+    op = graph.operators.get(gid)
+    if not isinstance(op, TransformerOperator):
+        raise ValueError(
+            f"fitted refit pipeline's head is {op.label() if op else gid!r},"
+            " not a transformer; fit the pipeline first"
+        )
+    head_t = op.transformer
+    prefix_sink = graph.dependencies[gid][0]
+    if isinstance(prefix_sink, SourceId):
+        return None, head_t
+    return Pipeline(graph, source, prefix_sink), head_t
+
+
+# ---------------------------------------------------------------------------
+# OnlineTrainer — the continuous serving-refresh loop
+# ---------------------------------------------------------------------------
+
+
+class OnlineTrainer:
+    """Keep a model current: fold live batches, re-solve on a cadence,
+    publish versioned artifacts, hot-swap a live daemon.
+
+    ``pipeline`` is the unfitted ``featurize.and_then(head_est, X0, y0)``
+    shape; construction fits it once (the initial model; featurize
+    stages are FROZEN thereafter) and — when the head supports
+    ``partial_fit`` — prepares the retained accumulator state.
+    ``submit(X, y)`` featurizes through the frozen prefix and folds;
+    the ``_refresh_loop`` thread (cadence ``refresh_ms``, env
+    ``KEYSTONE_ONLINE_REFRESH_MS``; 0 = manual ``refresh()`` only)
+    re-solves, writes ``{artifact_dir}/{name}-gNNNN.kart`` and pushes it
+    through ``daemon.request_swap`` — the zero-dropped-requests handoff.
+
+    Failure semantics: a refresh that dies at ANY point (the
+    ``refresh_abort`` fault site, a failed swap, a full disk) is counted
+    (``refreshes_failed``), logged, and changes nothing — the daemon
+    keeps answering on its current generation and the accumulators are
+    untouched, so the next cadence tick simply retries. With
+    ``checkpoint_dir``, the state snapshots after every fold: a killed
+    trainer process resumes bit-identically (mesh-width changes refused,
+    typed)."""
+
+    def __init__(self, pipeline, daemon=None, artifact_dir: Optional[str] = None,
+                 *, refresh_ms: Optional[float] = None,
+                 decay: Optional[float] = None,
+                 window: Optional[int] = None,
+                 chunk_rows: Optional[int] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 feature_shape: Optional[Tuple[int, ...]] = None,
+                 name: str = "online", start: Optional[bool] = None,
+                 seed_state: bool = True, keep_artifacts: int = 8):
+        from keystone_tpu.config import config
+        from keystone_tpu.utils.reliability import active_plan
+
+        self.name = str(name)
+        self._daemon = daemon
+        self._artifact_dir = artifact_dir
+        self._checkpoint_dir = checkpoint_dir
+        self._feature_shape = feature_shape
+        self._chunk_rows = chunk_rows
+        # Resolved ONCE (the active_plan discipline): refresh cadence,
+        # forgetting knobs, fault plan.
+        self._refresh_ms = (
+            config.online_refresh_ms if refresh_ms is None
+            else float(refresh_ms)
+        )
+        if decay is None:
+            decay = (
+                config.online_decay if config.online_decay != 1.0 else None
+            )
+        if window is None:
+            window = config.online_window or None
+        if decay is not None and window is not None:
+            raise OnlineStateError(
+                "decay and window are exclusive forgetting modes"
+            )
+        self._decay = decay
+        self._window = window
+        self._plan = active_plan()
+        head = refit_head_estimator(pipeline.graph, pipeline.sink)
+        if head is None:
+            raise ValueError(
+                "OnlineTrainer needs a pipeline whose sink is a lazily-fit "
+                "estimator head (featurize.and_then(est, data, labels))"
+            )
+        if not supports_partial_fit(head):
+            raise OnlineStateError(
+                f"{type(head).__name__} does not implement partial_fit; "
+                "the refresh loop would silently full-refit every tick "
+                "(Pipeline.refit_stream supports that fallback; the "
+                "trainer refuses it)"
+            )
+        self._head = head
+        fitted = pipeline.fit()
+        self._prefix, self._head_t = split_fitted_head(fitted)
+        self._lock = threading.Lock()
+        # Serializes whole refreshes end-to-end (snapshot → solve →
+        # publish → swap): a manual refresh() racing the cadence tick
+        # could otherwise install the OLDER of two re-solves as the
+        # newest generation with zero fold debt left to trigger a
+        # correcting tick. Ordering: _refresh_lock is taken BEFORE
+        # self._lock, never the reverse.
+        self._refresh_lock = threading.Lock()
+        # Serializes checkpoint WRITES only (they run off the main lock:
+        # a multi-MB pickle-to-disk per fold must not stall
+        # resolve/refresh/stats and every other producer).
+        self._ckpt_lock = threading.Lock()
+        self._ckpt_written_folds = 0
+        self._keep_artifacts = max(1, int(keep_artifacts))
+        self._state: Optional[OnlineState] = None
+        if checkpoint_dir is not None:
+            self._state = OnlineState.load(checkpoint_dir)
+            if self._state is not None:
+                # The mismatch originates HERE, so it refuses HERE — a
+                # trainer that constructed fine but threw on every
+                # submit would keep serving the pre-kill model forever
+                # while the cadence loop saw nothing pending.
+                if self._state.window != self._window:
+                    raise OnlineStateError(
+                        f"resumed checkpoint was folded with window="
+                        f"{self._state.window}, this trainer is "
+                        f"configured window={self._window}; delete the "
+                        "checkpoint to change the forgetting mode"
+                    )
+                if (self._chunk_rows is not None
+                        and self._state.chunk_rows != self._chunk_rows):
+                    raise OnlineStateError(
+                        f"resumed checkpoint was folded at chunk_rows="
+                        f"{self._state.chunk_rows}, this trainer asks "
+                        f"for {self._chunk_rows}; delete the checkpoint "
+                        "to change the fold granularity"
+                    )
+                if self._state.decays > 0 and self._decay is None:
+                    # γ-weighted history continued UNWEIGHTED silently
+                    # changes the forgetting semantics mid-stream.
+                    # (A different γ is legal — decay is per-call — and
+                    # decay starting fresh on an undecayed resume too.)
+                    raise OnlineStateError(
+                        "resumed checkpoint carries time-decayed history "
+                        f"({self._state.decays} decay(s) applied), but "
+                        "this trainer is configured without decay; set "
+                        "decay= (or delete the checkpoint) to change the "
+                        "forgetting mode"
+                    )
+                self._ckpt_written_folds = self._state.folds
+                logger.info(
+                    "online trainer %s: resumed accumulator checkpoint "
+                    "(%d fold(s), %.0f effective rows)",
+                    self.name, self._state.folds, self._state.rows,
+                )
+        if self._state is None and seed_state:
+            # Seed with the INITIAL training problem (featurized values
+            # re-read through the session cache the fit just warmed):
+            # the first refresh then re-solves initial ∪ streamed, never
+            # a near-degenerate model from the first small batch alone.
+            # A resumed checkpoint already contains its history and is
+            # never double-seeded.
+            feats0, labels0 = head_fit_values(pipeline.graph,
+                                              pipeline.sink)
+            self._state = partial_fit_step(
+                None, feats0, labels0, window=self._window,
+                chunk_rows=self._chunk_rows,
+            )
+            if checkpoint_dir is not None:
+                self._state.save(checkpoint_dir)
+                self._ckpt_written_folds = self._state.folds
+        self._folds_since_refresh = 0
+        # The artifact sequence continues past whatever this name
+        # already published into artifact_dir: a restarted/resumed
+        # trainer must never overwrite g0001 UNDER a stale g0008 (an
+        # operator rolling back to "newest by number" would deploy the
+        # pre-kill model).
+        self._seq = self._max_published_seq()
+        self._pushed = 0
+        self._attempts = 0
+        self._fitted = fitted
+        self._last_artifact: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if start is None:
+            start = self._refresh_ms > 0
+        if start and self._refresh_ms > 0:
+            self._thread = threading.Thread(
+                target=self._refresh_loop,
+                name=f"keystone-online-refresh-{self.name}", daemon=True,
+            )
+            self._thread.start()
+
+    def _max_published_seq(self) -> int:
+        """Highest gNNNN this trainer name already wrote to
+        artifact_dir (0 when none/unset)."""
+        if self._artifact_dir is None or not os.path.isdir(
+                self._artifact_dir):
+            return 0
+        import glob
+
+        best = 0
+        pattern = os.path.join(self._artifact_dir,
+                               f"{self.name}-g[0-9]*.kart")
+        for path in glob.glob(pattern):
+            stem = os.path.basename(path)[len(self.name) + 2:-len(".kart")]
+            try:
+                best = max(best, int(stem))
+            except ValueError:
+                continue  # not ours
+        return best
+
+    # -- data path ---------------------------------------------------------
+
+    def _featurize(self, X):
+        if self._prefix is None:
+            return X
+        return self._prefix.apply(X).get()
+
+    def submit(self, X, y) -> None:
+        """Featurize one labeled batch through the frozen prefix and
+        fold it into the retained state (checkpointed when configured)."""
+        feats = self._featurize(X)
+        snap = folds = None
+        with self._lock:
+            self._state = partial_fit_step(
+                self._state, feats, y, decay=self._decay,
+                window=self._window, chunk_rows=self._chunk_rows,
+            )
+            self._folds_since_refresh += 1
+            if self._checkpoint_dir is not None:
+                # Snapshot (host memcpy) under the lock; the disk write
+                # runs OUTSIDE it so a multi-MB pickle cannot stall
+                # concurrent producers or the cadence refresh.
+                snap = self._state.snapshot()
+                folds = self._state.folds
+        if snap is not None:
+            with self._ckpt_lock:
+                # Monotonic guard: concurrent submits release the main
+                # lock in fold order but could reach the writer out of
+                # order — an older snapshot must never overwrite newer.
+                if folds > self._ckpt_written_folds:
+                    save_state_snapshot(self._checkpoint_dir, snap)
+                    self._ckpt_written_folds = folds
+
+    # -- refresh path ------------------------------------------------------
+
+    def refresh(self) -> "Pipeline":
+        """Re-solve NOW, publish, and hot-swap (when wired to a daemon).
+        Raises on failure — the caller (or the cadence loop, which
+        catches and retries next tick) decides; the failure is counted
+        either way and serving is unaffected. Whole refreshes serialize
+        (a manual call racing the cadence tick publishes in snapshot
+        order, never an older re-solve over a newer one)."""
+        try:
+            with self._refresh_lock:
+                return self._refresh_inner()
+        except BaseException:
+            _online_counters().bump("refreshes_failed")
+            raise
+
+    def _refresh_inner(self):
+        from keystone_tpu.workflow.serialization import save_artifact
+
+        if self._plan is not None:
+            # The chaos seam: a refresh killed here leaves the daemon
+            # serving its current generation and the accumulators (plus
+            # their checkpoint) intact for a bit-identical retry.
+            self._plan.maybe_raise("refresh_abort")
+        with self._lock:
+            state = self._snapshot_state_locked()
+            # Captured, NOT reset: the fold debt clears only when the
+            # publish SUCCEEDS, so a refresh that dies in
+            # save_artifact/request_swap leaves the cadence loop armed
+            # to retry next tick exactly as documented.
+            pending = self._folds_since_refresh
+            self._seq += 1
+            self._attempts += 1
+            seq = self._seq
+        # The solve runs OUTSIDE the lock (on the f64 snapshot copy):
+        # a large-d Cholesky must not stall concurrent submit() folds
+        # for its whole duration.
+        fitted = combine_head(self._prefix, self._head.solve_online(state))
+        path = None
+        if self._artifact_dir is not None:
+            path = os.path.join(
+                self._artifact_dir, f"{self.name}-g{seq:04d}.kart"
+            )
+            save_artifact(fitted, path, feature_shape=self._feature_shape)
+        if self._daemon is not None:
+            if path is None:
+                raise ValueError(
+                    "hot-swapping into a daemon needs artifact_dir"
+                )
+            self._daemon.request_swap(path)
+        with self._lock:
+            self._fitted = fitted
+            self._last_artifact = path
+            self._pushed += 1
+            # Subtract (don't zero): folds submitted DURING the publish
+            # keep their tick.
+            self._folds_since_refresh = max(
+                0, self._folds_since_refresh - pending
+            )
+        _online_counters().bump("refreshes_pushed")
+        if path is not None:
+            self._prune_artifacts(seq)
+        return fitted
+
+    def _prune_artifacts(self, latest_seq: int) -> None:
+        """Bounded retention: keep the newest ``keep_artifacts``
+        versioned artifacts, delete the rest — a steady 5s cadence must
+        not fill the volume (which would fail every future refresh and
+        the co-located checkpoints with it). The daemon holds its loaded
+        generations in memory, so deleting served files is safe."""
+        floor = latest_seq - self._keep_artifacts + 1
+        if floor <= 0:
+            return
+        import glob
+
+        pattern = os.path.join(self._artifact_dir,
+                               f"{self.name}-g[0-9]*.kart")
+        for old in glob.glob(pattern):
+            stem = os.path.basename(old)[len(self.name) + 2:-len(".kart")]
+            try:
+                seq = int(stem)
+            except ValueError:
+                continue  # not ours
+            if seq < floor:
+                try:
+                    os.unlink(old)
+                except OSError:
+                    pass  # retention is best-effort; next refresh retries
+
+    def resolve(self):
+        """Re-solve the retained state NOW and return the refreshed
+        fitted pipeline WITHOUT publishing — no artifact, no swap. The
+        read-your-current-model surface (and the bench's honest
+        re-solve timer: exactly the work a refresh adds on top of
+        publish/swap)."""
+        with self._lock:
+            state = self._snapshot_state_locked()
+        return combine_head(self._prefix, self._head.solve_online(state))
+
+    def _snapshot_state_locked(self) -> OnlineState:
+        """A deep f64 copy of the retained state (caller holds the
+        lock), so the Cholesky re-solve can run off-lock without a
+        concurrent fold tearing the accumulators mid-read.
+
+        The copy's pending tail is FLUSHED here, still under the lock:
+        the tail fold runs the RowMatrix psum collectives, and two
+        threads interleaving collective launches on one mesh (a
+        concurrent ``submit`` fold vs an off-lock tail fold) deadlock
+        the participant rendezvous. After the flush the off-lock solve
+        is collective-free (host centering + the jitted Cholesky), which
+        is safe next to anything. The LIVE state keeps its pending
+        buffer and phase untouched."""
+        state = self._state
+        if state is None or state.total_rows <= 0:
+            raise OnlineStateError("refresh with nothing folded yet refused")
+        snap = OnlineState.from_snapshot(state.snapshot())
+        snap.flush()
+        return snap
+
+    def _maybe_refresh(self) -> None:
+        with self._lock:
+            pending = self._folds_since_refresh
+        if pending <= 0:
+            return
+        try:
+            self.refresh()
+        except Exception as e:  # lint: broad-ok a failed cadence refresh is counted + logged; the loop retries next tick and serving keeps the old generation
+            logger.warning(
+                "online trainer %s: refresh failed (%s: %s); serving "
+                "keeps the current generation, retrying next tick",
+                self.name, type(e).__name__, e,
+            )
+
+    def _refresh_loop(self) -> None:
+        while not self._stop.wait(self._refresh_ms / 1e3):
+            self._maybe_refresh()
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def fitted(self):
+        """The latest fitted pipeline (initial fit, or the last refresh)."""
+        with self._lock:
+            return self._fitted
+
+    @property
+    def last_artifact(self) -> Optional[str]:
+        with self._lock:
+            return self._last_artifact
+
+    def stats(self) -> dict:
+        with self._lock:
+            state = self._state
+            return {
+                "name": self.name,
+                "refresh_ms": self._refresh_ms,
+                "decay": self._decay,
+                "window": self._window,
+                "folds": 0 if state is None else state.folds,
+                "effective_rows": (
+                    0.0 if state is None else state.total_rows
+                ),
+                "folds_since_refresh": self._folds_since_refresh,
+                # COMPLETED publishes — a dashboard must not read a
+                # failing-every-tick trainer as "refreshing" (attempts
+                # counts the tries; the gap is the failure signal).
+                "refreshes": self._pushed,
+                "refresh_attempts": self._attempts,
+                "artifact_seq": self._seq,
+                "last_artifact": self._last_artifact,
+            }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    def __enter__(self) -> "OnlineTrainer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

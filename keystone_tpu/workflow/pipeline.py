@@ -630,6 +630,151 @@ class Pipeline:
                 )
         return fp
 
+    def refit_stream(self, batches, every: int = 1, *, decay=None,
+                     window=None, state=None, seed_state: bool = True):
+        """Incrementally refit the HEAD of this pipeline on a labeled
+        stream, freezing the fitted featurize stages.
+
+        ``self`` must be the ``featurize.and_then(head_est, X0, y0)``
+        shape (sink = a lazily-fit estimator application). The pipeline
+        is fitted once up front — every featurize stage (including
+        estimator-fitted ones like feature selectors) is FROZEN from
+        then on. Each ``(X, y)`` batch from ``batches`` is featurized
+        through the frozen prefix and folded into the head's retained
+        accumulators (``head_est.partial_fit``); every ``every`` batches
+        the head is re-solved cheaply and a refreshed fitted pipeline is
+        yielded (prefix reused by reference — zero featurize refit cost).
+        A final refresh is yielded for any tail batches.
+
+        ``seed_state=True`` (default) folds the INITIAL training problem
+        into a fresh state first, so the first tick re-solves
+        initial ∪ streamed rather than the first batches alone; pass a
+        ``state`` (or ``seed_state=False``) to refit on the stream only.
+
+        A head WITHOUT ``partial_fit`` still works but silently costs a
+        FULL head refit per cadence tick (all streamed features are
+        buffered): the fallback is logged once and counted
+        (``online.full_refits``), and the static linter flags the shape
+        up front (KG105 via ``Pipeline.lint(refit=True)``). The
+        ``decay``/``window`` forgetting modes need the online path and
+        are REFUSED (not silently dropped) on the fallback.
+
+        ``decay``/``window`` select the forgetting mode (see
+        ``workflow/online.py``); ``state`` lets a caller hand in (and
+        keep observing) the retained ``OnlineState``.
+
+        Validation, the lint gate, the initial fit, and the seed all run
+        EAGERLY (this returns an inner generator): a misconfiguration
+        refuses HERE, not at whatever distant point first iterates.
+        """
+        import numpy as np
+
+        from keystone_tpu.utils.metrics import online_counters
+        from keystone_tpu.workflow.analysis import enforce_lint
+        from keystone_tpu.workflow.online import (
+            refit_head_estimator,
+            split_fitted_head,
+            supports_partial_fit,
+        )
+
+        # Opt-in static gate (KEYSTONE_LINT): KG105 names the
+        # full-refit-per-tick hazard before any batch streams.
+        enforce_lint(self, "refit_stream", refit=True)
+        head_est = refit_head_estimator(self.graph, self.sink)
+        if head_est is None:
+            raise ValueError(
+                "refit_stream needs a pipeline whose sink is a lazily-fit "
+                "estimator head (featurize.and_then(est, data, labels))"
+            )
+        fitted = self.fit()
+        prefix, _ = split_fitted_head(fitted)  # ticks rebuild the head
+        online = supports_partial_fit(head_est)
+        if not online:
+            import logging
+
+            if decay is not None or window is not None:
+                # Refuse, never silently drop: the fallback's unweighted
+                # full refit is NOT the forgetting semantics asked for.
+                raise ValueError(
+                    f"decay/window need a partial_fit head; "
+                    f"{type(head_est).__name__} would full-refit with "
+                    "every batch weighted equally"
+                )
+            if state is not None:
+                # Same rule for a caller-supplied state: the fallback
+                # never reads it, and silently excluding its retained
+                # history from every tick is a wrong model, not a mode.
+                raise ValueError(
+                    f"a caller-supplied OnlineState needs a partial_fit "
+                    f"head; {type(head_est).__name__}'s full-refit "
+                    "fallback would never fold it"
+                )
+            logging.getLogger("keystone_tpu").warning(
+                "refit_stream: %s lacks partial_fit — every cadence tick "
+                "is a FULL head refit over the buffered stream (KG105)",
+                type(head_est).__name__,
+            )
+        feats_all: List[Any] = []
+        ys_all: List[Any] = []
+        if state is None and seed_state:
+            from keystone_tpu.workflow.online import head_fit_values
+
+            feats0, labels0 = head_fit_values(self.graph, self.sink)
+            if online:
+                state = head_est.partial_fit(feats0, labels0,
+                                             window=window)
+            else:
+                # The fallback honors the seed too: its full refits run
+                # over initial ∪ streamed, same as the online path.
+                feats_all.append(feats0)
+                ys_all.append(labels0)
+
+        def tick():
+            from keystone_tpu.workflow.online import combine_head
+
+            if online:
+                new_head = head_est.solve_online(state)
+            else:
+                online_counters.bump("full_refits")
+                new_head = head_est.fit(
+                    np.concatenate([np.asarray(f) for f in feats_all]),
+                    np.concatenate([np.asarray(y) for y in ys_all]),
+                )
+            return combine_head(prefix, new_head)
+
+        def run():
+            nonlocal state
+            since = 0
+            for item in batches:
+                if not (isinstance(item, tuple) and len(item) == 2):
+                    raise ValueError(
+                        "refit_stream needs (features, labels) batches"
+                    )
+                X, y = item
+                feats = prefix.apply(X).get() if prefix is not None else X
+                if online:
+                    state = head_est.partial_fit(
+                        feats, y, state=state, decay=decay, window=window
+                    )
+                else:
+                    # NOT batches_folded: nothing reached retained
+                    # accumulators on this path — the buffer feeds the
+                    # counted full refit. Copies, same as
+                    # OnlineState.fold: a caller reusing one
+                    # preallocated batch buffer must not overwrite what
+                    # a later tick will refit on.
+                    feats_all.append(np.array(feats, copy=True))
+                    ys_all.append(np.array(y, copy=True))
+                    online_counters.bump("batches_buffered")
+                since += 1
+                if since >= int(every):
+                    since = 0
+                    yield tick()
+            if since > 0:
+                yield tick()
+
+        return run()
+
     def compiled(
         self, buckets=None, max_batch=None, donate=None, devices=None,
         inflight=None,
@@ -660,18 +805,20 @@ class Pipeline:
     # -- introspection -----------------------------------------------------
 
     def lint(self, example=None, serve: bool = False,
-             have_ladder=None) -> "LintReport":
+             have_ladder=None, refit: bool = False) -> "LintReport":
         """Statically lint the pipeline DAG (workflow/analysis.py): the
         abstract shape/dtype pass plus the KG rule catalog. ``example``
         (sample batch, ShapeDtypeStruct, or per-row feature-shape tuple)
         feeds shape propagation; ``serve=True`` escalates serveability
-        findings to errors — the would-be ``compiled()`` contract.
+        findings to errors — the would-be ``compiled()`` contract;
+        ``refit=True`` checks the ``refit_stream`` contract (KG105).
         Returns a ``LintReport``; never executes the graph."""
         from keystone_tpu.workflow.analysis import lint_graph
 
         return lint_graph(
             self.graph, self.source, self.sink,
             example=example, serve=serve, have_ladder=have_ladder,
+            refit=refit,
         )
 
     def transformers(self) -> List[Transformer]:

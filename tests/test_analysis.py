@@ -377,3 +377,64 @@ def test_kg104_env_pin_reads_live(monkeypatch):
     monkeypatch.setenv("KEYSTONE_SOLVE_CHUNK_ROWS", str(1 << 22))
     monkeypatch.setattr(config, "solve_chunk_rows", 0)
     assert p.lint(example=(8,), have_ladder=True).by_rule("KG104")
+
+
+# ---------------------------------------------------------------------------
+# KG105 — refit_stream head without partial_fit (ISSUE-15)
+# ---------------------------------------------------------------------------
+
+
+def _refit_pipeline(head):
+    X = np.zeros((8, 8), np.float32)
+    y = np.zeros((8, 3), np.float32)
+    return L2Normalizer().and_then(head, X, y)
+
+
+def test_kg105_flags_batch_only_head_under_refit():
+    from keystone_tpu.workflow import LabelEstimator
+
+    class BatchOnlyHead(LabelEstimator):
+        def fit(self, X, y):
+            return LinearMapper(np.zeros((8, 3), np.float32))
+
+    hits = _refit_pipeline(BatchOnlyHead()).lint(
+        example=(8,), have_ladder=True, refit=True
+    ).by_rule("KG105")
+    assert hits and hits[0].severity == "warning"
+    assert "partial_fit" in hits[0].message
+    assert "FULL head refit" in hits[0].message
+    assert "BatchOnlyHead" in hits[0].node
+
+
+def test_kg105_silent_on_online_head_and_without_refit():
+    from keystone_tpu.nodes.learning import LinearMapEstimator
+    from keystone_tpu.workflow import LabelEstimator
+
+    # The whole normal-equation family implements the contract.
+    p = _refit_pipeline(LinearMapEstimator(lam=1e-3))
+    assert not p.lint(example=(8,), have_ladder=True,
+                      refit=True).by_rule("KG105")
+
+    class BatchOnlyHead(LabelEstimator):
+        def fit(self, X, y):
+            return LinearMapper(np.zeros((8, 3), np.float32))
+
+    # A batch-only head is a fine BATCH pipeline: silent unless the
+    # refit contract is requested.
+    assert not _refit_pipeline(BatchOnlyHead()).lint(
+        example=(8,), have_ladder=True
+    ).by_rule("KG105")
+
+
+def test_kg105_weighted_block_head_flags():
+    """BlockWeighted nulls the online contract (per-batch folds cannot
+    know the full class counts) — the lint must see that, not just a
+    missing attribute."""
+    from keystone_tpu.nodes.learning.block_least_squares import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+
+    hits = _refit_pipeline(
+        BlockWeightedLeastSquaresEstimator(lam=1e-3)
+    ).lint(example=(8,), have_ladder=True, refit=True).by_rule("KG105")
+    assert hits and "BlockWeightedLeastSquaresEstimator" in hits[0].node

@@ -268,3 +268,47 @@ def test_serve_precision_healthy_rerun_passes(history):
     _append_serve_row(history, mutate, metric="serve_precision")
     result = bench_watch.run(str(history))
     assert result["ok"], result["regressions"]
+
+
+def test_online_family_loaded_and_regression_flagged(history):
+    """ISSUE-15: the `make bench-online` fit_online row gates under the
+    same generic loader — the re-solve speedup regressing down, the
+    post-refresh accuracy / recovery sliding down, the re-solve wall
+    creeping up, dropped requests appearing, or the swap gate flipping
+    false all fail the watch."""
+    path = os.path.join(str(history), "BENCH_fit.json")
+    rows = [json.loads(line) for line in open(path)]
+    latest = [r for r in rows if r.get("metric") == "fit_online"][-1]
+    row = json.loads(json.dumps(latest))
+    row["value"] *= 0.2  # re-solve speedup collapses
+    row["detail"]["post_refresh_accuracy"] *= 0.3
+    row["detail"]["accuracy_recovery"] *= 0.3
+    row["detail"]["resolve_wall_s"] *= 4.0
+    row["detail"]["swap_gate"] = False
+    with open(path, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    result = bench_watch.run(str(history))
+    assert not result["ok"]
+    names = {v["series"] for v in result["regressions"]}
+    assert "fit:fit_online:value" in names
+    assert "fit:fit_online:detail.post_refresh_accuracy" in names
+    assert "fit:fit_online:detail.accuracy_recovery" in names
+    assert "fit:fit_online:detail.resolve_wall_s" in names
+    assert "fit:fit_online:detail.swap_gate" in names
+
+
+def test_online_family_healthy_rerun_passes(history):
+    """A same-fingerprint re-run inside the noise band must stay green
+    (the band gates the trajectory, not determinism)."""
+    path = os.path.join(str(history), "BENCH_fit.json")
+    rows = [json.loads(line) for line in open(path)]
+    latest = [r for r in rows if r.get("metric") == "fit_online"][-1]
+    row = json.loads(json.dumps(latest))
+    row["value"] *= 1.1
+    row["detail"]["resolve_wall_s"] *= 0.95
+    with open(path, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    result = bench_watch.run(str(history))
+    bad = [v for v in result["regressions"]
+           if v["series"].startswith("fit:fit_online:")]
+    assert not bad, bad

@@ -94,9 +94,13 @@ LOWER_BETTER = (
 #: Leaf-name fragments that mark a higher-is-better series (rates,
 #: speedups, utilization). ``scaling`` covers the fit_multichip rows/s
 #: scaling value; ``rows_per`` its per-width throughput leaves.
+#: ``accuracy``/``recovery`` cover the fit_online drift family: the
+#: post-refresh accuracy on the shifted stream (and how much of the
+#: drift loss the refresh won back) sliding down is a regression even
+#: while the re-solve wall still wins.
 HIGHER_BETTER = (
     "tflops", "throughput", "per_s", "per_sec", "speedup", "img_per",
-    "rows_per", "mfu", "scaling",
+    "rows_per", "mfu", "scaling", "accuracy", "recovery",
 )
 
 

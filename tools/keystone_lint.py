@@ -84,7 +84,10 @@ KNOWN_THREAD_TARGETS = {"_watchdog_loop", "_watch_loop", "_solve_watch_loop",
                         # workflow/daemon.py ServingDaemon: the socket
                         # ingress accept thread, its per-connection
                         # workers, and the hot-swap worker.
-                        "_accept_loop", "_serve_conn", "_swap_loop"}
+                        "_accept_loop", "_serve_conn", "_swap_loop",
+                        # workflow/online.py OnlineTrainer: the cadence
+                        # refresh worker (re-solve + artifact + swap).
+                        "_refresh_loop"}
 HOST_SYNC_CALLS = {"block_until_ready", "device_get", "asarray", "array"}
 
 #: Mutating method names treated as writes for KL001 (deque/list/set/dict
