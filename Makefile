@@ -1,6 +1,6 @@
 # Developer entry points. Pipelines launch via bin/run-pipeline.sh.
 
-.PHONY: test t1 chaos native bench bench-serve bench-serve-overload bench-serve-replicas bench-serve-daemon bench-serve-precision bench-fit bench-opt bench-multichip bench-online trace-demo obs-serve serve-daemon profile-demo bench-watch lint dryrun clean tpu-checkride sentinel northstar acceptance
+.PHONY: test t1 chaos native bench bench-serve bench-serve-overload bench-serve-replicas bench-serve-daemon bench-serve-precision bench-fit bench-opt bench-multichip bench-imagenet bench-online trace-demo obs-serve serve-daemon profile-demo bench-watch lint dryrun clean tpu-checkride sentinel northstar acceptance
 
 # The canonical tier-1 verify (ROADMAP.md), verbatim at the defaults —
 # builders and CI invoke this one entry point instead of hand-copying the
@@ -21,6 +21,12 @@ t1:
 # conn_drop, zero unresolved futures; clients simply retry).
 chaos:
 	$(MAKE) t1 T1_ENV="KEYSTONE_FAULTS=io:0.05,oom:1,conn_drop:0.05 KEYSTONE_FAULTS_SEED=0" T1_LOG=/tmp/_chaos.log
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  KEYSTONE_FAULTS=io:0.05,oom:1 KEYSTONE_FAULTS_SEED=0 \
+	  python -m keystone_tpu.pipelines.images.imagenet_sift_lcs_fv \
+	  --stream --fv-backend pallas --gmm-k 2 --pca-dims 4 --top-k 2 \
+	  --synthetic-n 96 --synthetic-classes 4 --stream-batch 32 \
+	  --fit-sample-images 64 --checkpoint-dir /tmp/_chaos_imagenet_ckpt
 
 # One-command resumable live-chip evidence harness: probes the TPU, runs
 # bench f32/bf16 + MFU sweep + Pallas Mosaic compile + streamed-overlap +
@@ -169,6 +175,22 @@ bench-opt:
 # BENCH_fit.json history `make bench-watch` regresses against.
 bench-multichip:
 	JAX_PLATFORMS=cpu python tools/bench_multichip.py --out BENCH_fit.json
+
+# Real-pipeline multichip bench: the ImageNet SIFT+LCS+FV featurize ->
+# BlockLS solve chain fitted in 1-device and N-fake-device subprocesses
+# (bench-multichip precedent), with the fused jittable tail lowered
+# through SpecLayout.jit under buffer donation. Hard gates: sharded
+# predictions bit-identical to the single-device walk, donation
+# invisible (donate-on preds digest == donate-off), Pallas FV active on
+# the sharded path (counter-verified), zero silent fallbacks, and the
+# donation decision path exercised (buffers_donated + donation_refused
+# > 0 — the flagship's shrinking featurize stages legitimately refuse,
+# see README "Fused & donated fits"). Rows/s scaling and the
+# donated-vs-undonated peak-HBM gate are hard only on real multi-chip
+# hardware (fake CPU devices time-slice the host and report no HBM).
+# APPENDS the fingerprinted fit_imagenet_multichip row to BENCH_fit.json.
+bench-imagenet:
+	JAX_PLATFORMS=cpu python tools/bench_imagenet.py --out BENCH_fit.json
 
 # Online-learning drift gate: a label-shifted synthetic stream folds
 # into the retained gram/AtB accumulators with time-decay, re-solves,

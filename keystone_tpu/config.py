@@ -153,6 +153,24 @@ class Config:
     shard_min_rows: int = field(
         default_factory=lambda: _env_int("KEYSTONE_SHARD_MIN_ROWS", 64)
     )
+    # Buffer donation across the fused-fit plumbing: the sharded chain
+    # call donates the staging copy it creates for a host batch
+    # (utils/mesh.py SpecLayout.jit) when an output can alias it, and the
+    # solver hot loops donate their dead accumulator/residual buffers
+    # (linalg/row_matrix.py donate_argnums) — each update then holds ONE
+    # live copy instead of two, capping the fit's HBM high-water.
+    # Donation never touches caller-owned arrays (anything placed
+    # upstream can be multi-consumer via gather/memo), and is refused —
+    # counted, never silent — when no output matches the buffer's
+    # shape/dtype (XLA aliasing is aval-matched, so donating there would
+    # be a warning and a no-op). KEYSTONE_DONATE_BUFFERS=0 pins donation
+    # off everywhere: the bench's non-donated A/B control and the
+    # debugging escape hatch when a deleted-buffer error needs isolating.
+    donate_buffers: bool = field(
+        default_factory=lambda: os.environ.get(
+            "KEYSTONE_DONATE_BUFFERS", ""
+        ).lower() not in ("0", "false", "no")
+    )
     # Feature blocks whose gram ridge inverses are factorized together in
     # ONE batched XLA program (batched Cholesky + triangular solves over a
     # leading block axis). TPU lowers a single b×b factorization to a

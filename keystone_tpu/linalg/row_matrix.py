@@ -41,8 +41,15 @@ def donate_argnums(mesh: Mesh, *argnums: int):
     """donate_argnums for the solver hot loops on real hardware: the old
     residual/weight/accumulator buffers are dead the moment the update
     returns, and donating them caps the solver's HBM high-water at one live
-    copy (SURVEY.md §5 sanitizer row's donation/aliasing prescription). CPU
-    ignores donation with a per-call warning, so only device meshes opt in."""
+    copy (SURVEY.md §5 sanitizer row's donation/aliasing prescription).
+    Gated on ``config.donate_buffers`` (KEYSTONE_DONATE_BUFFERS=0 pins the
+    non-donated baseline for A/B benches and deleted-buffer debugging).
+    CPU meshes keep the legacy refusal: these loops predate runtimes that
+    honor host donation, and their CPU test surface pins the undonated
+    lowering — the workflow layer's staged-chain donation
+    (``SpecLayout.jit``) is the path that donates on every backend."""
+    if not config.donate_buffers:
+        return ()
     if mesh.devices.flat[0].platform == "cpu":
         return ()
     return argnums

@@ -106,6 +106,11 @@ class ImageNetSiftLcsFVConfig:
     stream: bool = False
     stream_batch: int = 256
     fit_sample_images: int = 512
+    # Checkpoint directory for the chunked/streamed solve: the BCD solver
+    # snapshots per-chunk accumulator state there and resumes after a
+    # crash (including one mid-way through the donated chunk loop — the
+    # chaos harness pins that path). None = no checkpointing.
+    checkpoint_dir: Optional[str] = None
 
 
 def resolve_scale(conf: ImageNetSiftLcsFVConfig) -> ImageNetSiftLcsFVConfig:
@@ -254,6 +259,7 @@ def run_streamed(conf: ImageNetSiftLcsFVConfig) -> dict:
         num_iters=conf.num_iters,
         lam=conf.lam,
         mixture_weight=conf.mixture_weight,
+        checkpoint_dir=conf.checkpoint_dir,
         stream=True,  # feature blocks stream to the device, double-buffered
     )
     model = solver.fit(A_host, targets)
@@ -357,6 +363,7 @@ def run(conf: ImageNetSiftLcsFVConfig) -> dict:
         num_iters=conf.num_iters,
         lam=conf.lam,
         mixture_weight=conf.mixture_weight,
+        checkpoint_dir=conf.checkpoint_dir,
     )
     scored = featurizer.and_then(solver, train.data, targets)
     if conf.augment:
@@ -410,6 +417,8 @@ def main(argv=None):
                    help="out-of-core: stream images, hold only features")
     p.add_argument("--stream-batch", type=int, default=256)
     p.add_argument("--fit-sample-images", type=int, default=512)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="snapshot/resume dir for the chunked solve")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--synthetic-n", type=int, default=512)
     p.add_argument("--synthetic-classes", type=int, default=16)
@@ -431,6 +440,7 @@ def main(argv=None):
             stream=a.stream,
             stream_batch=a.stream_batch,
             fit_sample_images=a.fit_sample_images,
+            checkpoint_dir=a.checkpoint_dir,
             seed=a.seed,
             synthetic_n=a.synthetic_n,
             synthetic_classes=a.synthetic_classes,

@@ -141,11 +141,23 @@ class SpecLayout:
         """Replicated: fitted params, grams, solved weights."""
         return NamedSharding(self.mesh, P())
 
-    def jit(self, fn, **jit_kwargs):
+    def jit(self, fn, donate_argnums=(), **jit_kwargs):
         """Lower ``fn`` (batch -> batch, row-independent) ONCE with the
         convention's explicit shardings: rows sharded in, rows sharded
         out. The explicit specs — not input inheritance — are what make
-        the chain's placement a contract instead of an accident."""
+        the chain's placement a contract instead of an accident.
+
+        ``donate_argnums`` is honored only under ``config.donate_buffers``
+        (KEYSTONE_DONATE_BUFFERS=0 pins it off) and is the caller's claim
+        that those buffers are dead after the call — donate ONLY staging
+        copies the caller itself created, never arrays it was handed:
+        a donated buffer is deleted, and any later read raises jax's
+        deleted-buffer RuntimeError. Unlike the solver loops'
+        ``row_matrix.donate_argnums``, this does not refuse CPU meshes:
+        the current runtime honors donation there too, which is what lets
+        the fake-device tests pin deletion and aliasing for real."""
+        if donate_argnums and config.donate_buffers:
+            jit_kwargs["donate_argnums"] = donate_argnums
         return jax.jit(
             fn, in_shardings=self.data(), out_shardings=self.data(),
             **jit_kwargs,
@@ -295,10 +307,14 @@ def batch_layout(x) -> Optional[SpecLayout]:
     - An already row-sharded device array (the DatasetOperator placement)
       returns its own layout: the chain re-lowers with those explicit
       specs instead of trusting propagation.
-    - A host numeric batch whose rows do NOT divide the default mesh —
-      the silent single-device cliff of old — returns the default layout
-      when padding is worth it (>= ``config.shard_min_rows`` rows): the
-      chain call mask-pads, runs sharded, and trims.
+    - A host numeric batch at or above ``config.shard_min_rows`` rows
+      returns the default layout: the chain call STAGES it onto the mesh
+      itself (``put`` for the divisible "shard" class, ``pad_put`` +
+      trim for the "pad" class — the old silent single-device cliff) and
+      owns the staging copy, which is what makes it donatable into the
+      lowered chain (``config.donate_buffers``). Host arrivals are the
+      streamed-fit common case: the jittable tail of a mixed chain takes
+      its input from the host stage before it.
     - Everything else (sub-minimum batches, non-numeric data, 1-share
       meshes) returns None.
     """
@@ -307,9 +323,7 @@ def batch_layout(x) -> Optional[SpecLayout]:
         return layout
     if isinstance(x, jax.Array):  # placed already (replicated/one device)
         return None
-    if host_batch_shard_class(x) != "pad":
-        # Divisible host batches are placed by DatasetOperator (a direct
-        # batch_call on one keeps today's propagation path); small /
-        # non-numeric batches have nothing to pad.
+    if host_batch_shard_class(x) not in ("pad", "shard"):
+        # Small / non-numeric batches have nothing to stage.
         return None
     return SpecLayout.for_mesh()

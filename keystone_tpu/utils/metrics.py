@@ -1164,6 +1164,12 @@ def _memory_analysis(compiled) -> Dict[str, float]:
         ("argument_size_in_bytes", "argument_bytes"),
         ("output_size_in_bytes", "output_bytes"),
         ("generated_code_size_in_bytes", "code_bytes"),
+        # Donated-and-aliased input bytes: a donated lowering's working
+        # set is argument+output+temp MINUS alias (the aliased buffers
+        # are the same memory counted twice) — the backend-portable
+        # evidence that donation lowered the high-water, usable where
+        # peak_bytes_in_use isn't reported (CPU fake-device runs).
+        ("alias_size_in_bytes", "alias_bytes"),
     ):
         v = getattr(mem, attr, None)
         if isinstance(v, (int, float)) and not isinstance(v, bool):
@@ -1750,6 +1756,15 @@ class ShardingCounters(CounterSet):
       that genuinely ran single-device (the ONLY surviving fallback)
     - ``fallback_row_coupled`` — pad-unsound (row_independent=False)
       chains that kept the propagation path for a non-divisible batch
+    - ``buffers_donated`` — staged chain inputs donated into the lowered
+      chain (the buffer aliases an output; one live copy, not two)
+    - ``donation_refused`` — staged calls under ``config.donate_buffers``
+      where no output aval could alias the buffer (shrinking/growing
+      chains): donation would be a warning and a no-op, so it is refused
+      up front and counted instead of silently dropped
+    - ``pallas_sharded_calls`` — sharded chain executions whose lowered
+      body runs a Pallas kernel (``uses_pallas``) — the 'kernel actually
+      active on the sharded path' evidence the ImageNet bench gates on
     """
 
 

@@ -45,6 +45,13 @@ Rule catalog (KG = Keystone Graph):
   estimator does not implement ``partial_fit``: every cadence tick then
   silently costs a FULL head refit over the buffered stream instead of
   a cheap accumulator re-solve.
+- ``KG106 undonated-fit-chain`` — with ``config.donate_buffers`` on, an
+  estimator's jittable feature chain takes its input from a dataset the
+  runtime places directly onto the mesh (the divisible "shard" class):
+  the placed array is caller-owned, so the fused lowering runs WITHOUT
+  donating its input and the fit holds the batch live twice (input +
+  chain output). Host-staged arrivals (streamed batches, the pad class)
+  donate their staging copy instead. Shape-only, no execution.
 - ``KG201 dead-node`` — a node in the graph unreachable from the sink
   (composition orphans the pruner should have dropped).
 - ``KG202 cache-advice`` — a non-trivial subchain re-used by >= 2
@@ -56,8 +63,8 @@ Rule catalog (KG = Keystone Graph):
 
 Severity model: serveability rules (KG00x) are *errors* when linting
 with ``serve=True`` (the pre-``compiled()`` gate) and *warnings*
-otherwise; KG101/KG102/KG103/KG104/KG105 are warnings; KG201/KG202/KG203
-are info.
+otherwise; KG101/KG102/KG103/KG104/KG105/KG106 are warnings;
+KG201/KG202/KG203 are info.
 
 Wire-up: ``Pipeline.lint()`` runs this directly; the opt-in env gate
 ``KEYSTONE_LINT=warn|error|off`` (default off) runs it before every
@@ -99,6 +106,8 @@ GRAPH_RULES: Dict[str, str] = {
     "KG104": "pinned serve ladder / solve chunk priced beyond the HBM budget",
     "KG105": "refit_stream head estimator lacks partial_fit (full refit "
              "per cadence tick)",
+    "KG106": "estimator's fit chain lowers without donation (mesh-placed "
+             "caller-owned input)",
     "KG201": "dead node unreachable from the pipeline sink",
     "KG202": "re-used subchain with no cache node",
     "KG203": "stored measured profile exists but auto-cache is model-only",
@@ -512,6 +521,68 @@ def lint_graph(
                 hint="size batches to a multiple of the mesh "
                      f"width ({shards}) to shard without padding",
             ))
+
+        # -- KG106: estimator fit chain lowers without donation --------
+        # Same classifier, same shape-only discipline as KG103. The
+        # "shard" class is placed onto the mesh by DatasetOperator, so
+        # the fused chain's input arrives caller-owned: the lowering
+        # cannot donate it (placed values can be multi-consumer via
+        # gather / the by-hash memo), and an accumulator-carrying fit
+        # over it holds batch + chain output live at once while
+        # ``config.donate_buffers`` promises one live copy. Host-staged
+        # arrivals (streamed batches, the pad class) donate the staging
+        # copy the chain call itself creates.
+        if config.donate_buffers:
+
+            def _feeds_estimator_via_jittable(start: NodeId) -> bool:
+                """Does a jittable chain stand between this dataset and
+                an estimator's fit? Walk downstream like KG103's helper,
+                but keep going past the first jittable stage until an
+                ``EstimatorOperator`` consumes the chain's output."""
+                seen = set()
+                stack = [(start, False)]
+                while stack:
+                    nid_, jit_seen = stack.pop()
+                    for u in consumers.get(nid_, ()):
+                        if not isinstance(u, NodeId) or (u, jit_seen) in seen:
+                            continue
+                        seen.add((u, jit_seen))
+                        u_op = graph.operators.get(u)
+                        if isinstance(u_op, EstimatorOperator):
+                            if jit_seen:
+                                return True
+                        elif isinstance(u_op, TransformerOperator):
+                            stack.append((
+                                u,
+                                jit_seen or getattr(
+                                    u_op.transformer, "jittable", False
+                                ),
+                            ))
+                        elif getattr(u_op, "persist", False):
+                            stack.append((u, jit_seen))
+                return False
+
+            for nid in (order if shards > 1 else ()):
+                op = graph.operators[nid]
+                if not isinstance(op, DatasetOperator):
+                    continue
+                if host_batch_shard_class(op.data, shards) != "shard":
+                    continue
+                if not _feeds_estimator_via_jittable(nid):
+                    continue
+                rows = int(op.data.shape[0])
+                emit(Diagnostic(
+                    "KG106", "warning", _node_label(graph, nid),
+                    f"fit chain over this {rows}-row mesh-placed batch "
+                    "lowers WITHOUT donation (the placed input is "
+                    "caller-owned), so the fit holds batch + chain "
+                    "output live at once while config.donate_buffers "
+                    "promises in-place updates",
+                    hint="stream the batches (host arrivals stage-and-"
+                         "donate their copy), or pin "
+                         "KEYSTONE_DONATE_BUFFERS=0 if two live copies "
+                         "are intended",
+                ))
 
     # -- KG104: pinned memory plan priced beyond the HBM budget ------------
     # Shape-only pricing off the propagated specs — no execution, no

@@ -134,36 +134,116 @@ class Transformer:
             object.__setattr__(self, "_jit_cache", fn)
         return fn
 
-    def _jitted_sharded(self, layout) -> Callable:
+    def apply_sharded(self, X, layout):
+        """The chain body the sharded lowering traces — ``apply_batch``
+        unless a transformer needs the mesh layout to pick a sharded
+        kernel strategy (``FisherVector``'s Pallas backend wraps its
+        kernel in ``shard_map`` on real TPU meshes; everywhere else the
+        plain body partitions under GSPMD bit-identically)."""
+        return self.apply_batch(X)
+
+    #: Does this chain run a Pallas kernel? Drives the
+    #: ``pallas_sharded_calls`` evidence counter on the sharded path.
+    uses_pallas: bool = False
+
+    def _jitted_sharded(self, layout, donate: bool = False) -> Callable:
         """The chain lowered ONCE per mesh layout with the SpecLayout
         convention's explicit shardings (rows sharded in, rows sharded
-        out) — memoized per (transformer, layout) like ``_jitted``."""
+        out) — memoized per (transformer, layout, donate) like
+        ``_jitted``. The donated variant aliases the staged input buffer
+        into the chain's output (``SpecLayout.jit`` donation)."""
         cache = getattr(self, "_shard_jit_cache", None)
         if cache is None:
             cache = {}
             object.__setattr__(self, "_shard_jit_cache", cache)
-        fn = cache.get(layout)
+        key = (layout, donate)
+        fn = cache.get(key)
         if fn is None:
-            fn = cache[layout] = layout.jit(self.apply_batch)
+            body = lambda X: self.apply_sharded(X, layout)  # noqa: E731
+            fn = cache[key] = layout.jit(
+                body, donate_argnums=(0,) if donate else ()
+            )
         return fn
 
+    def _donation_eligible(self, X, layout) -> bool:
+        """Can the staged input buffer alias into this chain's output?
+        XLA matches donated buffers to outputs by aval (shape + dtype);
+        a shrinking/growing chain has no match, so donating there would
+        be a per-compile warning and a no-op — refused up front (and
+        counted by the caller). Shape-only: one ``eval_shape`` per
+        (shape, dtype, layout), memoized beside the jit cache."""
+        from keystone_tpu.config import config
+
+        if not config.donate_buffers:
+            return False
+        cache = getattr(self, "_donate_ok_cache", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(self, "_donate_ok_cache", cache)
+        key = (tuple(X.shape), str(X.dtype), layout)
+        ok = cache.get(key)
+        if ok is None:
+            try:
+                spec = jax.ShapeDtypeStruct(X.shape, X.dtype)
+                out = jax.eval_shape(
+                    lambda a: self.apply_sharded(a, layout), spec
+                )
+                leaves = jax.tree_util.tree_leaves(out)
+                ok = any(
+                    getattr(leaf, "shape", None) == spec.shape
+                    and getattr(leaf, "dtype", None) == spec.dtype
+                    for leaf in leaves
+                )
+            except Exception:  # lint: broad-ok abstract eval is best-effort; anything it can't trace just keeps the undonated lowering
+                ok = False
+            cache[key] = ok
+        return ok
+
+    def _staged_call(self, staged, layout):
+        """Run the lowered chain on a staging buffer ``_sharded_call``
+        itself created (``put``/``pad_put``) — the ONLY buffers the chain
+        ever donates: they are provably dead here, unlike caller-owned
+        arrays (anything placed upstream can be multi-consumer via
+        gather/by-hash memo). Donation is refused — counted, never
+        silent — when no output aval can alias the buffer."""
+        from keystone_tpu.utils.metrics import sharding_counters
+
+        donate = self._donation_eligible(staged, layout)
+        if donate:
+            sharding_counters.bump("buffers_donated")
+        else:
+            from keystone_tpu.config import config
+
+            if config.donate_buffers:
+                sharding_counters.bump("donation_refused")
+        if self.uses_pallas:
+            sharding_counters.bump("pallas_sharded_calls")
+        return self._jitted_sharded(layout, donate=donate)(staged)
+
     def _sharded_call(self, X, layout):
-        """Run the chain data-parallel under ``layout``: divisible batches
-        go straight through the explicitly-specced jit; non-divisible host
-        batches are mask-padded onto the mesh, run at the padded shape,
-        and trimmed back — row-independence makes the pad rows inert, so
-        outputs are bit-identical to the unsharded walk while the compute
-        spans every shard. Row-coupled chains (padding unsound) keep the
-        propagation path, counted so the narrow run is visible."""
+        """Run the chain data-parallel under ``layout``: host batches are
+        staged onto the mesh by this call (``put`` when divisible,
+        mask-pad + trim otherwise) and the staging copy is donated into
+        the lowered chain where an output can alias it; already-sharded
+        device batches go straight through the explicitly-specced jit,
+        never donated (the caller owns them). Row-independence makes pad
+        rows inert, so outputs are bit-identical to the unsharded walk
+        while the compute spans every shard. Row-coupled host chains
+        (padding unsound, rows non-divisible) keep the propagation path,
+        counted so the narrow run is visible."""
         from keystone_tpu.utils.metrics import sharding_counters
 
         n = int(X.shape[0])
-        if n % layout.num_shards == 0:
-            # Only reachable with X already sharded: batch_layout hands
-            # host arrays here solely for the pad class (divisible host
-            # batches were placed by DatasetOperator upstream).
+        if isinstance(X, jax.Array):
+            # Caller-owned placement (DatasetOperator / upstream chain):
+            # only divisible row counts carry a layout here.
             sharding_counters.bump("sharded_chain_calls")
+            if self.uses_pallas:
+                sharding_counters.bump("pallas_sharded_calls")
             return self._jitted_sharded(layout)(X)
+        if n % layout.num_shards == 0:
+            sharding_counters.bump("sharded_chain_calls")
+            return self._staged_call(layout.put(X), layout)
         if not self.row_independent:
             sharding_counters.bump("fallback_row_coupled")
             return self._jitted()(X)
@@ -171,7 +251,7 @@ class Transformer:
         sharding_counters.bump("sharded_chain_calls")
         sharding_counters.bump("batches_padded")
         sharding_counters.bump("pad_rows_added", padded.shape[0] - n)
-        out = self._jitted_sharded(layout)(padded)
+        out = self._staged_call(padded, layout)
         return out[:n]
 
     def __getstate__(self):
@@ -181,6 +261,7 @@ class Transformer:
         state = dict(self.__dict__)
         state.pop("_jit_cache", None)
         state.pop("_shard_jit_cache", None)
+        state.pop("_donate_ok_cache", None)  # keys carry the (live) mesh
         return state
 
     def signature(self) -> Any:
@@ -261,10 +342,20 @@ class FusedTransformer(Transformer):
         self.row_independent = all(
             getattr(s, "row_independent", True) for s in flat
         )
+        self.uses_pallas = any(
+            getattr(s, "uses_pallas", False) for s in flat
+        )
 
     def apply_batch(self, X):
         for s in self.stages:
             X = s.apply_batch(X)
+        return X
+
+    def apply_sharded(self, X, layout):
+        # Thread the layout so stages with a sharded kernel strategy
+        # (Pallas shard_map on TPU) see it inside the ONE fused lowering.
+        for s in self.stages:
+            X = s.apply_sharded(X, layout)
         return X
 
     def signature(self):

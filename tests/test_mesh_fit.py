@@ -139,8 +139,9 @@ def test_batch_layout_decisions():
     big_odd = np.zeros((130, 4), dtype=np.float32)
     small = np.zeros((8, 4), dtype=np.float32)
     text = np.array(["a", "b"], dtype=object)
-    # Divisible host batches belong to DatasetOperator placement.
-    assert batch_layout(big_div) is None
+    # Divisible host batches stage (and donate) through the chain call
+    # when they arrive host-side (e.g. from a host stage mid-chain).
+    assert batch_layout(big_div) == layout
     # Non-divisible >= min rows: the mask-pad path.
     assert batch_layout(big_odd) == layout
     assert batch_layout(small) is None
