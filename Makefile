@@ -1,6 +1,6 @@
 # Developer entry points. Pipelines launch via bin/run-pipeline.sh.
 
-.PHONY: test t1 chaos native bench bench-serve bench-serve-overload bench-serve-replicas bench-serve-daemon bench-serve-precision bench-fit bench-opt bench-multichip bench-imagenet bench-online trace-demo obs-serve serve-daemon profile-demo bench-watch lint dryrun clean tpu-checkride sentinel northstar acceptance
+.PHONY: test t1 chaos chaos-elastic native bench bench-serve bench-serve-overload bench-serve-replicas bench-serve-daemon bench-serve-precision bench-fit bench-opt bench-multichip bench-imagenet bench-online trace-demo obs-serve serve-daemon profile-demo bench-watch lint dryrun clean tpu-checkride sentinel northstar acceptance
 
 # The canonical tier-1 verify (ROADMAP.md), verbatim at the defaults —
 # builders and CI invoke this one entry point instead of hand-copying the
@@ -27,6 +27,16 @@ chaos:
 	  --stream --fv-backend pallas --gmm-k 2 --pca-dims 4 --top-k 2 \
 	  --synthetic-n 96 --synthetic-classes 4 --stream-batch 32 \
 	  --fit-sample-images 64 --checkpoint-dir /tmp/_chaos_imagenet_ckpt
+	$(MAKE) chaos-elastic
+
+# Elastic-mesh chaos leg (tools/chaos_elastic.py): fits killed mid-solve
+# at width 8 resume at widths 4 AND 16 under the same fault plan, and
+# the migrated resume must match the uninterrupted target-width fit
+# BIT-FOR-BIT (stream solve, BCD epochs, OnlineState in all three
+# forgetting modes) — with every migration counted and zero silent ones.
+chaos-elastic:
+	JAX_PLATFORMS=cpu KEYSTONE_FAULTS=io:0.05,oom:1 KEYSTONE_FAULTS_SEED=0 \
+	  python tools/chaos_elastic.py --quick
 
 # One-command resumable live-chip evidence harness: probes the TPU, runs
 # bench f32/bf16 + MFU sweep + Pallas Mosaic compile + streamed-overlap +

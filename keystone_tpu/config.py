@@ -125,6 +125,21 @@ class Config:
     solver_storage_dtype: str | None = field(
         default_factory=lambda: os.environ.get("KEYSTONE_SOLVER_DTYPE") or None
     )
+    # Canonical block count for the width-independent solver row fold
+    # (utils.mesh.fold_blocks). Row reductions (grams, AᵀB, column sums)
+    # are summed over this many fixed row blocks in a balanced-tree order
+    # regardless of mesh width, so a solve accumulated on W devices is
+    # BIT-identical to the same solve on W' devices — the property the
+    # elastic mesh migration's resume gate relies on. Must be a power of
+    # two; meshes whose width does not divide it fall back to the plain
+    # psum fold (order differs per width). Rows pad to a multiple of this
+    # count instead of the mesh width. 0 pins the legacy psum fold
+    # everywhere. Env: KEYSTONE_GRAM_FOLD_BLOCKS.
+    gram_fold_blocks: int = field(
+        default_factory=lambda: int(
+            os.environ.get("KEYSTONE_GRAM_FOLD_BLOCKS", "16")
+        )
+    )
     # Mesh axis name used for data (row) parallelism throughout.
     data_axis: str = "data"
     # Mesh axis name used for model (feature-block) parallelism.
@@ -169,6 +184,21 @@ class Config:
     donate_buffers: bool = field(
         default_factory=lambda: os.environ.get(
             "KEYSTONE_DONATE_BUFFERS", ""
+        ).lower() not in ("0", "false", "no")
+    )
+    # Elastic mesh: durable solver/profile state recorded under one mesh
+    # width migrates onto the current width at resume time
+    # (utils/mesh.reshard_state — the accumulators are placement-free
+    # sums, so a migrated resume is bit-identical to an uninterrupted fit
+    # at the target width) instead of refusing with MeshMismatchError.
+    # Every migration is counted in the "elastic" metrics family — never
+    # silent — and truly non-migratable state (torn/partial per-shard
+    # payloads) still refuses typed. KEYSTONE_ELASTIC_MESH=0 pins the
+    # refuse-only contract everywhere (the pre-elastic behavior and the
+    # escape hatch when a migration needs isolating).
+    elastic_mesh: bool = field(
+        default_factory=lambda: os.environ.get(
+            "KEYSTONE_ELASTIC_MESH", ""
         ).lower() not in ("0", "false", "no")
     )
     # Feature blocks whose gram ridge inverses are factorized together in

@@ -33,10 +33,12 @@ from jax.scipy.linalg import cho_factor, cho_solve, solve_triangular
 from jax.sharding import Mesh, PartitionSpec as P
 
 from keystone_tpu.config import config
+from keystone_tpu.utils.mesh import fold_blocks, register_reshard_adapter
 from keystone_tpu.linalg.row_matrix import (
     RowMatrix,
     _precision,
     donate_argnums as _donate,
+    sharded_rowsum,
     solver_matmul,
     storage_dtype,
 )
@@ -49,15 +51,20 @@ def _local_weighted(a_b, w_rows, weighted: bool):
     return a_b * w_rows[:, None] if weighted else a_b
 
 
-def _local_ridge_gram(a_b, aw, lam, precision, axis):
-    """Psum'd ridge gram AᵀA + λI for one block — THE single source for the
-    gram expression across every shard_map body (fused, batched, uncached)."""
-    gram = lax.psum(solver_matmul(aw.T, a_b, precision), axis)
+def _local_ridge_gram(a_b, aw, lam, precision, axis, width):
+    """Ridge gram AᵀA + λI for one block, reduced over the sharded rows in
+    the canonical width-independent fold (``sharded_rowsum`` — the
+    elastic-mesh bit-identity contract) — THE single source for the gram
+    expression across every shard_map body (fused, batched, uncached)."""
+    gram = sharded_rowsum(
+        lambda awb, ab: solver_matmul(awb.T, ab, precision),
+        axis, width, (aw, a_b),
+    )
     b = a_b.shape[1]
     return gram + lam * jnp.eye(b, dtype=gram.dtype)
 
 
-def _local_gram_inv(a_b, aw, lam, precision, axis):
+def _local_gram_inv(a_b, aw, lam, precision, axis, width):
     """Explicit ridge resolvent (AᵀA + λI)⁻¹ for the block.
 
     The inverse — not the Cholesky factor — is the cached quantity: XLA
@@ -67,25 +74,32 @@ def _local_gram_inv(a_b, aw, lam, precision, axis):
     solves per block; the λ-regularized SPD gram keeps it well-conditioned,
     and later epochs re-solve against the residual, so per-epoch solve
     error self-corrects instead of accumulating."""
-    return _batched_spd_inv(_local_ridge_gram(a_b, aw, lam, precision, axis))
+    return _batched_spd_inv(
+        _local_ridge_gram(a_b, aw, lam, precision, axis, width)
+    )
 
 
-def _local_solve_update(a_b, aw, inv, r, w_b, precision, axis):
+def _local_solve_update(a_b, aw, inv, r, w_b, precision, axis, width):
     r_plus = r + solver_matmul(a_b, w_b, precision)
-    rhs = lax.psum(solver_matmul(aw.T, r_plus, precision), axis)
+    rhs = sharded_rowsum(
+        lambda awb, rb: solver_matmul(awb.T, rb, precision),
+        axis, width, (aw, r_plus),
+    )
     w_b_new = solver_matmul(inv, rhs, precision)
     r_new = r_plus - solver_matmul(a_b, w_b_new, precision)
     return r_new, w_b_new
 
 
 @lru_cache(maxsize=None)
-def _gram_inv_fn(mesh: Mesh, axis: str, precision, weighted: bool):
+def _gram_inv_fn(mesh: Mesh, axis: str, precision, weighted: bool,
+                 fold: int):
     """Per-block gram + ridge inverse, computed once per block
     (epoch-invariant)."""
+    width = mesh.shape[axis]
 
     def local(a_b, lam, w_rows):
         aw = _local_weighted(a_b, w_rows, weighted)
-        return _local_gram_inv(a_b, aw, lam, precision, axis)
+        return _local_gram_inv(a_b, aw, lam, precision, axis, width)
 
     sm = shard_map(
         local,
@@ -98,14 +112,16 @@ def _gram_inv_fn(mesh: Mesh, axis: str, precision, weighted: bool):
 
 
 @lru_cache(maxsize=None)
-def _gram_only_fn(mesh: Mesh, axis: str, precision, weighted: bool):
-    """Per-block psum'd ridge gram (no factorization) — the gemm half of
+def _gram_only_fn(mesh: Mesh, axis: str, precision, weighted: bool,
+                  fold: int):
+    """Per-block ridge gram (no factorization) — the gemm half of
     the factor phase. Kept per-block: block grams are already large MXU
     gemms; it is only the FACTORIZATION that wants batching."""
+    width = mesh.shape[axis]
 
     def local(a_b, lam, w_rows):
         aw = _local_weighted(a_b, w_rows, weighted)
-        return _local_ridge_gram(a_b, aw, lam, precision, axis)
+        return _local_ridge_gram(a_b, aw, lam, precision, axis, width)
 
     sm = shard_map(
         local,
@@ -220,17 +236,23 @@ def _stack_blocks_fn(mesh: Mesh, axis: str, nb: int):
 
 
 @lru_cache(maxsize=None)
-def _fused_factor_fn(mesh: Mesh, axis: str, precision, weighted: bool):
-    """All blocks' ridge inverses in ONE program: batched psum'd grams
-    (one big MXU batch-gemm) into batched Cholesky + triangular solves.
-    The single dispatch matters as much as the batching — through the
-    relay transport, per-program launch latency between many small factor
-    programs was a real slice of solver wall-clock."""
+def _fused_factor_fn(mesh: Mesh, axis: str, precision, weighted: bool,
+                     fold: int):
+    """All blocks' ridge inverses in ONE program: batched canonical-fold
+    grams (one big MXU batch-gemm per row block) into batched Cholesky +
+    triangular solves. The single dispatch matters as much as the
+    batching — through the relay transport, per-program launch latency
+    between many small factor programs was a real slice of solver
+    wall-clock."""
+    width = mesh.shape[axis]
 
     def local(a3, lam, w_rows):  # a3: (chunk, rows_shard, b)
         aw = a3 * w_rows[None, :, None] if weighted else a3
-        gram = lax.psum(
-            solver_matmul(jnp.swapaxes(aw, 1, 2), a3, precision), axis
+        gram = sharded_rowsum(
+            lambda awb, ab: solver_matmul(
+                jnp.swapaxes(awb, 1, 2), ab, precision
+            ),
+            axis, width, (aw, a3), row_axes=(1, 1),
         )
         b = a3.shape[2]
         return _batched_spd_inv(gram + lam * jnp.eye(b, dtype=gram.dtype))
@@ -248,7 +270,7 @@ def _fused_factor_fn(mesh: Mesh, axis: str, precision, weighted: bool):
 @lru_cache(maxsize=None)
 def _fused_epochs_fn(
     mesh: Mesh, axis: str, precision, weighted: bool, num_epochs: int,
-    cached: bool,
+    cached: bool, fold: int,
 ):
     """The whole multi-epoch BCD sweep as ONE XLA program: scan over blocks
     inside scan over epochs, per-shard under shard_map.
@@ -264,15 +286,18 @@ def _fused_epochs_fn(
     ``cached=True`` consumes precomputed ridge inverses (xs carries them);
     ``cached=False`` re-derives gram+Cholesky per block visit — the
     single-epoch / factor-cache-disabled mode."""
+    width = mesh.shape[axis]
 
     def local(a3, invs, r, w3, lam, w_rows):
         def block_step(rc, xs):
             a_b, inv, w_b = xs
             aw = _local_weighted(a_b, w_rows, weighted)
             if not cached:
-                inv = _local_gram_inv(a_b, aw, lam, precision, axis)
+                inv = _local_gram_inv(
+                    a_b, aw, lam, precision, axis, width
+                )
             r_new, w_new = _local_solve_update(
-                a_b, aw, inv, rc, w_b, precision, axis
+                a_b, aw, inv, rc, w_b, precision, axis, width
             )
             return r_new, w_new
 
@@ -295,14 +320,18 @@ def _fused_epochs_fn(
 
 
 @lru_cache(maxsize=None)
-def _cached_block_update_fn(mesh: Mesh, axis: str, precision, weighted: bool):
+def _cached_block_update_fn(mesh: Mesh, axis: str, precision,
+                            weighted: bool, fold: int):
     """BCD block update reusing the precomputed ridge inverse: only MXU
     gemms remain in the epoch loop — the dominant 2·n·b² gram FLOPs drop
     out after the first epoch, and no triangular solve ever runs in it."""
+    width = mesh.shape[axis]
 
     def local(a_b, inv, r, w_b, w_rows):
         aw = _local_weighted(a_b, w_rows, weighted)
-        return _local_solve_update(a_b, aw, inv, r, w_b, precision, axis)
+        return _local_solve_update(
+            a_b, aw, inv, r, w_b, precision, axis, width
+        )
 
     sm = shard_map(
         local,
@@ -315,16 +344,18 @@ def _cached_block_update_fn(mesh: Mesh, axis: str, precision, weighted: bool):
 
 
 @lru_cache(maxsize=None)
-def _first_epoch_update_fn(mesh: Mesh, axis: str, precision, weighted: bool):
+def _first_epoch_update_fn(mesh: Mesh, axis: str, precision,
+                           weighted: bool, fold: int):
     """Fused block update that also emits the gram's ridge inverse — the
     streamed path's first epoch. Fusion keeps a_b in one XLA program so the
     block is read from HBM once for gram + update instead of twice."""
+    width = mesh.shape[axis]
 
     def local(a_b, r, w_b, lam, w_rows):
         aw = _local_weighted(a_b, w_rows, weighted)
-        inv = _local_gram_inv(a_b, aw, lam, precision, axis)
+        inv = _local_gram_inv(a_b, aw, lam, precision, axis, width)
         r_new, w_b_new = _local_solve_update(
-            a_b, aw, inv, r, w_b, precision, axis
+            a_b, aw, inv, r, w_b, precision, axis, width
         )
         return r_new, w_b_new, inv
 
@@ -339,9 +370,11 @@ def _first_epoch_update_fn(mesh: Mesh, axis: str, precision, weighted: bool):
 
 
 @lru_cache(maxsize=None)
-def _block_update_fn(mesh: Mesh, axis: str, precision, weighted: bool):
+def _block_update_fn(mesh: Mesh, axis: str, precision, weighted: bool,
+                     fold: int):
     """One BCD block update, jitted once per (mesh, shapes) and reused for
     every block and epoch — the hot loop of the whole framework."""
+    width = mesh.shape[axis]
 
     def local(a_b, r, w_b, lam, w_rows):
         # r is the current residual B - A W (row-sharded).
@@ -350,8 +383,13 @@ def _block_update_fn(mesh: Mesh, axis: str, precision, weighted: bool):
             aw = a_b * w_rows[:, None]
         else:
             aw = a_b
-        gram = lax.psum(solver_matmul(aw.T, a_b, precision), axis)
-        rhs = lax.psum(solver_matmul(aw.T, r_plus, precision), axis)
+        gram, rhs = sharded_rowsum(
+            lambda awb, ab, rb: (
+                solver_matmul(awb.T, ab, precision),
+                solver_matmul(awb.T, rb, precision),
+            ),
+            axis, width, (aw, a_b, r_plus),
+        )
         b = a_b.shape[1]
         c, low = cho_factor(gram + lam * jnp.eye(b, dtype=gram.dtype))
         w_b_new = cho_solve((c, low), rhs)
@@ -363,6 +401,7 @@ def _block_update_fn(mesh: Mesh, axis: str, precision, weighted: bool):
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(), P(), P(axis)),
         out_specs=(P(axis), P()),
+        check_vma=False,
     )
     return jax.jit(sm, donate_argnums=_donate(mesh, 1, 2))
 
@@ -413,7 +452,9 @@ def _factor_blocks(
     if n_eq % chunk == 1:
         n_eq -= 1
     if n_eq > 1 and chunk > 1:
-        gram_only = _gram_only_fn(mesh, axis, precision, weighted)
+        gram_only = _gram_only_fn(
+            mesh, axis, precision, weighted, fold_blocks(mesh.shape[axis])
+        )
         batched_inv = _batched_ridge_inv_fn(mesh)
         for c0 in range(0, n_eq, chunk):
             part = a_blocks[c0 : min(c0 + chunk, n_eq)]
@@ -430,7 +471,9 @@ def _factor_blocks(
                 stacked.block_until_ready()
             # Unstacked views keep the epoch-loop interface unchanged.
             invs.extend(stacked[i] for i in range(stacked.shape[0]))
-    gram_inv = _gram_inv_fn(mesh, axis, precision, weighted)
+    gram_inv = _gram_inv_fn(
+        mesh, axis, precision, weighted, fold_blocks(mesh.shape[axis])
+    )
     for a_b in a_blocks[len(invs) :]:
         c = gram_inv(a_b, lam_arr, w_rows)
         if throttle:
@@ -497,7 +540,9 @@ def block_coordinate_descent(
         itemsize = jnp.dtype(cdtype).itemsize
         factor_bytes = sum((e - s) ** 2 for s, e in blocks) * itemsize
         cache_grams = num_iters > 1 and factor_bytes < config.hbm_budget_bytes // 4
-    update = _block_update_fn(mesh, axis, _precision(), weighted)
+    update = _block_update_fn(
+        mesh, axis, _precision(), weighted, fold_blocks(mesh.shape[axis])
+    )
     lam_arr = jnp.asarray(lam, dtype=cdtype)
 
     W = [jnp.zeros((e - s, k), dtype=cdtype) for s, e in blocks]
@@ -545,7 +590,8 @@ def block_coordinate_descent(
     a_blocks = [lax.slice_in_dim(A.data, s, e, axis=1) for s, e in blocks]
     if cache_grams and start_epoch < num_iters:
         cached_update = _cached_block_update_fn(
-            mesh, axis, _precision(), weighted
+            mesh, axis, _precision(), weighted,
+            fold_blocks(mesh.shape[axis]),
         )
         invs = _factor_blocks(
             a_blocks, blocks, lam_arr, w_rows, mesh, axis, weighted, throttle
@@ -588,7 +634,9 @@ def _solve_fused(
         # Chunked like _factor_blocks (shared _factor_chunk policy): bounds
         # the factor transient to chunk·b² buffers instead of nb·b².
         chunk = _factor_chunk(blocks[0][1] - blocks[0][0])
-        factor = _fused_factor_fn(mesh, axis, precision, weighted)
+        factor = _fused_factor_fn(
+            mesh, axis, precision, weighted, fold_blocks(mesh.shape[axis])
+        )
         if chunk >= nb:
             invs = factor(a3, lam_arr, w_rows)
         else:
@@ -610,11 +658,14 @@ def _solve_fused(
     if checkpoint_dir is None:
         step = _fused_epochs_fn(
             mesh, axis, precision, weighted, num_iters - start_epoch,
-            cache_grams,
+            cache_grams, fold_blocks(mesh.shape[axis]),
         )
         R, W3 = step(a3, invs, R, W3, lam_arr, w_rows)
     else:
-        step = _fused_epochs_fn(mesh, axis, precision, weighted, 1, cache_grams)
+        step = _fused_epochs_fn(
+            mesh, axis, precision, weighted, 1, cache_grams,
+            fold_blocks(mesh.shape[axis]),
+        )
         for epoch in range(start_epoch, num_iters):
             R, W3 = step(a3, invs, R, W3, lam_arr, w_rows)
             _save_epoch(
@@ -640,8 +691,11 @@ def _make_fingerprint(
     storage dtype is part of the identity — an f32 solve must not resume a
     bf16 one (mixed-precision epochs with no warning). ``device_count`` /
     ``data_axis`` are the per-shard manifest: same problem on a different
-    mesh width is REFUSED at restore (``MeshMismatchError``), never
-    resumed into differently-folded accumulators."""
+    mesh width either MIGRATES at restore (``utils.mesh.reshard_state``
+    trims and re-pads the residual onto the new shard multiple — elastic
+    mesh, default on, counted) or refuses typed (``MeshMismatchError``)
+    with ``KEYSTONE_ELASTIC_MESH=0`` — never resumed into
+    differently-folded accumulators, never silently discarded."""
     from keystone_tpu.utils.mesh import num_data_shards
 
     return {
@@ -711,6 +765,11 @@ def _save_epoch(ckpt_dir: str, epoch: int, W, R, fingerprint) -> None:
         "fingerprint": dict(fingerprint),
     }
     _async_checkpointer(ckpt_dir).save(path, tree, force=True)
+    # JSON mesh sidecar: the static lint's (KG107) no-execution window
+    # into what mesh this directory's epochs were folded under.
+    from keystone_tpu.utils.mesh import write_mesh_manifest
+
+    write_mesh_manifest(ckpt_dir, fingerprint)
 
 
 def wait_for_checkpoints(ckpt_dir: str) -> None:
@@ -761,37 +820,111 @@ def _restore_latest(ckpt_dir: str, fingerprint):
     tree = ocp.PyTreeCheckpointer().restore(
         os.path.join(ckpt_dir, f"epoch_{latest}")
     )
-    from keystone_tpu.utils.mesh import mesh_fp_compat
+    from keystone_tpu.utils.mesh import mesh_resume_decision, reshard_state
 
     # Pre-manifest snapshots (no device_count/data_axis keys) compare
-    # with the absent keys backfilled as wildcards, so a legacy epoch
-    # checkpoint of the SAME problem still resumes after the manifest
-    # upgrade instead of silently restarting at epoch 0.
-    saved_fp = mesh_fp_compat(tree.get("fingerprint"), fingerprint)
-    if saved_fp is None or not _fingerprint_matches(saved_fp, fingerprint):
-        _refuse_bcd_mesh_mismatch(saved_fp, fingerprint, ckpt_dir)
+    # with the absent keys backfilled as wildcards (the shared
+    # mesh_resume_decision triage), so a legacy epoch checkpoint of the
+    # SAME problem still resumes after the manifest upgrade instead of
+    # silently restarting at epoch 0. Same problem on a different mesh
+    # width migrates (elastic, counted) or refuses typed.
+    decision, saved_fp = mesh_resume_decision(
+        tree.get("fingerprint"), fingerprint,
+        f"BCD checkpoint {ckpt_dir}",
+        extra_mesh_keys=("rows",), same_problem=_fingerprint_matches,
+    )
+    if decision == "fresh":
         logging.getLogger("keystone_tpu").warning(
             "checkpoint dir %s holds a different solve (fingerprint "
             "mismatch); starting fresh",
             ckpt_dir,
         )
         return None
+    if decision == "migrate":
+        tree = reshard_state(
+            dict(tree, fingerprint=saved_fp), family="bcd_epoch"
+        )
     return int(tree["epoch"]), tree["W"], tree["R"]
 
 
-def _refuse_bcd_mesh_mismatch(saved_fp, expected_fp, ckpt_dir) -> None:
-    """The shared mesh-width refusal (``utils.mesh.refuse_mesh_mismatch``)
+def _refuse_bcd_mesh_mismatch(saved_fp, expected_fp, ckpt_dir) -> bool:
+    """The shared mesh-width rule (``utils.mesh.refuse_mesh_mismatch``)
     with the BCD-specific exclusions: padded ``rows`` follow the mesh (the
     shard multiple changes them for the same logical solve), and problem
-    identity uses the solver's tolerant float matching. Resuming W/R
-    folded under one shard layout into another is a wrong-answer resume;
-    other mismatches stay on the warn-and-start-fresh path."""
+    identity uses the solver's tolerant float matching. Returns True when
+    the elastic path should migrate the checkpoint via ``reshard_state``;
+    raises the typed ``MeshMismatchError`` when elastic migration is
+    pinned off (resuming W/R folded under one shard layout into another
+    unmigrated would be a wrong-answer resume); other mismatches stay on
+    the warn-and-start-fresh path."""
     from keystone_tpu.utils.mesh import refuse_mesh_mismatch
 
-    refuse_mesh_mismatch(
+    return refuse_mesh_mismatch(
         saved_fp, expected_fp, f"BCD checkpoint {ckpt_dir}",
         extra_mesh_keys=("rows",), same_problem=_fingerprint_matches,
     )
+
+
+def _reshard_bcd_R(state, layout, where):
+    """Shared residual migration for both BCD checkpoint families: trim
+    the zero pad rows folded under the OLD shard multiple off ``R``,
+    re-pad to the NEW multiple, and rewrite the fingerprint's ``rows`` +
+    mesh keys. Pad rows are zero by construction (A and B are zero-padded,
+    so every epoch's residual update leaves them zero) — a nonzero pad
+    region can only mean a torn per-shard payload, which refuses typed."""
+    from keystone_tpu.utils.mesh import (
+        pad_multiple,
+        pad_rows,
+        reshard_refused,
+    )
+
+    fp = dict(state.get("fingerprint") or {})
+    R = state.get("R")
+    n, rows = int(fp.get("n", -1)), int(fp.get("rows", -1))
+    R = np.asarray(R) if R is not None else None
+    if R is None or n < 0 or R.shape[0] != rows or n > rows:
+        raise reshard_refused(
+            where,
+            "residual shape does not match its fingerprint "
+            "(torn or partially written checkpoint)",
+        )
+    if R[n:].any():
+        raise reshard_refused(
+            where,
+            "nonzero rows in the residual's pad region — a partial "
+            "per-shard write, not a clean epoch snapshot",
+        )
+    R_new, _ = pad_rows(R[:n], pad_multiple(layout.num_shards))
+    fp["rows"] = int(R_new.shape[0])
+    fp["device_count"] = int(layout.num_shards)
+    fp["data_axis"] = str(layout.axis)
+    return dict(state, R=R_new, fingerprint=fp)
+
+
+def _reshard_bcd_epoch(state, layout):
+    """Elastic-mesh adapter for epoch checkpoints (orbax ``epoch_N``
+    trees): W blocks are replicated (placement-free) and pass through
+    byte-identical; only the residual's row padding follows the mesh."""
+    return _reshard_bcd_R(state, layout, "BCD epoch checkpoint")
+
+
+def _reshard_bcd_stream(state, layout):
+    """Elastic-mesh adapter for mid-epoch block snapshots: W blocks and
+    the cached ridge inverses are replicated (placement-free); the
+    residual re-pads exactly as the epoch family does."""
+    state = _reshard_bcd_R(state, layout, "BCD block checkpoint")
+    if int(state.get("block", -1)) < 0 or int(state.get("epoch", -1)) < 0:
+        from keystone_tpu.utils.mesh import reshard_refused
+
+        raise reshard_refused(
+            "BCD block checkpoint",
+            "snapshot is missing its block cursor",
+        )
+    return state
+
+
+register_reshard_adapter("bcd_epoch", _reshard_bcd_epoch)
+register_reshard_adapter("bcd_stream", _reshard_bcd_stream)
 
 
 def assemble_blocks(W: List[jax.Array]) -> jax.Array:
@@ -831,22 +964,39 @@ def _bcd_ckpt_save(store, fingerprint, epoch, block, W, R, invs) -> None:
         },
         overwrite=True,
     )
+    from keystone_tpu.utils.mesh import write_mesh_manifest
+
+    write_mesh_manifest(store.root, fingerprint)
     reliability_counters.bump("checkpoints_written")
 
 
 def _bcd_ckpt_resume(store, fingerprint):
-    """The block snapshot, or None when absent / bound to another solve."""
+    """The block snapshot, or None when absent / bound to another solve.
+    Same mesh triage as the epoch family: a snapshot of THIS solve under
+    a different mesh width migrates (elastic, counted) or refuses typed —
+    it is never silently discarded as if it were another problem."""
     import logging
+
+    from keystone_tpu.utils.mesh import mesh_resume_decision, reshard_state
 
     state = store.get(_BCD_CKPT_KEY)
     if state is None:
         return None
-    if not _fingerprint_matches(state.get("fingerprint", {}), fingerprint):
+    decision, saved_fp = mesh_resume_decision(
+        state.get("fingerprint"), fingerprint,
+        f"BCD block checkpoint {store.root}",
+        extra_mesh_keys=("rows",), same_problem=_fingerprint_matches,
+    )
+    if decision == "fresh":
         logging.getLogger("keystone_tpu").warning(
             "block checkpoint in %s holds a different solve (fingerprint "
             "mismatch); ignoring it", store.root,
         )
         return None
+    if decision == "migrate":
+        state = reshard_state(
+            dict(state, fingerprint=saved_fp), family="bcd_stream"
+        )
     return state
 
 
@@ -988,8 +1138,12 @@ def block_coordinate_descent_streamed(
         w_rows = jnp.zeros((B.padded_rows,), dtype=dtype)
     w_rows = jax.device_put(w_rows, sharding)
 
-    first = _first_epoch_update_fn(mesh, axis, _precision(), weighted)
-    cached = _cached_block_update_fn(mesh, axis, _precision(), weighted)
+    first = _first_epoch_update_fn(
+        mesh, axis, _precision(), weighted, fold_blocks(mesh.shape[axis])
+    )
+    cached = _cached_block_update_fn(
+        mesh, axis, _precision(), weighted, fold_blocks(mesh.shape[axis])
+    )
     lam_arr = jnp.asarray(lam, dtype=cdtype)
     throttle = jax.default_backend() == "cpu"
 

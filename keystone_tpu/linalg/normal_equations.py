@@ -20,10 +20,12 @@ from jax.scipy.linalg import cho_factor, cho_solve
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from keystone_tpu.config import config
+from keystone_tpu.utils.mesh import register_reshard_adapter
 from keystone_tpu.linalg.row_matrix import (
     RowMatrix,
     _precision,
     donate_argnums,
+    sharded_rowsum,
     solver_matmul,
     storage_dtype,
 )
@@ -57,19 +59,26 @@ def solve_least_squares_normal(
 
 @lru_cache(maxsize=None)
 def _accum_gram_atb_fn(mesh: Mesh, axis: str, precision):
-    """One fused program per chunk: psum'd (AᵀA, AᵀB) added into the
-    running accumulators. Everything is donated — the accumulators because
-    the previous values are dead once the sums exist, and the CHUNK buffers
-    because the overlapped loop never touches a chunk after its
-    accumulation step, so XLA recycles their HBM for the next transfer and
-    device residency stays at two in-flight chunk buffers regardless of
-    stream length."""
+    """One fused program per chunk: (AᵀA, AᵀB) — reduced over rows in the
+    canonical width-independent fold (``sharded_rowsum``, so a stream
+    checkpointed on one mesh width resumes on another bit-identically) —
+    added into the running accumulators. Everything is donated — the
+    accumulators because the previous values are dead once the sums
+    exist, and the CHUNK buffers because the overlapped loop never
+    touches a chunk after its accumulation step, so XLA recycles their
+    HBM for the next transfer and device residency stays at two in-flight
+    chunk buffers regardless of stream length."""
+    width = mesh.shape[axis]
 
     def local(gram, atb, a, b):
-        return (
-            gram + lax.psum(solver_matmul(a.T, a, precision), axis),
-            atb + lax.psum(solver_matmul(a.T, b, precision), axis),
+        g, t = sharded_rowsum(
+            lambda ab, bb: (
+                solver_matmul(ab.T, ab, precision),
+                solver_matmul(ab.T, bb, precision),
+            ),
+            axis, width, (a, b),
         )
+        return gram + g, atb + t
 
     sm = shard_map(
         local,
@@ -231,7 +240,9 @@ def _stream_fingerprint(first_chunk) -> dict:
     the stream's first record — enough to refuse resuming a different
     problem into these accumulators — plus the per-shard manifest (mesh
     width and data axis), so a snapshot folded under one mesh can never
-    continue under another."""
+    SILENTLY continue under another: a width change either migrates the
+    snapshot through ``utils.mesh.reshard_state`` (elastic mesh, default
+    on, counted) or refuses typed."""
     import numpy as np
 
     from keystone_tpu.utils.mesh import num_data_shards
@@ -250,6 +261,40 @@ def _stream_fingerprint(first_chunk) -> dict:
     }
 
 
+def _reshard_stream_state(state, layout):
+    """Elastic-mesh adapter for chunked-solve snapshots: the retained
+    gram/AᵀB are full (d, d)/(d, b) f64 sums — placement-free, nothing
+    per-shard to re-fold — so migration rewrites the fingerprint's mesh
+    manifest onto ``layout`` and passes every accumulator byte through
+    untouched. Torn payloads (accumulator shapes contradicting the
+    fingerprint) refuse typed instead."""
+    import numpy as np
+
+    from keystone_tpu.utils.mesh import reshard_refused
+
+    fp = dict(state.get("fingerprint") or {})
+    gram, atb = state.get("gram"), state.get("atb")
+    d = int(fp.get("d", -1))
+    gram = np.asarray(gram) if gram is not None else None
+    atb = np.asarray(atb) if atb is not None else None
+    if (
+        gram is None
+        or atb is None
+        or gram.shape != (d, d)
+        or atb.shape[:1] != (d,)
+        or int(state.get("chunks_done", -1)) < 0
+    ):
+        raise reshard_refused(
+            "stream solve",
+            "snapshot accumulators do not match their fingerprint "
+            "(torn or partially written checkpoint)",
+        )
+    fp["device_count"] = int(layout.num_shards)
+    fp["data_axis"] = str(layout.axis)
+    return dict(state, fingerprint=fp)
+
+
+register_reshard_adapter("stream_solve", _reshard_stream_state)
 
 
 class _StreamCheckpointer:
@@ -288,8 +333,8 @@ class _StreamCheckpointer:
         from keystone_tpu.utils.metrics import reliability_counters
 
         from keystone_tpu.utils.mesh import (
-            mesh_fp_compat,
-            refuse_mesh_mismatch,
+            mesh_resume_decision,
+            reshard_state,
         )
 
         self.fingerprint = _stream_fingerprint(first_chunk)
@@ -297,19 +342,26 @@ class _StreamCheckpointer:
         if state is None:
             return
         # Pre-manifest snapshots (no device_count/data_axis keys) compare
-        # with the absent keys backfilled as wildcards, so a legacy
-        # checkpoint of the SAME problem still resumes after the manifest
-        # upgrade instead of silently recomputing hours of accumulation.
-        saved_fp = mesh_fp_compat(state.get("fingerprint"), self.fingerprint)
-        if saved_fp != self.fingerprint:
-            # Same problem on a different mesh width is REFUSED (typed),
-            # never a wrong-answer resume and never a silent restart.
-            refuse_mesh_mismatch(saved_fp, self.fingerprint, "stream solve")
+        # with the absent keys backfilled as wildcards (the shared
+        # mesh_resume_decision triage), so a legacy checkpoint of the
+        # SAME problem still resumes after the manifest upgrade instead
+        # of silently recomputing hours of accumulation. The same problem
+        # on a different mesh width MIGRATES (elastic mesh, counted) or
+        # refuses typed — never a wrong-answer resume, never a silent
+        # restart.
+        decision, saved_fp = mesh_resume_decision(
+            state.get("fingerprint"), self.fingerprint, "stream solve"
+        )
+        if decision == "fresh":
             logging.getLogger("keystone_tpu").warning(
                 "stream-solve checkpoint holds a different solve "
                 "(fingerprint mismatch); starting fresh"
             )
             return
+        if decision == "migrate":
+            state = reshard_state(
+                dict(state, fingerprint=saved_fp), family="stream_solve"
+            )
         reliability_counters.bump("checkpoints_resumed")
         self.skip = int(state["chunks_done"])
         self.gram_np, self.atb_np = state["gram"], state["atb"]
@@ -363,6 +415,9 @@ class _StreamCheckpointer:
             },
             overwrite=True,
         )
+        from keystone_tpu.utils.mesh import write_mesh_manifest
+
+        write_mesh_manifest(self.store.root, self.fingerprint)
         reliability_counters.bump("checkpoints_written")
         return True
 

@@ -21,7 +21,12 @@ from keystone_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from keystone_tpu.config import config
-from keystone_tpu.utils.mesh import default_mesh, pad_rows
+from keystone_tpu.utils.mesh import (
+    default_mesh,
+    fold_blocks,
+    pad_multiple,
+    pad_rows,
+)
 
 
 def _precision():
@@ -72,56 +77,132 @@ def solver_matmul(x, y, precision):
     return jnp.matmul(x, y, precision=precision)
 
 
+def sharded_rowsum(block_fn, axis: str, width: int, operands, row_axes=None):
+    """THE reduction over the sharded row axis for every solver
+    accumulator (grams, AᵀB, column sums) — call inside a shard_map body.
+
+    ``block_fn(*row_slices)`` maps row slices of ``operands`` to a pytree
+    of partial sums. With the canonical fold active
+    (``utils.mesh.fold_blocks``), the logical rows are cut into a FIXED
+    number of blocks — the same blocks on every mesh width, because rows
+    pad to a multiple of the block count (``pad_multiple``) — and the
+    per-block partials combine in a balanced binary tree: local subtrees
+    per shard, then a butterfly (log₂ width ppermute rounds) across them.
+    Every width that divides the block count therefore sums in the SAME
+    order and produces the SAME bits — the invariance the elastic mesh
+    resume gate (reshard then continue, bit-identical to a fresh fit at
+    the new width) stands on. Widths outside the fold's reach keep the
+    legacy whole-shard ``psum`` (order differs per width, sums still
+    exact). ``row_axes`` names the row axis per operand (default 0 — the
+    batched-gram callers reduce over axis 1 of a stacked operand)."""
+    if row_axes is None:
+        row_axes = (0,) * len(operands)
+    C = fold_blocks(width)
+    if not C:
+        return jax.tree_util.tree_map(
+            lambda v: lax.psum(v, axis), block_fn(*operands)
+        )
+    blocks_per_shard = C // width
+    parts = []
+    for i in range(blocks_per_shard):
+        slices = [
+            lax.slice_in_dim(
+                op,
+                i * (op.shape[ra] // blocks_per_shard),
+                (i + 1) * (op.shape[ra] // blocks_per_shard),
+                axis=ra,
+            )
+            for op, ra in zip(operands, row_axes)
+        ]
+        parts.append(block_fn(*slices))
+    while len(parts) > 1:
+        parts = [
+            jax.tree_util.tree_map(jnp.add, parts[i], parts[i + 1])
+            for i in range(0, len(parts), 2)
+        ]
+    acc = parts[0]
+    step = 1
+    while step < width:
+        perm = [(i, i ^ step) for i in range(width)]
+        acc = jax.tree_util.tree_map(
+            lambda v, p=perm: v + lax.ppermute(v, axis, p), acc
+        )
+        step *= 2
+    return acc
+
+
 @lru_cache(maxsize=None)
-def _gram_fn(mesh: Mesh, axis: str, precision):
+def _gram_fn(mesh: Mesh, axis: str, precision, fold: int):
+    width = mesh.shape[axis]
+
     @jax.jit
-    @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P())
+    @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(), check_vma=False)
     def gram(a):
-        return lax.psum(solver_matmul(a.T, a, precision), axis)
+        return sharded_rowsum(
+            lambda ab: solver_matmul(ab.T, ab, precision), axis, width, (a,)
+        )
 
     return gram
 
 
 @lru_cache(maxsize=None)
-def _atb_fn(mesh: Mesh, axis: str, precision):
+def _atb_fn(mesh: Mesh, axis: str, precision, fold: int):
+    width = mesh.shape[axis]
+
     @jax.jit
-    @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P())
+    @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P(), check_vma=False)
     def atb(a, b):
-        return lax.psum(solver_matmul(a.T, b, precision), axis)
+        return sharded_rowsum(
+            lambda ab, bb: solver_matmul(ab.T, bb, precision),
+            axis, width, (a, b),
+        )
 
     return atb
 
 
 @lru_cache(maxsize=None)
-def _gram_and_atb_fn(mesh: Mesh, axis: str, precision):
+def _gram_and_atb_fn(mesh: Mesh, axis: str, precision, fold: int):
+    width = mesh.shape[axis]
+
     @jax.jit
-    @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=(P(), P()))
+    @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=(P(), P()), check_vma=False)
     def gram_and_atb(a, b):
         # One program: a is read from HBM once for both reductions.
-        return (
-            lax.psum(solver_matmul(a.T, a, precision), axis),
-            lax.psum(solver_matmul(a.T, b, precision), axis),
+        return sharded_rowsum(
+            lambda ab, bb: (
+                solver_matmul(ab.T, ab, precision),
+                solver_matmul(ab.T, bb, precision),
+            ),
+            axis, width, (a, b),
         )
 
     return gram_and_atb
 
 
 @lru_cache(maxsize=None)
-def _col_sum_fn(mesh: Mesh, axis: str):
+def _col_sum_fn(mesh: Mesh, axis: str, fold: int):
+    width = mesh.shape[axis]
+
     @jax.jit
-    @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P())
+    @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(), check_vma=False)
     def col_sum(a):
-        return lax.psum(jnp.sum(a, axis=0), axis)
+        return sharded_rowsum(
+            lambda ab: jnp.sum(ab, axis=0), axis, width, (a,)
+        )
 
     return col_sum
 
 
 @lru_cache(maxsize=None)
-def _weighted_col_sum_fn(mesh: Mesh, axis: str):
+def _weighted_col_sum_fn(mesh: Mesh, axis: str, fold: int):
+    width = mesh.shape[axis]
+
     @jax.jit
-    @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P())
+    @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P(), check_vma=False)
     def weighted_col_sum(w, a):
-        return lax.psum(jnp.sum(w * a, axis=0), axis)
+        return sharded_rowsum(
+            lambda wb, ab: jnp.sum(wb * ab, axis=0), axis, width, (w, a)
+        )
 
     return weighted_col_sum
 
@@ -162,7 +243,11 @@ class RowMatrix:
         k = mesh.shape[axis]
         dtype = dtype or config.default_dtype
         x = np.asarray(x, dtype=dtype) if isinstance(x, np.ndarray) else jnp.asarray(x, dtype=dtype)
-        padded, n = pad_rows(x, k)
+        # pad_multiple, not the raw width: with the canonical fold active
+        # every mesh width pads (and blocks) rows identically, which is
+        # what makes the gram fold — and thus whole solves —
+        # bit-identical across widths (the elastic-mesh resume gate).
+        padded, n = pad_rows(x, pad_multiple(k))
         sharding = NamedSharding(mesh, P(axis))
         data = jax.device_put(padded, sharding)
         return cls(data, n, mesh)
@@ -190,21 +275,26 @@ class RowMatrix:
     def gram(self) -> jax.Array:
         """AᵀA, replicated: per-shard MXU gemm + psum over ICI
         (the ``treeAggregate`` of local grams in NormalEquations)."""
-        return _gram_fn(self.mesh, config.data_axis, _precision())(self.data)
+        return _gram_fn(
+            self.mesh, config.data_axis, _precision(),
+            fold_blocks(self.num_shards),
+        )(self.data)
 
     def atb(self, other: "RowMatrix") -> jax.Array:
         """AᵀB for a row-aligned B."""
         self._check_aligned(other)
-        return _atb_fn(self.mesh, config.data_axis, _precision())(
-            self.data, other.data
-        )
+        return _atb_fn(
+            self.mesh, config.data_axis, _precision(),
+            fold_blocks(self.num_shards),
+        )(self.data, other.data)
 
     def gram_and_atb(self, other: "RowMatrix"):
         """(AᵀA, AᵀB) in one fused program — A is read once."""
         self._check_aligned(other)
-        return _gram_and_atb_fn(self.mesh, config.data_axis, _precision())(
-            self.data, other.data
-        )
+        return _gram_and_atb_fn(
+            self.mesh, config.data_axis, _precision(),
+            fold_blocks(self.num_shards),
+        )(self.data, other.data)
 
     def col_sums(self) -> jax.Array:
         """Column sums over the LOGICAL rows, replicated: per-shard sum +
@@ -213,15 +303,17 @@ class RowMatrix:
         the same mesh, the result is bit-identical no matter what
         placement the source array arrived with (the property that keeps
         intercept means — and thus whole fits — placement-invariant)."""
-        return _col_sum_fn(self.mesh, config.data_axis)(self.data)
+        return _col_sum_fn(
+            self.mesh, config.data_axis, fold_blocks(self.num_shards)
+        )(self.data)
 
     def weighted_col_sums(self, weights: "RowMatrix") -> jax.Array:
         """Σ_i w_i · row_i for a row-aligned (n, 1) weight column — the
         weighted-centering reduction, psum'd like ``col_sums``."""
         self._check_aligned(weights)
-        return _weighted_col_sum_fn(self.mesh, config.data_axis)(
-            weights.data, self.data
-        )
+        return _weighted_col_sum_fn(
+            self.mesh, config.data_axis, fold_blocks(self.num_shards)
+        )(weights.data, self.data)
 
     def centered(self, means: jax.Array, dtype=None) -> "RowMatrix":
         """``self - means`` over the LOGICAL rows, pad rows kept ZERO (a

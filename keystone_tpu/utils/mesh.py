@@ -34,14 +34,21 @@ _default_mesh: Optional[Mesh] = None
 
 class MeshMismatchError(RuntimeError):
     """Persisted solver/checkpoint state was recorded under a different
-    mesh width (device count / data axis) than the one resuming it.
+    mesh width (device count / data axis) than the one resuming it, and
+    could not be migrated.
 
     Raised — never silently resumed and never silently restarted — by the
-    streaming solvers' checkpoint binding: per-shard state folded under
-    one mesh must not continue under another, because the operator would
-    read a 'resumed' solve whose provenance (and any per-shard manifest)
-    lies about the mesh it ran on. Re-run on the recording mesh width, or
-    delete the checkpoint to start fresh deliberately."""
+    streaming solvers' checkpoint binding when elastic migration is
+    pinned off (``KEYSTONE_ELASTIC_MESH=0``) or the state is genuinely
+    non-migratable (a torn/partial per-shard payload): continuing
+    differently-folded state unexamined would hand the operator a
+    'resumed' solve whose provenance lies about the mesh it ran on.
+    Recovery: ``utils.mesh.reshard_state`` migrates the state onto the
+    current width (the default-on ``KEYSTONE_ELASTIC_MESH`` path does
+    this automatically at resume, counted in the "elastic" metrics
+    family), or re-run on the recording mesh width. The work in the
+    checkpoint is recoverable — deleting it is a last resort, not the
+    advice."""
 
 
 def default_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
@@ -91,6 +98,34 @@ def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
 def num_data_shards(mesh: Optional[Mesh] = None) -> int:
     mesh = mesh or default_mesh()
     return mesh.shape[config.data_axis]
+
+
+def fold_blocks(width: int) -> int:
+    """Canonical block count for the width-independent solver row fold,
+    or 0 when this mesh width must fall back to the plain psum fold.
+
+    Row reductions folded over ``config.gram_fold_blocks`` fixed row
+    blocks in a balanced-tree order produce the SAME bits on any mesh
+    width that divides the block count — the property that lets a solve
+    checkpointed on one width resume on another bit-identically (the
+    elastic mesh contract). Active only when both the block count and
+    the width are powers of two with ``width <= blocks``."""
+    blocks = int(config.gram_fold_blocks or 0)
+    if blocks <= 0 or blocks & (blocks - 1):
+        return 0
+    width = int(width)
+    if width <= 0 or width & (width - 1) or blocks % width:
+        return 0
+    return blocks
+
+
+def pad_multiple(width: int) -> int:
+    """The row-padding multiple for solver operands on a ``width``-shard
+    mesh: the canonical fold block count when the deterministic fold is
+    active (every width's rows then pad identically, which is what keeps
+    the fold's block boundaries — and therefore its bits —
+    width-independent), else the mesh width."""
+    return fold_blocks(width) or int(width)
 
 
 def pad_rows(x: np.ndarray | jax.Array, multiple: int):
@@ -237,11 +272,14 @@ def refuse_mesh_mismatch(
     where: str,
     extra_mesh_keys: tuple = (),
     same_problem=None,
-) -> None:
-    """Raise the typed ``MeshMismatchError`` when a persisted fingerprint
-    names the SAME problem as ``expected_fp`` under a DIFFERENT mesh —
-    the one refusal rule shared by every checkpointing solver, so the
-    contract can never fork per solver.
+) -> bool:
+    """The one mesh-width rule shared by every checkpointing solver, so
+    the contract can never fork per solver: when a persisted fingerprint
+    names the SAME problem as ``expected_fp`` under a DIFFERENT mesh,
+    either signal the elastic migration path (``config.elastic_mesh``,
+    default on — returns True, the caller migrates via ``reshard_state``)
+    or raise the typed ``MeshMismatchError`` (elastic pinned off).
+    Returns False when there is no same-problem mesh conflict.
 
     ``extra_mesh_keys`` names additional keys that legitimately follow
     the mesh (e.g. padded row counts); ``same_problem`` overrides the
@@ -251,27 +289,74 @@ def refuse_mesh_mismatch(
     any OTHER disagreement is the caller's warn-and-start-fresh path.
     """
     if not isinstance(saved_fp, dict):
-        return
+        return False
     saved_mesh = {k: saved_fp.get(k) for k in MESH_FP_KEYS}
     if None in saved_mesh.values():
-        return
+        return False
     expected_mesh = {k: expected_fp.get(k) for k in MESH_FP_KEYS}
     if saved_mesh == expected_mesh:
-        return
+        return False
     excluded = set(MESH_FP_KEYS) | set(extra_mesh_keys)
     if same_problem is None:
         same_problem = lambda a, b: a == b  # noqa: E731
-    if same_problem(
+    if not same_problem(
         {k: v for k, v in saved_fp.items() if k not in excluded},
         {k: v for k, v in expected_fp.items() if k not in excluded},
     ):
-        raise MeshMismatchError(
-            f"{where}: checkpoint was written under mesh {saved_mesh}, "
-            f"but this solve runs under {expected_mesh}; resuming solver "
-            "state across a mesh-width change is refused. Re-run on the "
-            "recording mesh width, or delete the checkpoint to start "
-            "fresh."
-        )
+        return False
+    if config.elastic_mesh:
+        return True
+    raise MeshMismatchError(
+        f"{where}: checkpoint was written under mesh {saved_mesh}, "
+        f"but this solve runs under {expected_mesh}; elastic migration "
+        "is pinned off (KEYSTONE_ELASTIC_MESH=0), so resuming solver "
+        "state across the width change is refused. Recover with "
+        "utils.mesh.reshard_state (or unpin KEYSTONE_ELASTIC_MESH to "
+        "migrate automatically at resume), or re-run on the recording "
+        "mesh width — the checkpointed work is recoverable."
+    )
+
+
+def mesh_resume_decision(
+    saved_fp,
+    expected_fp,
+    where: str,
+    extra_mesh_keys: tuple = (),
+    same_problem=None,
+):
+    """THE checkpoint-resume triage every durable-state family routes
+    through (stream solve, BCD, ``OnlineState``) — legacy-wildcard
+    backfill, problem-identity comparison, and the mesh-width rule in one
+    place, so the three can never drift apart.
+
+    Returns ``(decision, saved_fp)`` where ``saved_fp`` has absent
+    pre-manifest mesh keys backfilled (``mesh_fp_compat``) and
+    ``decision`` is one of:
+
+    - ``"resume"`` — same problem, same mesh: continue the state as-is;
+    - ``"migrate"`` — same problem under a different mesh width with
+      ``config.elastic_mesh`` on: the caller migrates the payload via
+      ``reshard_state`` and then resumes;
+    - ``"fresh"`` — a different problem (or no usable fingerprint): the
+      caller's warn-and-start-fresh path.
+
+    Raises ``MeshMismatchError`` for the same-problem/different-mesh
+    case when elastic migration is pinned off.
+    """
+    saved_fp = mesh_fp_compat(saved_fp, expected_fp)
+    if not isinstance(saved_fp, dict):
+        return "fresh", saved_fp
+    matches = same_problem if same_problem is not None else (
+        lambda a, b: a == b
+    )
+    if matches(saved_fp, expected_fp):
+        return "resume", saved_fp
+    if refuse_mesh_mismatch(
+        saved_fp, expected_fp, where,
+        extra_mesh_keys=extra_mesh_keys, same_problem=same_problem,
+    ):
+        return "migrate", saved_fp
+    return "fresh", saved_fp
 
 
 def mesh_fp_compat(saved_fp, expected_fp):
@@ -287,6 +372,154 @@ def mesh_fp_compat(saved_fp, expected_fp):
         if k not in out and k in expected_fp:
             out[k] = expected_fp[k]
     return out
+
+
+#: family name -> adapter(state, layout) -> migrated state. Families
+#: register at import; ``reshard_state`` imports them lazily so the
+#: registry is always populated by first use (no import cycles: this
+#: module never imports the solvers at top level).
+_RESHARD_ADAPTERS: dict = {}
+
+
+def register_reshard_adapter(family: str, adapter) -> None:
+    """Register one durable-state family's migration adapter. The
+    adapter takes ``(state, layout)`` — the persisted payload dict and
+    the target ``SpecLayout`` — and returns a NEW payload whose
+    accumulators are bit-identical and whose mesh manifest names the
+    target layout; it raises ``MeshMismatchError`` for payloads it can
+    prove torn/partial (those must keep the typed refusal)."""
+    _RESHARD_ADAPTERS[family] = adapter
+
+
+def _infer_reshard_family(state) -> Optional[str]:
+    """Which durable-state family a payload dict belongs to, from its
+    key shape (each family's snapshot schema is disjoint)."""
+    if not isinstance(state, dict):
+        return None
+    keys = set(state)
+    if {"pipeline_digest", "digests", "rows"} <= keys:
+        return "profile"
+    if {"fingerprint", "gram", "atb"} <= keys:
+        if {"x_sum", "y_sum"} <= keys:
+            return "online_state"
+        if "chunks_done" in keys:
+            return "stream_solve"
+    if {"fingerprint", "epoch", "W", "R"} <= keys:
+        return "bcd_stream" if "block" in keys else "bcd_epoch"
+    return None
+
+
+def reshard_state(state, new_layout: Optional[SpecLayout] = None,
+                  family: Optional[str] = None):
+    """Migrate one durable-state payload onto ``new_layout``'s mesh
+    width — the elastic-mesh recovery every checkpointing family shares.
+
+    The retained f64 accumulators are placement-free by construction
+    (gram/AᵀB/col_sums are psum'd sums whose grouping invariance PR 14
+    pinned), so migration is a manifest rewrite, not a recompute: the
+    per-family adapter re-folds/re-pads anything mesh-shaped (e.g. the
+    BCD residual's padded rows), rewrites the fingerprint's mesh keys
+    (``MESH_FP_KEYS``) onto the new layout, and returns a NEW payload
+    bit-identical in every accumulator byte. A migrated resume therefore
+    matches an uninterrupted fresh fit at the target width bit-for-bit.
+
+    ``family`` names the adapter explicitly; None infers it from the
+    payload's key shape. Every migration is counted in the "elastic"
+    metrics registry family and logged — never silent. Truly
+    non-migratable state (unknown family, torn/partial per-shard
+    payloads) raises the typed ``MeshMismatchError`` instead.
+    """
+    import logging
+
+    # Importing the families registers their adapters (see
+    # register_reshard_adapter); lazy so there is no import cycle.
+    import keystone_tpu.linalg.bcd  # noqa: F401
+    import keystone_tpu.linalg.normal_equations  # noqa: F401
+    import keystone_tpu.workflow.online  # noqa: F401
+    import keystone_tpu.workflow.profile_store  # noqa: F401
+    from keystone_tpu.utils.metrics import elastic_counters
+
+    if new_layout is None:
+        new_layout = SpecLayout.for_mesh()
+    if family is None:
+        family = _infer_reshard_family(state)
+    adapter = _RESHARD_ADAPTERS.get(family)
+    if adapter is None:
+        elastic_counters.bump("migrations_refused")
+        raise MeshMismatchError(
+            f"reshard_state: no migration adapter for this state "
+            f"(family={family!r}); it cannot be migrated across mesh "
+            "widths — re-run on the recording mesh width"
+        )
+    migrated = adapter(state, new_layout)
+    elastic_counters.bump("states_migrated")
+    elastic_counters.bump(f"{family}_migrated")
+    logging.getLogger("keystone_tpu").warning(
+        "elastic mesh: migrated %s state onto %d-shard mesh "
+        "(counted in metrics family 'elastic')",
+        family, new_layout.num_shards,
+    )
+    return migrated
+
+
+#: Filename of the JSON mesh sidecar every checkpoint writer drops next
+#: to its payloads — the static lint's (KG107) no-execution window into
+#: what mesh a directory's state was folded under.
+MESH_MANIFEST_NAME = "mesh_manifest.json"
+
+
+def write_mesh_manifest(ckpt_dir: str, fingerprint) -> None:
+    """Atomic JSON sidecar naming the mesh a checkpoint directory's state
+    was folded under (the fingerprint is JSON-safe scalars by
+    construction), so the static lint (KG107) can flag a width drift with
+    one dict read — no unpickling, no orbax restore, no execution.
+    Best-effort: a read-only store keeps its payloads authoritative."""
+    import json
+    import os
+
+    path = os.path.join(os.path.abspath(ckpt_dir), MESH_MANIFEST_NAME)
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(dict(fingerprint), f)
+        os.replace(tmp, path)
+    except (OSError, TypeError, ValueError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def read_mesh_manifest(ckpt_dir) -> Optional[dict]:
+    """The sidecar's fingerprint dict, or None when absent/unreadable —
+    the advisory read; payload fingerprints stay authoritative at
+    resume."""
+    import json
+    import os
+
+    if not ckpt_dir:
+        return None
+    path = os.path.join(os.path.abspath(str(ckpt_dir)), MESH_MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def reshard_refused(where: str, reason: str) -> MeshMismatchError:
+    """The non-migratable refusal adapters raise: counted (never silent)
+    and worded like every other mesh refusal, naming the recovery."""
+    from keystone_tpu.utils.metrics import elastic_counters
+
+    elastic_counters.bump("migrations_refused")
+    return MeshMismatchError(
+        f"{where}: state cannot be migrated across mesh widths "
+        f"({reason}); reshard_state refuses rather than resume a "
+        "corrupted payload — re-run on the recording mesh width or "
+        "delete the checkpoint after inspecting it"
+    )
 
 
 def value_data_shards(value) -> Optional[int]:

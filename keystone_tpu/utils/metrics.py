@@ -1837,3 +1837,35 @@ class OnlineCounters(CounterSet):
 
 online_counters = OnlineCounters()
 metrics_registry.register("online", online_counters)
+
+
+class ElasticCounters(CounterSet):
+    """Process-wide elastic-mesh observability: every durable-state
+    migration across a mesh-width change (``utils.mesh.reshard_state``)
+    lands here, so "the resume was migrated, not refused and not
+    silently restarted" is a counter assertion — the never-silent half
+    of the ``KEYSTONE_ELASTIC_MESH`` contract. Thread-safe (CounterSet);
+    rides ``/metrics`` like every registry family.
+
+    Well-known keys:
+
+    - ``states_migrated`` — total successful ``reshard_state``
+      migrations, any family
+    - ``stream_solve_migrated`` — chunked-solve snapshots
+      (``solve_least_squares_chunked`` checkpoints) re-manifested onto a
+      new mesh width
+    - ``bcd_epoch_migrated`` / ``bcd_stream_migrated`` — BCD epoch
+      checkpoints (orbax) and mid-epoch block snapshots whose residual
+      was re-padded and manifest rewritten
+    - ``online_state_migrated`` — ``OnlineState`` snapshots resumed
+      across a width change
+    - ``profile_migrated`` — profile-store entries whose per-shard rows
+      were re-scaled onto the new width
+    - ``migrations_refused`` — same-problem/different-mesh state that
+      could NOT be migrated (torn/partial per-shard payload, unknown
+      family): kept the typed ``MeshMismatchError`` refusal
+    """
+
+
+elastic_counters = ElasticCounters()
+metrics_registry.register("elastic", elastic_counters)

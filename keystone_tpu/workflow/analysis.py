@@ -52,6 +52,13 @@ Rule catalog (KG = Keystone Graph):
   donating its input and the fit holds the batch live twice (input +
   chain output). Host-staged arrivals (streamed batches, the pad class)
   donate their staging copy instead. Shape-only, no execution.
+- ``KG107 checkpoint-mesh-drift`` — an estimator configured with a
+  ``checkpoint_dir`` whose on-disk mesh manifest was recorded under a
+  DIFFERENT mesh width than the active data mesh: the fit will hit the
+  elastic migration (counted) — or the typed ``MeshMismatchError`` with
+  ``KEYSTONE_ELASTIC_MESH=0`` — at resume time. Flagged up front from
+  the directory's JSON sidecar (a static dict read: no unpickling, no
+  orbax restore, no execution).
 - ``KG201 dead-node`` — a node in the graph unreachable from the sink
   (composition orphans the pruner should have dropped).
 - ``KG202 cache-advice`` — a non-trivial subchain re-used by >= 2
@@ -63,7 +70,7 @@ Rule catalog (KG = Keystone Graph):
 
 Severity model: serveability rules (KG00x) are *errors* when linting
 with ``serve=True`` (the pre-``compiled()`` gate) and *warnings*
-otherwise; KG101/KG102/KG103/KG104/KG105/KG106 are warnings;
+otherwise; KG101/KG102/KG103/KG104/KG105/KG106/KG107 are warnings;
 KG201/KG202/KG203 are info.
 
 Wire-up: ``Pipeline.lint()`` runs this directly; the opt-in env gate
@@ -108,6 +115,8 @@ GRAPH_RULES: Dict[str, str] = {
              "per cadence tick)",
     "KG106": "estimator's fit chain lowers without donation (mesh-placed "
              "caller-owned input)",
+    "KG107": "checkpoint_dir holds state recorded under a different mesh "
+             "width",
     "KG201": "dead node unreachable from the pipeline sink",
     "KG202": "re-used subchain with no cache node",
     "KG203": "stored measured profile exists but auto-cache is model-only",
@@ -705,6 +714,52 @@ def lint_graph(
                      "BlockLeastSquaresEstimator / LeastSquaresEstimator) "
                      "or accept the counted online.full_refits cost",
             ))
+
+    # -- KG107: checkpoint_dir state recorded under a different mesh -------
+    # Pure static read: the checkpoint writers drop a JSON mesh sidecar
+    # (utils.mesh.write_mesh_manifest) next to their payloads, so the
+    # width comparison is one dict read per checkpointed estimator — no
+    # unpickling, no orbax restore, no execution. Absent sidecars
+    # (pre-elastic directories, no checkpoint yet) stay silent: the
+    # resume-time triage is authoritative; this is the early warning.
+    for nid, op in graph.operators.items():
+        if not isinstance(op, EstimatorOperator):
+            continue
+        ckpt_dir = getattr(
+            getattr(op, "estimator", None), "checkpoint_dir", None
+        )
+        if not ckpt_dir:
+            continue
+        from keystone_tpu.utils.mesh import (
+            num_data_shards,
+            read_mesh_manifest,
+        )
+
+        manifest = read_mesh_manifest(ckpt_dir)
+        if manifest is None:
+            continue
+        recorded = manifest.get("device_count")
+        if recorded is None:
+            continue
+        try:
+            active = int(num_data_shards())
+        except RuntimeError:  # deviceless backend: no mesh to drift from
+            continue
+        if int(recorded) == active:
+            continue
+        emit(Diagnostic(
+            "KG107", "warning", _node_label(graph, nid),
+            f"checkpoint_dir {ckpt_dir} holds solver state recorded "
+            f"under a {int(recorded)}-shard mesh, but the active data "
+            f"mesh has {active} shards: the fit will migrate the state "
+            "at resume (elastic mesh, counted in the 'elastic' metrics "
+            "family) — or refuse with MeshMismatchError under "
+            "KEYSTONE_ELASTIC_MESH=0",
+            hint="expected with an intentional width change (the elastic "
+                 "migration is bit-identical); otherwise point "
+                 "checkpoint_dir at state recorded on this mesh, or "
+                 "migrate it explicitly with utils.mesh.reshard_state",
+        ))
 
     # -- KG202: cache placement advice (consumer map shared with KG103) ----
     for gid, users in consumers.items():

@@ -34,7 +34,8 @@ Three layers:
   ``checkpoint_dir`` the accumulator state snapshots after every fold
   and a killed trainer resumes **bit-identically** (the
   ``_stream_fingerprint`` contract: state folded under one mesh width
-  refuses to resume under another, typed, never a wrong answer).
+  migrates onto another via ``utils.mesh.reshard_state`` — elastic
+  mesh, default on, counted — or refuses typed, never a wrong answer).
 
 Forgetting modes (exclusive):
 
@@ -66,6 +67,8 @@ import threading
 from typing import Any, List, Optional, Tuple
 
 import numpy as np
+
+from keystone_tpu.utils.mesh import register_reshard_adapter
 
 logger = logging.getLogger("keystone_tpu")
 
@@ -224,8 +227,11 @@ class OnlineState:
             raise OnlineStateError(
                 f"fold under mesh {mesh_now} into accumulators folded "
                 f"under ({self.device_count}, {self.data_axis!r}) refused "
-                "— re-shard state via a checkpoint on the recording mesh "
-                "or start a fresh state"
+                "— migrate the state onto the current mesh via "
+                "utils.mesh.reshard_state (snapshot/from_snapshot does "
+                "this automatically with elastic mesh on), or fold on "
+                "the recording mesh width; the retained work is "
+                "recoverable"
             )
         dtypes_now = (str(config.default_dtype), str(config.accum_dtype))
         if dtypes_now != (self.default_dtype, self.accum_dtype):
@@ -460,12 +466,18 @@ class OnlineState:
 
     @classmethod
     def from_snapshot(cls, snap: dict) -> "OnlineState":
-        """Rebuild a state from :meth:`snapshot`. The snapshot's mesh
-        manifest must match the CURRENT mesh — resuming accumulator
-        state across a mesh-width change is refused with the typed
+        """Rebuild a state from :meth:`snapshot`. A snapshot of the same
+        problem recorded under a DIFFERENT mesh width migrates onto the
+        current mesh (``utils.mesh.reshard_state`` — the accumulators are
+        placement-free f64 sums, so the migrated state folds and solves
+        bit-identically; elastic mesh, default on, counted) or, with
+        ``KEYSTONE_ELASTIC_MESH=0``, refuses with the typed
         ``MeshMismatchError`` (the one rule every checkpointing solver
-        shares), never a wrong-answer resume."""
-        from keystone_tpu.utils.mesh import refuse_mesh_mismatch
+        shares) — never a wrong-answer resume."""
+        from keystone_tpu.utils.mesh import (
+            mesh_resume_decision,
+            reshard_state,
+        )
 
         fp = dict(snap["fingerprint"])
         state = cls(
@@ -473,11 +485,15 @@ class OnlineState:
             window=fp.get("window"),
         )
         expected = state.fingerprint()
-        if fp != expected:
-            refuse_mesh_mismatch(fp, expected, "online state")
+        decision, fp = mesh_resume_decision(fp, expected, "online state")
+        if decision == "fresh":
             raise OnlineStateError(
                 f"online-state snapshot holds a different problem "
                 f"({fp} != {expected}); delete it to start fresh"
+            )
+        if decision == "migrate":
+            snap = reshard_state(
+                dict(snap, fingerprint=fp), family="online_state"
             )
         state.gram = np.asarray(snap["gram"], dtype=np.float64)
         state.atb = np.asarray(snap["atb"], dtype=np.float64)
@@ -500,8 +516,8 @@ class OnlineState:
     @classmethod
     def load(cls, directory: str) -> Optional["OnlineState"]:
         """The checkpointed state, or None when none exists. A snapshot
-        recorded under a different mesh width raises the typed
-        ``MeshMismatchError`` (see :meth:`from_snapshot`)."""
+        recorded under a different mesh width migrates or raises the
+        typed ``MeshMismatchError`` (see :meth:`from_snapshot`)."""
         from keystone_tpu.workflow.disk_cache import DiskCache
 
         snap = DiskCache(directory, suffix=".online.pkl").get(_STATE_KEY)
@@ -514,6 +530,34 @@ class OnlineState:
         return state
 
 
+def _reshard_online_snapshot(snap, layout):
+    """Elastic-mesh adapter for :meth:`OnlineState.snapshot` payloads:
+    every retained accumulator (gram/AᵀB/col-sums, the pending row bytes,
+    the window ring's stats units) is a host-resident f64 sum or raw row
+    buffer — placement-free, nothing per-shard to re-fold — so migration
+    rewrites the fingerprint's mesh manifest onto ``layout`` and passes
+    the bytes through untouched. Torn payloads (accumulator shapes
+    contradicting the fingerprint) refuse typed instead."""
+    from keystone_tpu.utils.mesh import reshard_refused
+
+    fp = dict(snap.get("fingerprint") or {})
+    d = int(fp.get("d", -1))
+    gram = snap.get("gram")
+    gram = np.asarray(gram) if gram is not None else None
+    if gram is None or gram.shape != (d, d):
+        raise reshard_refused(
+            "online state",
+            "snapshot accumulators do not match their fingerprint "
+            "(torn or partially written checkpoint)",
+        )
+    fp["device_count"] = int(layout.num_shards)
+    fp["data_axis"] = str(layout.axis)
+    return dict(snap, fingerprint=fp)
+
+
+register_reshard_adapter("online_state", _reshard_online_snapshot)
+
+
 def save_state_snapshot(directory: str, snap: dict) -> None:
     """Write one already-taken :meth:`OnlineState.snapshot` through the
     atomic DiskCache — THE checkpoint write shared by ``state.save`` and
@@ -523,6 +567,9 @@ def save_state_snapshot(directory: str, snap: dict) -> None:
     DiskCache(directory, suffix=".online.pkl").put(
         _STATE_KEY, snap, overwrite=True
     )
+    from keystone_tpu.utils.mesh import write_mesh_manifest
+
+    write_mesh_manifest(directory, snap.get("fingerprint") or {})
     from keystone_tpu.utils.metrics import reliability_counters
 
     reliability_counters.bump("checkpoints_written")

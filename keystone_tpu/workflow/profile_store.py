@@ -199,10 +199,15 @@ def load_profile(
 
     Returns None when the store is unconfigured, the entry is absent, or
     the entry is corrupt/tampered/unknown-version (warned, skipped — an
-    optimizer pass must degrade to model-only, not crash). Raises
-    ``ProfileFingerprintError`` when the entry exists and parses but was
-    recorded under an incompatible runtime: that is a refusal the caller
-    must hear about, not silently equivalent to 'no profile'.
+    optimizer pass must degrade to model-only, not crash). An entry from
+    the same backend/device kind whose only disagreement is the mesh
+    width MIGRATES onto the live width (elastic mesh, default on: the
+    per-shard plan rows are re-scaled through ``utils.mesh.reshard_state``
+    and the migrated entry is persisted back, counted — never silent).
+    Raises ``ProfileFingerprintError`` when the entry exists and parses
+    but was recorded under an incompatible runtime (or elastic migration
+    is pinned off): that is a refusal the caller must hear about, not
+    silently equivalent to 'no profile'.
     """
     root = store_dir_or_none(store_dir)
     if not root or not pipeline_digest:
@@ -228,13 +233,124 @@ def load_profile(
 
         fingerprint = runtime_fingerprint()
     if not _fingerprint_compatible(entry.fingerprint, fingerprint):
+        migrated = _elastic_profile_migration(entry, fingerprint, root)
+        if migrated is not None:
+            return migrated
         raise ProfileFingerprintError(
             f"stored profile {path} was recorded under "
             f"{entry.fingerprint}, incompatible with this runtime "
             f"{ {k: fingerprint.get(k) for k in _FINGERPRINT_KEYS} }; "
-            "re-profile with Pipeline.fit(profile=True) on this backend"
+            "re-profile with Pipeline.fit(profile=True) on this backend "
+            "(a mesh-width-only mismatch migrates automatically via "
+            "utils.mesh.reshard_state unless KEYSTONE_ELASTIC_MESH=0)"
         )
     return entry
+
+
+def _reshard_profile_doc(doc: Dict[str, Any], layout) -> Dict[str, Any]:
+    """Elastic-mesh adapter for store entries: the measured wall/bytes
+    aggregates describe the pipeline, not the mesh — only the per-shard
+    plan provenance (``data_shards`` on digest aggregates and attribution
+    rows) and the fingerprint's ``device_count`` follow the width. Rows
+    recorded at the OLD width re-scale onto ``layout``; the payload
+    digest is recomputed so the migrated entry passes the integrity
+    check. Entries with no recorded width refuse typed."""
+    from keystone_tpu.utils.mesh import reshard_refused
+
+    fp = dict(doc.get("fingerprint") or {})
+    old = fp.get("device_count")
+    new = int(layout.num_shards)
+    if not isinstance(old, int) or old <= 0:
+        raise reshard_refused(
+            "profile store",
+            "entry has no recorded mesh width to migrate from",
+        )
+    digests = {k: dict(v) for k, v in (doc.get("digests") or {}).items()}
+    rows = [dict(r) for r in (doc.get("rows") or [])]
+    for agg in digests.values():
+        if agg.get("data_shards") == old:
+            agg["data_shards"] = new
+    for row in rows:
+        if row.get("data_shards") == old:
+            row["data_shards"] = new
+    fp["device_count"] = new
+    out = dict(doc, fingerprint=fp, digests=digests, rows=rows)
+    out["payload_digest"] = _payload_digest(digests, rows)
+    return out
+
+
+def _register_profile_adapter() -> None:
+    from keystone_tpu.utils.mesh import register_reshard_adapter
+
+    register_reshard_adapter("profile", _reshard_profile_doc)
+
+
+_register_profile_adapter()
+
+
+def _elastic_profile_migration(
+    entry: StoredProfile, fingerprint: Dict[str, Any], root: str
+) -> Optional[StoredProfile]:
+    """Migrate ``entry`` onto the live mesh width when that is its ONLY
+    incompatibility, elastic mesh is on, and the lookup fingerprint IS
+    the live runtime (a synthetic fingerprint is a question about another
+    machine, not a resume — it keeps the typed refusal). Persists the
+    migrated entry back to the store (best-effort: a read-only store
+    still serves the migrated copy this load). Returns None when the
+    mismatch is not elastically recoverable."""
+    from keystone_tpu.config import config
+
+    if not config.elastic_mesh:
+        return None
+    saved_dc = entry.fingerprint.get("device_count")
+    want_dc = fingerprint.get("device_count")
+    if saved_dc is None or want_dc is None or saved_dc == want_dc:
+        return None
+    others_saved = {
+        k: entry.fingerprint.get(k)
+        for k in _FINGERPRINT_KEYS if k != "device_count"
+    }
+    others_want = {
+        k: fingerprint.get(k)
+        for k in _FINGERPRINT_KEYS if k != "device_count"
+    }
+    if not _fingerprint_compatible(others_saved, others_want):
+        return None
+    from keystone_tpu.utils.mesh import SpecLayout
+
+    try:
+        layout = SpecLayout.for_mesh()
+    except Exception:  # lint: broad-ok deviceless backend: no live mesh to migrate onto
+        return None
+    if int(want_dc) != int(layout.num_shards):
+        return None
+    from keystone_tpu.utils.mesh import reshard_state
+
+    doc = {
+        "version": STORE_VERSION,
+        "pipeline_digest": entry.pipeline_digest,
+        "fingerprint": dict(entry.fingerprint),
+        "digests": entry.digests,
+        "rows": entry.rows,
+    }
+    migrated = reshard_state(doc, new_layout=layout, family="profile")
+    try:
+        save_profile(
+            entry.pipeline_digest, migrated["digests"], migrated["rows"],
+            store_dir=root, fingerprint=migrated["fingerprint"],
+        )
+    except (ProfileStoreError, OSError) as e:
+        logger.warning(
+            "profile store: migrated entry for %s could not be persisted "
+            "(%s); serving the in-memory migration", entry.pipeline_digest, e,
+        )
+    return StoredProfile(
+        pipeline_digest=entry.pipeline_digest,
+        fingerprint=migrated["fingerprint"],
+        digests=migrated["digests"],
+        rows=migrated["rows"],
+        path=entry.path,
+    )
 
 
 def _parse_entry(path: str, pipeline_digest: str) -> Optional[StoredProfile]:

@@ -1,0 +1,659 @@
+"""Elastic mesh (ISSUE-18): durable solver state reshards across mesh
+width changes instead of refusing.
+
+The contracts, pinned:
+
+- **Bit-identity**: a fit checkpointed at one width and resumed at
+  another (both shrink and grow, in-process via a narrowed default mesh)
+  produces bit-identical final weights to an uninterrupted fit at the
+  TARGET width — chunked solve, BCD epoch checkpoints, and OnlineState
+  (plain, decay, and window modes). The accumulators are placement-free
+  f64/psum'd sums (the PR-14 grouping-invariance rule), so migration is
+  a manifest rewrite, never a recompute.
+- **Never silent**: every migration lands in the "elastic" counter
+  family (``states_migrated`` + per-family keys) and rides ``/metrics``;
+  torn/partial payloads refuse with the typed ``MeshMismatchError``
+  (``migrations_refused`` counted).
+- **Escape hatch**: ``KEYSTONE_ELASTIC_MESH=0`` pins the pre-elastic
+  refuse-only contract (pinned in test_mesh_fit/test_online; the
+  default-on path here).
+- **One triage**: the three legacy-wildcard ``mesh_fp_compat`` call
+  sites (stream solve, BCD, OnlineState) ride one helper
+  (``mesh_resume_decision``) — pre-manifest checkpoints resume across
+  all three families, parametrized.
+- **KG107**: a checkpointed estimator whose directory's mesh manifest
+  was recorded under a different width is flagged at lint time from the
+  JSON sidecar (static dict read, no execution).
+- **bench_watch**: the ``fit_elastic`` family (migration speedup,
+  HIGHER_BETTER) regresses on speedup collapse and bit_identical flips,
+  passes healthy reruns.
+
+The 8→16 grow direction needs more devices than the in-process fake-8
+mesh; tools/chaos_elastic.py covers it in subprocesses (the `make
+chaos-elastic` leg, slow-marked here).
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from keystone_tpu.config import config
+from keystone_tpu.utils import mesh as mesh_util
+from keystone_tpu.utils.mesh import (
+    MeshMismatchError,
+    SpecLayout,
+    layout_of_array,
+    mesh_resume_decision,
+    num_data_shards,
+    read_mesh_manifest,
+    reshard_state,
+    set_default_mesh,
+    value_data_shards,
+    write_mesh_manifest,
+)
+from keystone_tpu.utils.metrics import (
+    elastic_counters,
+    metrics_registry,
+    reliability_counters,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+D, K = 12, 3
+
+
+@pytest.fixture(autouse=True)
+def _fresh_elastic_counters():
+    elastic_counters.reset()
+    reliability_counters.reset()
+    yield
+    elastic_counters.reset()
+    reliability_counters.reset()
+
+
+def _narrow_mesh(width: int) -> None:
+    """Shrink the default mesh to the first ``width`` fake devices (the
+    test-suite analog of losing hosts mid-run)."""
+    set_default_mesh(mesh_util.default_mesh(devices=jax.devices()[:width]))
+
+
+def _stream(n=72, chunks=6):
+    """Six 12-row chunks: 12 % 8 != 0 (mask-pad at width 8) while
+    12 % 4 == 0 (direct shard at width 4) — both placement classes in
+    one stream."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, D)).astype(np.float32)
+    Y = rng.normal(size=(n, K)).astype(np.float32)
+    rows = n // chunks
+
+    def it():
+        for i in range(chunks):
+            yield X[i * rows:(i + 1) * rows], Y[i * rows:(i + 1) * rows]
+
+    return X, Y, it
+
+
+class Kill(Exception):
+    pass
+
+
+def _killed(it, at):
+    def gen():
+        for i, batch in enumerate(it()):
+            if i == at:
+                raise Kill()
+            yield batch
+
+    return gen
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: bit-identical migrated resume, per family
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("source_w,target_w", [(8, 4), (4, 8)])
+def test_stream_solve_migrates_bit_identical(tmp_path, source_w, target_w):
+    """Chunked solve killed at one width resumes at another through the
+    elastic migration, matching the uninterrupted fit at the target
+    width bit-for-bit — and the migration is counted, never silent."""
+    from keystone_tpu.linalg import solve_least_squares_chunked
+
+    _, _, it = _stream()
+    ckpt = str(tmp_path / "ckpt")
+    if source_w != 8:
+        _narrow_mesh(source_w)
+    with pytest.raises(Kill):
+        solve_least_squares_chunked(
+            _killed(it, 4)(), lam=0.1,
+            checkpoint_dir=ckpt, checkpoint_every=2,
+        )
+    # "Pod resize": the surviving run continues on the target width.
+    if target_w == 8:
+        mesh_util.reset_default_mesh()
+    else:
+        _narrow_mesh(target_w)
+    assert num_data_shards() == target_w
+    ref = np.asarray(solve_least_squares_chunked(it(), lam=0.1))
+    out = np.asarray(
+        solve_least_squares_chunked(
+            it(), lam=0.1, checkpoint_dir=ckpt, checkpoint_every=2
+        )
+    )
+    np.testing.assert_array_equal(ref, out)
+    assert elastic_counters.get("states_migrated") == 1
+    assert elastic_counters.get("stream_solve_migrated") == 1
+    assert reliability_counters.get("checkpoints_resumed") == 1
+    assert reliability_counters.get("chunks_skipped_on_resume") == 4
+
+
+@pytest.mark.parametrize("source_w,target_w", [(8, 4), (4, 8)])
+def test_bcd_epoch_checkpoint_migrates_bit_identical(
+    tmp_path, monkeypatch, source_w, target_w
+):
+    """BCD epoch checkpoints re-pad the residual onto the new shard
+    multiple (68 rows: padded 72 at width 8, unpadded at width 4) and
+    resume to the same bits as the uninterrupted target-width solve."""
+    from keystone_tpu.linalg.bcd import (
+        assemble_blocks,
+        block_coordinate_descent,
+    )
+    from keystone_tpu.linalg.row_matrix import RowMatrix
+
+    rng = np.random.default_rng(1)
+    Xh = rng.normal(size=(68, 16)).astype(np.float32)
+    Yh = rng.normal(size=(68, K)).astype(np.float32)
+    ckpt = str(tmp_path / "bcd_ckpt")
+    if source_w != 8:
+        _narrow_mesh(source_w)
+    # Epoch 1 of 2 completes and checkpoints, then the "pod" dies
+    # mid-epoch-2. Interrupting a real num_iters=2 run (rather than
+    # seeding with num_iters=1) keeps every auto solver policy —
+    # cache_grams in particular — identical across seed, resume, and
+    # the fresh reference, so the bit gate tests resharding alone.
+    import keystone_tpu.linalg.bcd as bcd_mod
+
+    real_save = bcd_mod._save_epoch
+
+    def killing_save(*a, **k):
+        real_save(*a, **k)
+        raise Kill()
+
+    monkeypatch.setattr(bcd_mod, "_save_epoch", killing_save)
+    with pytest.raises(Kill):
+        block_coordinate_descent(
+            RowMatrix.from_array(Xh), RowMatrix.from_array(Yh),
+            block_size=8, num_iters=2, lam=1e-3, checkpoint_dir=ckpt,
+        )
+    monkeypatch.setattr(bcd_mod, "_save_epoch", real_save)
+    bcd_mod.wait_for_checkpoints(ckpt)
+    if target_w == 8:
+        mesh_util.reset_default_mesh()
+    else:
+        _narrow_mesh(target_w)
+    assert num_data_shards() == target_w
+    A, B = RowMatrix.from_array(Xh), RowMatrix.from_array(Yh)
+    Wr, _ = block_coordinate_descent(
+        A, B, block_size=8, num_iters=2, lam=1e-3, checkpoint_dir=ckpt,
+    )
+    Wf, _ = block_coordinate_descent(
+        RowMatrix.from_array(Xh), RowMatrix.from_array(Yh),
+        block_size=8, num_iters=2, lam=1e-3,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(assemble_blocks(Wr)), np.asarray(assemble_blocks(Wf))
+    )
+    assert elastic_counters.get("bcd_epoch_migrated") == 1
+    assert elastic_counters.get("states_migrated") == 1
+
+
+@pytest.mark.parametrize("mode", ["plain", "decay", "window"])
+@pytest.mark.parametrize("source_w,target_w", [(8, 4), (4, 8)])
+def test_online_state_migrates_bit_identical(
+    tmp_path, mode, source_w, target_w
+):
+    """An OnlineState snapshot folded at one width loads at another
+    (migrated, counted) and the continued stream solves to the same bits
+    as a fresh fold of the whole stream at the target width — in every
+    forgetting mode."""
+    from keystone_tpu.nodes.learning.linear_mapper import LinearMapEstimator
+    from keystone_tpu.workflow.online import OnlineState
+
+    kw = {}
+    if mode == "decay":
+        kw["decay"] = 0.5
+    if mode == "window":
+        kw["window"] = 2
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(64, D)).astype(np.float32)
+    Y = rng.normal(size=(64, K)).astype(np.float32)
+    splits = [(X[s:e], Y[s:e]) for s, e in
+              [(0, 20), (20, 36), (36, 52), (52, 64)]]
+    est = LinearMapEstimator(lam=1e-3)
+    if source_w != 8:
+        _narrow_mesh(source_w)
+    st = None
+    for bx, by in splits[:2]:
+        st = est.partial_fit(bx, by, state=st, **kw)
+    st.save(str(tmp_path))
+    if target_w == 8:
+        mesh_util.reset_default_mesh()
+    else:
+        _narrow_mesh(target_w)
+    assert num_data_shards() == target_w
+    resumed = OnlineState.load(str(tmp_path))
+    assert resumed is not None
+    assert elastic_counters.get("online_state_migrated") == 1
+    assert resumed.device_count == target_w
+    for bx, by in splits[2:]:
+        resumed = est.partial_fit(bx, by, state=resumed, **kw)
+    fresh = None
+    for bx, by in splits:
+        fresh = est.partial_fit(bx, by, state=fresh, **kw)
+    m_r, m_f = est.solve_online(resumed), est.solve_online(fresh)
+    np.testing.assert_array_equal(np.asarray(m_r.W), np.asarray(m_f.W))
+    np.testing.assert_array_equal(np.asarray(m_r.b), np.asarray(m_f.b))
+
+
+@pytest.mark.parametrize("source_w,target_w", [(8, 4), (4, 8)])
+def test_profile_entry_migrates_onto_live_width(
+    tmp_path, source_w, target_w
+):
+    """A profile-store entry recorded at another width re-scales its
+    per-shard plan rows onto the live mesh (persisted back, counted)
+    instead of refusing — but only when the lookup IS the live runtime."""
+    from keystone_tpu.workflow.profile_store import (
+        load_profile,
+        save_profile,
+    )
+
+    digest = "e" * 40
+    digests = {"abc": {"label": "X", "calls": 1, "wall_ns": 10,
+                       "out_bytes": 4, "out_rows": 1,
+                       "queue_wait_ns": 0, "out_shape": [1, 1],
+                       "data_shards": source_w}}
+    rows = [{"node": "X", "data_shards": source_w}]
+    save_profile(
+        digest, digests, rows, store_dir=str(tmp_path),
+        fingerprint={"backend": "cpu", "device_kind": "cpu",
+                     "device_count": source_w},
+    )
+    if target_w != 8:
+        _narrow_mesh(target_w)
+    entry = load_profile(
+        digest, store_dir=str(tmp_path),
+        fingerprint={"backend": "cpu", "device_kind": "cpu",
+                     "device_count": target_w},
+    )
+    assert entry is not None
+    assert entry.fingerprint["device_count"] == target_w
+    assert entry.node("abc")["data_shards"] == target_w
+    assert entry.rows[0]["data_shards"] == target_w
+    assert elastic_counters.get("profile_migrated") == 1
+    # Migration persisted: the next load at the new width is a clean hit
+    # (no second migration).
+    again = load_profile(
+        digest, store_dir=str(tmp_path),
+        fingerprint={"backend": "cpu", "device_kind": "cpu",
+                     "device_count": target_w},
+    )
+    assert again is not None
+    assert elastic_counters.get("profile_migrated") == 1
+
+
+def test_profile_migration_requires_live_width(tmp_path):
+    """A lookup fingerprint naming NEITHER the recorded nor the live
+    width is a question about another machine: still refused typed."""
+    from keystone_tpu.workflow.profile_store import (
+        ProfileFingerprintError,
+        load_profile,
+        save_profile,
+    )
+
+    digest = "f" * 40
+    save_profile(
+        digest, {"abc": {"label": "X", "data_shards": 2}}, [],
+        store_dir=str(tmp_path),
+        fingerprint={"backend": "cpu", "device_kind": "cpu",
+                     "device_count": 2},
+    )
+    with pytest.raises(ProfileFingerprintError):
+        load_profile(
+            digest, store_dir=str(tmp_path),
+            fingerprint={"backend": "cpu", "device_kind": "cpu",
+                         "device_count": 4},  # live mesh is 8
+        )
+    assert elastic_counters.get("profile_migrated") == 0
+    # A backend mismatch is never elastically recoverable, even at the
+    # live width: a CPU profile must not size a TPU plan.
+    with pytest.raises(ProfileFingerprintError):
+        load_profile(
+            digest, store_dir=str(tmp_path),
+            fingerprint={"backend": "tpu", "device_kind": "tpu",
+                         "device_count": 8},
+        )
+    assert elastic_counters.get("profile_migrated") == 0
+
+
+# ---------------------------------------------------------------------------
+# Non-migratable state still refuses, typed and counted
+# ---------------------------------------------------------------------------
+
+
+def test_torn_stream_snapshot_refuses_typed():
+    state = {
+        "fingerprint": {"d": 8, "device_count": 4, "data_axis": "data"},
+        "chunks_done": 2,
+        "gram": np.eye(5),  # contradicts d=8: a torn payload
+        "atb": np.zeros((8, 2)),
+    }
+    with pytest.raises(MeshMismatchError, match="torn|refuses"):
+        reshard_state(state, family="stream_solve")
+    assert elastic_counters.get("migrations_refused") == 1
+    assert elastic_counters.get("states_migrated") == 0
+
+
+def test_torn_bcd_residual_refuses_typed():
+    """Nonzero rows in the residual's pad region can only mean a partial
+    per-shard write — the mid-chunk-partial-shard case the issue names
+    as truly non-migratable."""
+    fp = {"rows": 72, "n": 68, "d": 16, "k": K, "block_size": 8,
+          "lam": 1e-3, "weighted": False, "a_dtype": "float32",
+          "a_probe": 1.0, "b_probe": 2.0,
+          "device_count": 8, "data_axis": "data"}
+    R = np.zeros((72, K), dtype=np.float32)
+    R[70] = 7.0  # torn: pad rows must be zero by construction
+    state = {"fingerprint": fp, "epoch": 1,
+             "W": [np.zeros((8, K), np.float32)], "R": R}
+    with pytest.raises(MeshMismatchError, match="pad region"):
+        reshard_state(state, family="bcd_epoch")
+    assert elastic_counters.get("migrations_refused") == 1
+
+    # The clean counterpart migrates (sanity: the refusal above is about
+    # the torn bytes, not the shape change).
+    state["R"] = np.zeros((72, K), dtype=np.float32)
+    migrated = reshard_state(
+        state, new_layout=SpecLayout.for_mesh(
+            mesh_util.default_mesh(devices=jax.devices()[:4])
+        ),
+        family="bcd_epoch",
+    )
+    assert migrated["fingerprint"]["device_count"] == 4
+    assert migrated["fingerprint"]["rows"] == 80
+    assert migrated["R"].shape == (80, K)
+
+
+def test_unknown_family_refuses_typed():
+    with pytest.raises(MeshMismatchError, match="no migration adapter"):
+        reshard_state({"mystery": 1})
+    assert elastic_counters.get("migrations_refused") == 1
+
+
+# ---------------------------------------------------------------------------
+# Satellite: one resume triage, legacy pre-manifest resumes everywhere
+# ---------------------------------------------------------------------------
+
+
+def _bcd_matcher():
+    from keystone_tpu.linalg.bcd import _fingerprint_matches
+
+    return _fingerprint_matches
+
+
+_STREAM_FP = {"d": D, "b_tail": (K,), "accum_dtype": "float32",
+              "storage_dtype": "float32", "chunk_rows": 12,
+              "x0_probe": 1.25, "device_count": 8, "data_axis": "data"}
+_BCD_FP = {"rows": 72, "n": 68, "d": 16, "k": K, "block_size": 8,
+           "lam": 1e-3, "weighted": False, "a_dtype": "float32",
+           "a_probe": 1.0, "b_probe": 2.0,
+           "device_count": 8, "data_axis": "data"}
+_ONLINE_FP = {"d": D, "b_tail": (K,), "chunk_rows": 512, "window": None,
+              "default_dtype": "float32", "accum_dtype": "float32",
+              "device_count": 8, "data_axis": "data"}
+
+
+@pytest.mark.parametrize("expected,extra,matcher", [
+    (_STREAM_FP, (), None),
+    (_BCD_FP, ("rows",), _bcd_matcher),
+    (_ONLINE_FP, (), None),
+], ids=["stream_solve", "bcd", "online_state"])
+def test_legacy_premanifest_checkpoints_resume(expected, extra, matcher):
+    """The consolidated triage backfills absent mesh keys as wildcards
+    for every family: a pre-manifest checkpoint of the same problem
+    RESUMES (never silently restarts), a width conflict migrates, a
+    different problem goes fresh — one rule, three families."""
+    matches = matcher() if matcher else None
+    legacy = {k: v for k, v in expected.items()
+              if k not in ("device_count", "data_axis")}
+    decision, backfilled = mesh_resume_decision(
+        legacy, expected, "test", extra_mesh_keys=extra,
+        same_problem=matches,
+    )
+    assert decision == "resume"
+    assert backfilled["device_count"] == expected["device_count"]
+    # Same problem, explicit other width: migrate (elastic default-on).
+    other = dict(expected, device_count=2)
+    if "rows" in other:
+        other["rows"] = other["n"]  # padded rows follow the mesh
+    decision, _ = mesh_resume_decision(
+        other, expected, "test", extra_mesh_keys=extra,
+        same_problem=matches,
+    )
+    assert decision == "migrate"
+    # Different problem: fresh, never a typed mesh refusal.
+    decision, _ = mesh_resume_decision(
+        dict(other, d=999), expected, "test", extra_mesh_keys=extra,
+        same_problem=matches,
+    )
+    assert decision == "fresh"
+
+
+# ---------------------------------------------------------------------------
+# Satellite: post-reshard arrays report the NEW width
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("source_w,target_w", [(8, 4), (4, 8)])
+def test_post_reshard_arrays_report_new_width(source_w, target_w):
+    """value_data_shards / layout_of_array on arrays re-placed after a
+    width change name the NEW width — what profile rows and /metrics
+    report for migrated state, both directions."""
+    x = np.random.default_rng(3).normal(size=(64, D)).astype(np.float32)
+    if source_w != 8:
+        _narrow_mesh(source_w)
+    layout = SpecLayout.for_mesh()
+    placed = layout.put(x)
+    assert value_data_shards(placed) == source_w
+    assert layout_of_array(placed).num_shards == source_w
+    if target_w == 8:
+        mesh_util.reset_default_mesh()
+    else:
+        _narrow_mesh(target_w)
+    relayout = SpecLayout.for_mesh()
+    replaced = relayout.put(x)
+    assert value_data_shards(replaced) == target_w
+    assert layout_of_array(replaced) == relayout
+    assert layout_of_array(replaced).num_shards == target_w
+
+
+def test_elastic_counters_ride_metrics():
+    """Migrations are observable where every other counter lives: the
+    registry snapshot and the Prometheus exposition."""
+    elastic_counters.bump("states_migrated")
+    elastic_counters.bump("stream_solve_migrated")
+    snap = metrics_registry.snapshot()
+    assert snap["elastic"]["states_migrated"] == 1
+    prom = metrics_registry.prometheus()
+    assert "keystone_elastic" in prom
+    assert "states_migrated" in prom
+
+
+# ---------------------------------------------------------------------------
+# Satellite: KG107 — checkpoint mesh drift at lint time
+# ---------------------------------------------------------------------------
+
+
+def _ckpt_pipeline(ckpt_dir):
+    from keystone_tpu.nodes.learning import BlockLeastSquaresEstimator
+    from keystone_tpu.workflow import Transformer
+
+    class Ident(Transformer):
+        def apply_batch(self, X):
+            return X * 1.0
+
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(64, D)).astype(np.float32)
+    y = rng.normal(size=(64, K)).astype(np.float32)
+    return Ident().to_pipeline().and_then(
+        BlockLeastSquaresEstimator(
+            block_size=8, num_iters=1, lam=1e-3,
+            checkpoint_dir=str(ckpt_dir),
+        ),
+        X, y,
+    )
+
+
+def test_kg107_flags_checkpoint_width_drift(tmp_path):
+    write_mesh_manifest(str(tmp_path), {"device_count": 2,
+                                        "data_axis": "data"})
+    assert read_mesh_manifest(str(tmp_path))["device_count"] == 2
+    hits = _ckpt_pipeline(tmp_path).lint().by_rule("KG107")
+    assert hits, "width drift in checkpoint_dir must be flagged"
+    assert hits[0].severity == "warning"
+    assert "2-shard" in hits[0].message
+    assert "reshard_state" in hits[0].hint
+
+
+def test_kg107_silent_on_matching_or_absent_manifest(tmp_path):
+    # No sidecar at all (no checkpoint yet): silent.
+    assert not _ckpt_pipeline(tmp_path / "empty").lint().by_rule("KG107")
+    # Manifest recorded on THIS mesh: silent.
+    write_mesh_manifest(str(tmp_path), {"device_count": 8,
+                                        "data_axis": "data"})
+    assert not _ckpt_pipeline(tmp_path).lint().by_rule("KG107")
+
+
+def test_kg107_in_catalog():
+    from keystone_tpu.workflow.analysis import GRAPH_RULES
+
+    assert "KG107" in GRAPH_RULES
+
+
+def test_checkpoint_writers_drop_mesh_sidecars(tmp_path):
+    """All three checkpoint families leave the JSON sidecar KG107 reads
+    — the static-lint window is populated by normal operation."""
+    from keystone_tpu.linalg import solve_least_squares_chunked
+    from keystone_tpu.nodes.learning.linear_mapper import LinearMapEstimator
+
+    _, _, it = _stream()
+    sdir = tmp_path / "stream"
+    solve_least_squares_chunked(
+        it(), lam=0.1, checkpoint_dir=str(sdir), checkpoint_every=2
+    )
+    manifest = read_mesh_manifest(str(sdir))
+    assert manifest is not None and manifest["device_count"] == 8
+
+    odir = tmp_path / "online"
+    est = LinearMapEstimator(lam=1e-3)
+    rng = np.random.default_rng(5)
+    st = est.partial_fit(rng.normal(size=(32, D)).astype(np.float32),
+                         rng.normal(size=(32, K)).astype(np.float32))
+    st.save(str(odir))
+    manifest = read_mesh_manifest(str(odir))
+    assert manifest is not None and manifest["device_count"] == 8
+
+
+# ---------------------------------------------------------------------------
+# Satellite: bench_watch learns the fit_elastic family
+# ---------------------------------------------------------------------------
+
+
+def _elastic_row(value, bit_identical=True, resume_wall=0.5):
+    return {
+        "metric": "fit_elastic",
+        "value": value,
+        "unit": "x migration speedup (thrown-away-work restart wall / "
+                "elastic resume wall)",
+        "backend": "cpu",
+        "host_cores": 1,
+        "n_devices": 8,
+        "detail": {
+            "bit_identical": bit_identical,
+            "migrations": 2,
+            "resume_wall_s": resume_wall,
+            "restart_wall_s": 2.0,
+        },
+        "ok": True,
+    }
+
+
+def _bench_watch_run(tmp_path, rows):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_watch_under_elastic_test",
+        os.path.join(REPO, "tools", "bench_watch.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    with open(tmp_path / "BENCH_fit.json", "w") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+    return mod.run(str(tmp_path))
+
+
+def test_bench_watch_judges_fit_elastic(tmp_path):
+    # Healthy history, then the migration stops paying for itself AND
+    # stops being exact: speedup (value) collapses, resume wall blows
+    # up, bit_identical flips — all flagged.
+    rows = [
+        _elastic_row(4.0), _elastic_row(4.2), _elastic_row(3.9),
+        _elastic_row(0.6, bit_identical=False, resume_wall=3.5),
+    ]
+    result = _bench_watch_run(tmp_path, rows)
+    bad = {v["series"] for v in result["regressions"]}
+    assert "fit:fit_elastic:value" in bad
+    assert "fit:fit_elastic:detail.resume_wall_s" in bad
+    assert "fit:fit_elastic:detail.bit_identical" in bad
+    assert not result["ok"]
+
+
+def test_bench_watch_passes_healthy_fit_elastic(tmp_path):
+    rows = [_elastic_row(4.0), _elastic_row(4.2), _elastic_row(4.1)]
+    result = _bench_watch_run(tmp_path, rows)
+    assert result["ok"], result["regressions"]
+
+
+# ---------------------------------------------------------------------------
+# The chaos leg end-to-end (subprocesses at widths 8 → 4 and 8 → 16)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_elastic_quick_green():
+    """tools/chaos_elastic.py under the chaos fault plan: a width-8 fit
+    and a width-8 online stream killed mid-solve resume at widths 4 AND
+    16 to the uninterrupted target-width bits, migrations counted, and
+    the fit_elastic bench row emitted."""
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join("tools", "chaos_elastic.py"),
+         "--quick"],
+        cwd=REPO, capture_output=True, text=True, timeout=560,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "KEYSTONE_FAULTS": "io:0.05,oom:1",
+             "KEYSTONE_FAULTS_SEED": "0"},
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "fit_elastic" and row["ok"]
+    detail = row["detail"]
+    assert detail["bit_identical_shrink"] is True
+    assert detail["bit_identical_grow"] is True
+    assert detail["migrations"] >= 2
+    assert detail["fresh_migrations"] == 0  # zero silent migrations

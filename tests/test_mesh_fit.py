@@ -345,7 +345,10 @@ def test_fitted_forward_with_layout():
 # ---------------------------------------------------------------------------
 
 
-def test_stream_checkpoint_mesh_width_refusal_both_ways(tmp_path):
+def test_stream_checkpoint_mesh_width_refusal_both_ways(tmp_path, monkeypatch):
+    # The refuse-only contract under KEYSTONE_ELASTIC_MESH=0 — the
+    # default-on elastic migration path is pinned in test_elastic_mesh.py.
+    monkeypatch.setattr(config, "elastic_mesh", False)
     from keystone_tpu.linalg.normal_equations import (
         _STREAM_CKPT_KEY,
         _StreamCheckpointer,
@@ -413,7 +416,8 @@ def test_stream_checkpoint_mesh_width_refusal_both_ways(tmp_path):
     assert ck4.skip == 3  # legacy resume preserved
 
 
-def test_bcd_checkpoint_mesh_width_refusal_both_ways():
+def test_bcd_checkpoint_mesh_width_refusal_both_ways(monkeypatch):
+    monkeypatch.setattr(config, "elastic_mesh", False)
     from keystone_tpu.linalg.bcd import _refuse_bcd_mesh_mismatch
 
     fp = {
@@ -460,7 +464,8 @@ def test_bcd_legacy_fingerprint_still_matches():
     assert not _fingerprint_matches(mesh_fp_compat(narrow, fp), fp)
 
 
-def test_profile_store_device_count_refused_both_ways(tmp_path):
+def test_profile_store_device_count_refused_both_ways(tmp_path, monkeypatch):
+    monkeypatch.setattr(config, "elastic_mesh", False)
     from keystone_tpu.workflow.profile_store import (
         ProfileFingerprintError,
         load_profile,

@@ -272,10 +272,15 @@ def test_typed_refusals():
         st.fold(X[:4], Y[:4])
 
 
-def test_mesh_manifest_refusal_on_resume(tmp_path):
-    """A snapshot recorded under one mesh width refuses to resume under
+def test_mesh_manifest_refusal_on_resume(tmp_path, monkeypatch):
+    """With elastic migration pinned off (KEYSTONE_ELASTIC_MESH=0), a
+    snapshot recorded under one mesh width refuses to resume under
     another — the shared MeshMismatchError, never a wrong-answer
-    resume; a different-problem snapshot refuses typed too."""
+    resume; a different-problem snapshot refuses typed too. The
+    default-on migration path is pinned in test_elastic_mesh.py."""
+    from keystone_tpu.config import config
+
+    monkeypatch.setattr(config, "elastic_mesh", False)
     X, Y = _data(n=64)
     st = LinearMapEstimator().partial_fit(X, Y)
     st.save(str(tmp_path))

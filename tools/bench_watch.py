@@ -93,7 +93,10 @@ LOWER_BETTER = (
 )
 #: Leaf-name fragments that mark a higher-is-better series (rates,
 #: speedups, utilization). ``scaling`` covers the fit_multichip rows/s
-#: scaling value; ``rows_per`` its per-width throughput leaves.
+#: scaling value; ``rows_per`` its per-width throughput leaves;
+#: ``speedup`` also covers the fit_elastic migration-speedup value
+#: (elastic resume wall vs thrown-away-work restart wall — migration
+#: getting slower relative to a restart is a regression).
 #: ``accuracy``/``recovery`` cover the fit_online drift family: the
 #: post-refresh accuracy on the shifted stream (and how much of the
 #: drift loss the refresh won back) sliding down is a regression even
@@ -250,10 +253,12 @@ def load_series(
     # JSONL histories: one fingerprinted row per line, chronological.
     # BENCH_serve.json keeps one latest row per serving metric;
     # BENCH_fit.json accumulates every `make bench-fit` / `make bench-opt`
-    # / `make bench-multichip` run (fit_parallel_walk, fit_optimizer,
-    # and fit_multichip families: wall-like leaves up = regress,
-    # speedup/scaling/rows_per_s down = regress, silent-fallback counts
-    # up = regress, bit_identical true->false = regress).
+    # / `make bench-multichip` / `make chaos-elastic` run
+    # (fit_parallel_walk, fit_optimizer, fit_multichip, and fit_elastic
+    # families: wall-like leaves up = regress, speedup/scaling/rows_per_s
+    # down = regress — fit_elastic's value is the migration speedup,
+    # resume wall vs thrown-away-work restart wall — silent-fallback
+    # counts up = regress, bit_identical true->false = regress).
     for family, fname in (("serve", "BENCH_serve.json"),
                           ("fit", "BENCH_fit.json")):
         jsonl_path = os.path.join(root, fname)

@@ -1,0 +1,377 @@
+"""Chaos leg for the elastic mesh (ISSUE-18): kill fits mid-solve at
+width 8, resume at widths 4 AND 16, demand target-width bits.
+
+``make chaos`` proves the solvers survive injected faults at ONE mesh
+width. This leg proves the other half of the recovery story: the pod
+that comes back is rarely the pod that died. Three durable-state
+families are seeded at an 8-device mesh and interrupted mid-solve —
+
+- chunked stream solve (killed between checkpoints),
+- BCD epoch checkpoints (killed mid-epoch-2),
+- OnlineState snapshots (plain, decay, and window forgetting) —
+
+then resumed in fresh subprocesses pinned to 4 fake devices (shrink)
+and 16 (grow, wider than the seed pod — only reachable out-of-process).
+Each resume must migrate (counted in the ``elastic`` metrics family),
+and the final weights must be BIT-IDENTICAL to an uninterrupted fit at
+the target width: the canonical gram fold (``config.gram_fold_blocks``)
+makes the accumulator sums width-invariant, so this is an equality
+gate, not a tolerance check. Fresh fits must migrate NOTHING — zero
+silent migrations.
+
+The whole run executes under the chaos fault plan
+(``KEYSTONE_FAULTS=io:0.05,oom:1``) inherited from the environment, so
+migration machinery is exercised with I/O faults landing mid-restore.
+
+The result row APPENDS to ``--out`` (BENCH_fit.json) as the
+``fit_elastic`` family: value = thrown-away-work restart wall /
+elastic resume wall (HIGHER_BETTER speedup; bench_watch also regresses
+on any ``bit_identical_*`` flip).
+
+Usage:
+    python tools/chaos_elastic.py [--quick] [--out BENCH_fit.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SEED_WIDTH = 8
+TARGET_WIDTHS = (4, 16)  # shrink AND grow past the seed pod
+
+D, K = 12, 3
+
+
+class Kill(Exception):
+    """The injected mid-solve pod death."""
+
+
+def _sizes(quick: bool):
+    """(stream rows, stream chunks, bcd rows, bcd dim, online rows)."""
+    if quick:
+        return 72, 6, 68, 16, 64
+    return 288, 6, 260, 32, 256
+
+
+def _stream_data(n, chunks):
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, D)).astype(np.float32)
+    Y = rng.normal(size=(n, K)).astype(np.float32)
+    rows = n // chunks
+
+    def it():
+        for i in range(chunks):
+            yield X[i * rows:(i + 1) * rows], Y[i * rows:(i + 1) * rows]
+
+    return it
+
+
+def _bcd_data(n, d):
+    import numpy as np
+
+    rng = np.random.default_rng(1)
+    return (rng.normal(size=(n, d)).astype(np.float32),
+            rng.normal(size=(n, K)).astype(np.float32))
+
+
+def _online_splits(n):
+    import numpy as np
+
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(n, D)).astype(np.float32)
+    Y = rng.normal(size=(n, K)).astype(np.float32)
+    q = n // 4
+    return [(X[s:e], Y[s:e])
+            for s, e in [(0, q), (q, 2 * q), (2 * q, 3 * q), (3 * q, n)]]
+
+
+_ONLINE_MODES = (("plain", {}), ("decay", {"decay": 0.5}),
+                 ("window", {"window": 2}))
+
+
+# ---------------------------------------------------------------------------
+# Workers (separate processes: XLA fixes the fake-device count at init)
+# ---------------------------------------------------------------------------
+
+
+def _worker_seed(root: str, quick: bool) -> None:
+    """Width-8 pod: do partial work per family, checkpoint, 'die'."""
+    from keystone_tpu.linalg import solve_least_squares_chunked
+    import keystone_tpu.linalg.bcd as bcd_mod
+    from keystone_tpu.linalg.row_matrix import RowMatrix
+    from keystone_tpu.nodes.learning.linear_mapper import LinearMapEstimator
+
+    sn, sc, bn, bd, on = _sizes(quick)
+
+    # Stream: checkpoints land every 2 chunks; the kill strikes at
+    # chunk 4, so chunks 0-3 survive and 4+ are lost work.
+    it = _stream_data(sn, sc)
+
+    def killed():
+        for i, batch in enumerate(it()):
+            if i == 4:
+                raise Kill()
+            yield batch
+
+    try:
+        solve_least_squares_chunked(
+            killed(), lam=0.1,
+            checkpoint_dir=os.path.join(root, "stream"), checkpoint_every=2,
+        )
+    except Kill:
+        pass
+
+    # BCD: interrupt a real num_iters=2 run right after the epoch-1
+    # save — seeding with num_iters=1 instead would flip the auto
+    # cache_grams policy and the resumed bits could never match the
+    # uninterrupted reference.
+    Xh, Yh = _bcd_data(bn, bd)
+    real_save = bcd_mod._save_epoch
+
+    def killing_save(*a, **k):
+        real_save(*a, **k)
+        raise Kill()
+
+    bcd_mod._save_epoch = killing_save
+    try:
+        bcd_mod.block_coordinate_descent(
+            RowMatrix.from_array(Xh), RowMatrix.from_array(Yh),
+            block_size=8, num_iters=2, lam=1e-3,
+            checkpoint_dir=os.path.join(root, "bcd"),
+        )
+    except Kill:
+        pass
+    finally:
+        bcd_mod._save_epoch = real_save
+    bcd_mod.wait_for_checkpoints(os.path.join(root, "bcd"))
+
+    # Online: two of four batches folded, snapshot saved, per mode.
+    est = LinearMapEstimator(lam=1e-3)
+    splits = _online_splits(on)
+    for mode, kw in _ONLINE_MODES:
+        st = None
+        for bx, by in splits[:2]:
+            st = est.partial_fit(bx, by, state=st, **kw)
+        st.save(os.path.join(root, f"online_{mode}"))
+
+    print("CHAOS_ROW " + json.dumps({"seeded": True}), flush=True)
+
+
+def _worker_resume(root: str, quick: bool, width: int) -> None:
+    """Target-width pod: resume every family (timed), refit fresh
+    (timed), gate on bit-identity and on counted-vs-silent migrations."""
+    import numpy as np
+
+    from keystone_tpu.linalg import solve_least_squares_chunked
+    from keystone_tpu.linalg.bcd import (
+        assemble_blocks,
+        block_coordinate_descent,
+    )
+    from keystone_tpu.linalg.row_matrix import RowMatrix
+    from keystone_tpu.nodes.learning.linear_mapper import LinearMapEstimator
+    from keystone_tpu.utils.metrics import elastic_counters
+    from keystone_tpu.workflow.online import OnlineState
+
+    sn, sc, bn, bd, on = _sizes(quick)
+    it = _stream_data(sn, sc)
+    Xh, Yh = _bcd_data(bn, bd)
+    splits = _online_splits(on)
+    est = LinearMapEstimator(lam=1e-3)
+
+    resumed = {}
+    t0 = time.perf_counter()
+    resumed["stream"] = np.asarray(solve_least_squares_chunked(
+        it(), lam=0.1,
+        checkpoint_dir=os.path.join(root, "stream"), checkpoint_every=2,
+    ))
+    Wr, _ = block_coordinate_descent(
+        RowMatrix.from_array(Xh), RowMatrix.from_array(Yh),
+        block_size=8, num_iters=2, lam=1e-3,
+        checkpoint_dir=os.path.join(root, "bcd"),
+    )
+    resumed["bcd"] = np.asarray(assemble_blocks(Wr))
+    for mode, kw in _ONLINE_MODES:
+        st = OnlineState.load(os.path.join(root, f"online_{mode}"))
+        assert st is not None, f"online_{mode} snapshot failed to load"
+        for bx, by in splits[2:]:
+            st = est.partial_fit(bx, by, state=st, **kw)
+        m = est.solve_online(st)
+        resumed[f"online_{mode}"] = np.concatenate(
+            [np.asarray(m.W).ravel(), np.asarray(m.b).ravel()])
+    resume_wall = time.perf_counter() - t0
+    migrations = elastic_counters.get("states_migrated")
+
+    fresh = {}
+    t0 = time.perf_counter()
+    fresh["stream"] = np.asarray(solve_least_squares_chunked(it(), lam=0.1))
+    Wf, _ = block_coordinate_descent(
+        RowMatrix.from_array(Xh), RowMatrix.from_array(Yh),
+        block_size=8, num_iters=2, lam=1e-3,
+    )
+    fresh["bcd"] = np.asarray(assemble_blocks(Wf))
+    for mode, kw in _ONLINE_MODES:
+        st = None
+        for bx, by in splits:
+            st = est.partial_fit(bx, by, state=st, **kw)
+        m = est.solve_online(st)
+        fresh[f"online_{mode}"] = np.concatenate(
+            [np.asarray(m.W).ravel(), np.asarray(m.b).ravel()])
+    restart_wall = time.perf_counter() - t0
+    fresh_migrations = elastic_counters.get("states_migrated") - migrations
+
+    families = {
+        fam: bool(np.array_equal(resumed[fam], fresh[fam]))
+        for fam in fresh
+    }
+    print("CHAOS_ROW " + json.dumps({
+        "width": width,
+        "bit_identical": all(families.values()),
+        "families": families,
+        "migrations": migrations,
+        "fresh_migrations": fresh_migrations,
+        "resume_wall_s": round(resume_wall, 4),
+        "restart_wall_s": round(restart_wall, 4),
+    }), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Parent orchestration
+# ---------------------------------------------------------------------------
+
+
+def _spawn(role: str, width: int, root: str, quick: bool) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={width}"
+    # Workers run as a script (sys.path[0] = tools/); the package lives
+    # at the repo root.
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--worker", role, "--width", str(width), "--root", root]
+    if quick:
+        cmd.append("--quick")
+    proc = subprocess.run(
+        cmd, env=env, cwd=REPO, capture_output=True, text=True, timeout=480,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"{role}@{width} worker failed rc={proc.returncode}\n"
+            f"stdout:{proc.stdout[-1000:]}\nstderr:{proc.stderr[-2000:]}"
+        )
+    for line in proc.stdout.splitlines():
+        if line.startswith("CHAOS_ROW "):
+            return json.loads(line[len("CHAOS_ROW "):])
+    raise RuntimeError(
+        f"{role}@{width} worker printed no row\nstdout:{proc.stdout[-1000:]}"
+    )
+
+
+def run_chaos(quick: bool) -> dict:
+    work = tempfile.mkdtemp(prefix="chaos_elastic_")
+    try:
+        seed_root = os.path.join(work, "seed")
+        os.makedirs(seed_root)
+        _spawn("seed", SEED_WIDTH, seed_root, quick)
+        per_width = {}
+        for width in TARGET_WIDTHS:
+            # Each target resumes from its own COPY of the dead pod's
+            # checkpoints: a resumed run rewrites the directory at the
+            # new width, which must not contaminate the other target.
+            wroot = os.path.join(work, f"w{width}")
+            shutil.copytree(seed_root, wroot)
+            per_width[width] = _spawn("resume", width, wroot, quick)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    shrink, grow = per_width[TARGET_WIDTHS[0]], per_width[TARGET_WIDTHS[1]]
+    resume_wall = shrink["resume_wall_s"] + grow["resume_wall_s"]
+    restart_wall = shrink["restart_wall_s"] + grow["restart_wall_s"]
+    migrations = shrink["migrations"] + grow["migrations"]
+    fresh_migrations = (
+        shrink["fresh_migrations"] + grow["fresh_migrations"]
+    )
+    speedup = restart_wall / resume_wall if resume_wall > 0 else float("inf")
+
+    import jax
+
+    from keystone_tpu.utils.metrics import environment_fingerprint
+
+    sn, sc, bn, bd, on = _sizes(quick)
+    row = {
+        "metric": "fit_elastic",
+        "value": round(speedup, 3),
+        "unit": ("x migration speedup "
+                 "(thrown-away-work restart wall / elastic resume wall)"),
+        "backend": jax.default_backend(),
+        "host_cores": os.cpu_count() or 1,
+        "env": environment_fingerprint(),
+        "detail": {
+            "seed_width": SEED_WIDTH,
+            "target_widths": list(TARGET_WIDTHS),
+            "stream_rows": sn,
+            "bcd_rows": bn,
+            "online_rows": on,
+            "bit_identical_shrink": shrink["bit_identical"],
+            "bit_identical_grow": grow["bit_identical"],
+            "families_shrink": shrink["families"],
+            "families_grow": grow["families"],
+            "migrations": migrations,
+            "fresh_migrations": fresh_migrations,
+            "resume_wall_s": round(resume_wall, 4),
+            "restart_wall_s": round(restart_wall, 4),
+        },
+    }
+    # The speedup is informational on CPU (compile noise dominates the
+    # tiny chaos problems); the GATES are bit-identity both directions,
+    # every resume migrated, and zero silent migrations on fresh fits.
+    row["ok"] = bool(
+        shrink["bit_identical"] and grow["bit_identical"]
+        and migrations >= 2 and fresh_migrations == 0
+    )
+    return row
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Kill fits at width 8, resume at widths 4 and 16, "
+                    "gate on target-width bit-identity.")
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny problem sizes (harness validation)")
+    ap.add_argument("--out", default=None,
+                    help="append the result row to this JSONL file")
+    ap.add_argument("--worker", choices=["seed", "resume"], default=None,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--width", type=int, default=SEED_WIDTH,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--root", default=None, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.worker == "seed":
+        _worker_seed(args.root, args.quick)
+        return 0
+    if args.worker == "resume":
+        _worker_resume(args.root, args.quick, args.width)
+        return 0
+
+    row = run_chaos(args.quick)
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(json.dumps(row) + "\n")
+    print(json.dumps(row), flush=True)
+    return 0 if row["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
