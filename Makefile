@@ -1,6 +1,6 @@
 # Developer entry points. Pipelines launch via bin/run-pipeline.sh.
 
-.PHONY: test t1 chaos chaos-elastic native bench bench-serve bench-serve-overload bench-serve-replicas bench-serve-daemon bench-serve-precision bench-fit bench-opt bench-multichip bench-imagenet bench-online trace-demo obs-serve serve-daemon profile-demo bench-watch lint dryrun clean tpu-checkride sentinel northstar acceptance
+.PHONY: test t1 chaos chaos-elastic native bench bench-serve bench-serve-overload bench-serve-replicas bench-serve-daemon bench-serve-precision bench-fit bench-opt bench-multichip bench-imagenet bench-online trace-demo trace-report obs-serve serve-daemon profile-demo bench-watch lint dryrun clean tpu-checkride sentinel northstar acceptance
 
 # The canonical tier-1 verify (ROADMAP.md), verbatim at the defaults —
 # builders and CI invoke this one entry point instead of hand-copying the
@@ -127,6 +127,20 @@ bench-serve-precision:
 trace-demo:
 	KEYSTONE_TRACE=1 JAX_PLATFORMS=cpu python tools/trace_demo.py --out /tmp/keystone_trace.json
 	JAX_PLATFORMS=cpu python tools/trace_report.py /tmp/keystone_trace.json --top 12
+
+# Durable-telemetry smoke: run the live daemon smoke with journey export
+# on (KEYSTONE_TELEMETRY_DIR), then — after the daemon has exited —
+# reconstruct the full cross-process timeline and the per-tenant SLO
+# report from the on-disk segments ALONE. Tier-1 runs the same
+# reconstruction in-process (tests/test_trace_report.py).
+trace-report:
+	rm -rf /tmp/keystone_telemetry && mkdir -p /tmp/keystone_telemetry
+	KEYSTONE_TELEMETRY_DIR=/tmp/keystone_telemetry KEYSTONE_TRACE=1 \
+	  JAX_PLATFORMS=cpu python tools/serve_daemon.py --smoke
+	JAX_PLATFORMS=cpu python tools/trace_report.py \
+	  --telemetry /tmp/keystone_telemetry --out /tmp/keystone_journeys.json
+	JAX_PLATFORMS=cpu python tools/trace_report.py \
+	  --telemetry /tmp/keystone_telemetry --slo
 
 # Observability export smoke: stand up a live warmed PipelineService +
 # the stdlib metrics server, fetch /metrics and /healthz over a real

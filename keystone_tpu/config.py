@@ -471,6 +471,48 @@ class Config:
     flight_dir: str = field(
         default_factory=lambda: os.environ.get("KEYSTONE_FLIGHT_DIR", "")
     )
+    # Durable telemetry export (utils/telemetry.py TelemetryLog): where
+    # resolved request journeys + tail-retained span trees append as
+    # JSONL, written by a dedicated writer thread off the serving hot
+    # path. '' (default) = telemetry export off — the daemon keeps only
+    # its in-memory rings. Env: KEYSTONE_TELEMETRY_DIR.
+    telemetry_dir: str = field(
+        default_factory=lambda: os.environ.get("KEYSTONE_TELEMETRY_DIR", "")
+    )
+    # Telemetry segment rotation threshold (MB): when the active JSONL
+    # segment grows past this, the writer rotates to a new sequence-
+    # numbered segment file. Env: KEYSTONE_TELEMETRY_ROTATE_MB.
+    telemetry_rotate_mb: float = field(
+        default_factory=lambda: _env_float("KEYSTONE_TELEMETRY_ROTATE_MB",
+                                           64.0)
+    )
+    # Bounded telemetry retention: keep the newest N rotated segments per
+    # process, delete the rest (the keep_artifacts precedent — a steady
+    # flood must not fill the volume). Env: KEYSTONE_TELEMETRY_KEEP.
+    telemetry_keep: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_TELEMETRY_KEEP", 8)
+    )
+    # Telemetry writer-queue capacity: journeys enqueue to the writer
+    # thread through a bounded queue; a full queue DROPS the record and
+    # counts it (telemetry family, records_dropped) — export never
+    # blocks admission. Env: KEYSTONE_TELEMETRY_QUEUE.
+    telemetry_queue: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_TELEMETRY_QUEUE", 4096)
+    )
+    # Per-tenant SLO accounting (workflow/daemon.py): rolling-window
+    # length in seconds over which deadline-hit rate and error-budget
+    # burn are computed for /stats + /metrics. Env: KEYSTONE_SLO_WINDOW_S.
+    slo_window_s: float = field(
+        default_factory=lambda: _env_float("KEYSTONE_SLO_WINDOW_S", 300.0)
+    )
+    # SLO objective: the target fraction of in-deadline, non-error
+    # responses per tenant/tier. Error-budget burn is the ratio of the
+    # observed failure rate to the budget this objective leaves
+    # (burn > 1.0 = burning budget faster than sustainable).
+    # Env: KEYSTONE_SLO_TARGET.
+    slo_target: float = field(
+        default_factory=lambda: _env_float("KEYSTONE_SLO_TARGET", 0.99)
+    )
     # TCP port for tools/metrics_server.py (the /metrics + /healthz pull
     # surface). 0 = bind an ephemeral port (the smoke-test default; the
     # chosen port is printed/returned). Env: KEYSTONE_METRICS_PORT.
@@ -684,6 +726,18 @@ def resolved_prefetch_depth() -> int | None:
     if "KEYSTONE_PREFETCH_DEPTH" in os.environ:
         return _env_int("KEYSTONE_PREFETCH_DEPTH", 2)
     return None
+
+
+def resolved_telemetry_dir() -> str | None:
+    """The durable telemetry export directory: env presence (not
+    truthiness) takes precedence over ``config.telemetry_dir``, so an
+    exported empty KEYSTONE_TELEMETRY_DIR explicitly disables the
+    export (the ``resolved_cache_dir`` convention). Returns None when
+    telemetry export is off. Lives here so the env read stays inside
+    config.py (keystone-lint KL003)."""
+    if "KEYSTONE_TELEMETRY_DIR" in os.environ:
+        return os.environ["KEYSTONE_TELEMETRY_DIR"] or None
+    return config.telemetry_dir or None
 
 
 def resolved_profile_store() -> str | None:

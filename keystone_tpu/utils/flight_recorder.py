@@ -207,6 +207,7 @@ class FlightRecorder:
         self._dumps: deque = deque(maxlen=self.DUMP_HISTORY)
         self.dumps_total = 0
         self.records_started = 0
+        self.evictions = 0
 
     @property
     def dumps(self) -> List[str]:
@@ -232,8 +233,22 @@ class FlightRecorder:
         ``as_dict()``) in the ring — solver journeys (``SolveRecord``)
         ride the same ring/dump machinery as serving requests."""
         with self._lock:
+            # A full ring evicts its oldest journey on append: counted
+            # per recorder AND process-wide (telemetry family) so the
+            # loss is scrape-able — telemetry that silently loses data
+            # is worse than none. capacity 0 (the ring knowingly off)
+            # is not an eviction.
+            evicted = (
+                self.capacity > 0 and len(self._records) == self.capacity
+            )
+            if evicted:
+                self.evictions += 1
             self._records.append(rec)
             self.records_started += 1
+        if evicted:
+            from keystone_tpu.utils.metrics import telemetry_counters
+
+            telemetry_counters.bump("journeys_evicted")
 
     def error(self, kind: str, message: str,
               rid: Optional[int] = None) -> None:
@@ -343,6 +358,7 @@ class FlightRecorder:
             return {
                 "records_held": len(self._records),
                 "records_started": self.records_started,
+                "records_evicted": self.evictions,
                 "errors_held": len(self._errors),
                 "dumps": list(self._dumps),
                 "dumps_total": self.dumps_total,

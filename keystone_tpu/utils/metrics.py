@@ -364,6 +364,31 @@ def reset_tracer() -> None:
         _tracer_key = None
 
 
+class _TracerLoss:
+    """Registry adapter exporting the tracer's loss/occupancy numbers
+    on /metrics (``keystone_tracer_*`` gauges): a ring that silently
+    evicts spans is telemetry lying by omission, so ``Tracer.dropped``
+    must be a scrape-able number, not a private attribute. Reads the
+    CACHED tracer only — scraping /metrics never arms tracing."""
+
+    def snapshot(self) -> Dict[str, int]:
+        with _tracer_lock:
+            t = _tracer
+        if t is None:
+            return {"enabled": 0, "dropped": 0, "spans_held": 0,
+                    "retained_requests": 0}
+        with t._lock:
+            return {
+                "enabled": 1,
+                "dropped": t.dropped,
+                "spans_held": len(t._spans),
+                "retained_requests": len(t._retained),
+            }
+
+    def reset(self) -> None:
+        pass  # stateless view; the tracer itself owns reset
+
+
 def validate_chrome_trace(doc: Any) -> List[str]:
     """Schema check of a Chrome-trace document; returns the list of
     problems (empty = valid). Shared by ``tools/trace_report.py`` and the
@@ -635,6 +660,13 @@ class MetricsRegistry:
         if not isinstance(part, CounterSet):
             raise TypeError(f"metric {name!r} is a {type(part).__name__}")
         return part
+
+    def part(self, name: str, factory: Callable[[], Any]) -> Any:
+        """Get-or-create an arbitrary ``snapshot()``/``reset()`` part —
+        for adapter views (the daemon's SLO gauges) that need the same
+        get-or-create semantics histograms and counter sets enjoy: two
+        daemons reusing one name share the family instead of raising."""
+        return self._get_or_create(name, factory)
 
     def names(self) -> List[str]:
         with self._lock:
@@ -1869,3 +1901,34 @@ class ElasticCounters(CounterSet):
 
 elastic_counters = ElasticCounters()
 metrics_registry.register("elastic", elastic_counters)
+
+
+class TelemetryCounters(CounterSet):
+    """Process-wide telemetry-pipeline observability: every durable-
+    export decision (utils/telemetry.py TelemetryLog) and every loss
+    the in-memory rings take lands here — telemetry that silently
+    loses data is worse than none, so the losses themselves are
+    first-class counters riding ``/metrics`` like every registry
+    family. Thread-safe (CounterSet).
+
+    Well-known keys:
+
+    - ``records_enqueued`` — journeys/span-tree records accepted onto
+      the writer queue
+    - ``records_written`` — records the writer thread landed on disk
+    - ``records_dropped`` — records lost WITHOUT blocking: queue full,
+      log closed, or a write error (the never-blocks-admission
+      contract, measured)
+    - ``segments_rotated`` — size-triggered segment rotations
+    - ``segments_pruned`` — rotated segments deleted by bounded
+      retention (``KEYSTONE_TELEMETRY_KEEP``)
+    - ``journeys_evicted`` — FlightRecorder journey-ring evictions: a
+      resolved-but-unexported journey pushed out by ring capacity
+      (the flight-recorder half of the no-silent-loss satellite;
+      ``Tracer.dropped`` rides the ``tracer`` gauges)
+    """
+
+
+telemetry_counters = TelemetryCounters()
+metrics_registry.register("telemetry", telemetry_counters)
+metrics_registry.register("tracer", _TracerLoss())

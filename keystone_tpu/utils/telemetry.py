@@ -1,0 +1,516 @@
+"""Durable request telemetry: wire trace ids, the JSONL export
+pipeline, and per-tenant SLO accounting.
+
+The flight recorder (PR 8) and tracer (PR 4) answer "what happened"
+only while the process lives and only inside one process: journeys sit
+in bounded in-memory rings that vanish on churn, spans carry process-
+local request ids no client ever sees, and the two are unjoinable with
+the wire responses. This module is the correlation + durability layer
+the ROADMAP serving items stand on:
+
+- **Trace ids** — a client-visible correlation token accepted at
+  ingress (``X-Trace-Id`` header / ``trace_id`` frame field) or minted
+  at admission, echoed on EVERY response including rejections, threaded
+  through ``FlightRecord.meta`` and tracer span attrs, so one id
+  stitches a request across router → daemon → replica → offline logs.
+- :class:`TelemetryLog` — an append-only JSONL export of resolved
+  journeys plus span trees, written by a dedicated writer thread
+  (``_writer_loop``, a registered thread root — see
+  tools/keystone_lint.py KNOWN_THREAD_TARGETS) so the serving hot path
+  never does file I/O: producers enqueue through a BOUNDED queue and a
+  full queue drops the record and counts it
+  (``telemetry.records_dropped``) — export never blocks admission (the
+  off-lock checkpoint-writer discipline of ``OnlineTrainer.submit``).
+  Segments rotate by size and retention is bounded
+  (``KEYSTONE_TELEMETRY_KEEP``, the ``keep_artifacts`` precedent).
+  Default-off: ``KEYSTONE_TELEMETRY_DIR`` unset/empty means
+  :func:`active_telemetry` returns None and every call site pays one
+  None check (the ``active_tracer()`` discipline).
+- :class:`SloAccounting` — per-(tenant, tier) rolling-window
+  deadline-hit rate and error-budget burn, fed by the daemon's
+  ``finish_request`` and surfaced on ``/stats`` (tenant-redacted for
+  anonymous callers) and as per-tier gauges on ``/metrics``.
+
+Clock note: journey stamps and span endpoints are ``perf_counter_ns``
+— monotonic, per-process, meaningless across processes. Every segment
+therefore opens with a ``meta`` record carrying an anchor pair
+(``unix_time``, ``perf_ns`` captured together), which is what lets
+``tools/trace_report.py`` place multiple processes' journeys on one
+wall-clock timeline.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import re
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("keystone_tpu")
+
+#: Sentinel that tells the writer thread to drain and exit.
+_CLOSE = object()
+
+#: What an inbound trace id may look like. Anything else (too long,
+#: exotic bytes, header-injection attempts) is REPLACED with a freshly
+#: minted id rather than refused — correlation is best-effort, the
+#: request itself must not fail over a malformed optional header.
+TRACE_ID_RE = re.compile(r"^[A-Za-z0-9._:\-]{1,64}$")
+
+
+def mint_trace_id() -> str:
+    """A fresh 16-hex-char trace id (uuid4-derived: unique across
+    processes and hosts without coordination)."""
+    return uuid.uuid4().hex[:16]
+
+
+def accept_trace_id(raw: Optional[str]) -> str:
+    """The trace id a request enters the system with: the client's when
+    it is well-formed, a freshly minted one otherwise (absent, empty,
+    or malformed — malformed inputs must not propagate into logs and
+    response headers verbatim)."""
+    if raw and TRACE_ID_RE.match(raw):
+        return raw
+    return mint_trace_id()
+
+
+def _telemetry_counters():
+    from keystone_tpu.utils.metrics import telemetry_counters
+
+    return telemetry_counters
+
+
+class TelemetryLog:
+    """Append-only JSONL telemetry segments for ONE process, written by
+    a dedicated writer thread.
+
+    Record kinds (one JSON object per line, ``kind`` discriminates):
+
+    - ``meta`` — opens every segment: pid, service name, schema
+      version, and the wall/perf anchor pair that maps this process's
+      ``perf_counter_ns`` stamps onto wall time.
+    - ``journey`` — one resolved ``FlightRecord`` (``as_dict()``
+      payload under ``journey``) plus its trace id.
+    - ``spans`` — tracer span trees (ring + tail-retained store) in the
+      tracer's native ns schema; written at export points (daemon
+      close), not per request.
+
+    Thread-safety: ``journey``/``spans``/``emit`` are safe from any
+    thread and never block — a full queue drops and counts. The writer
+    thread owns the file handle exclusively.
+    """
+
+    #: Bumped when the line schema changes shape incompatibly.
+    SCHEMA = 1
+
+    def __init__(self, directory: str, name: str = "telemetry",
+                 rotate_mb: Optional[float] = None,
+                 keep: Optional[int] = None,
+                 queue_cap: Optional[int] = None):
+        from keystone_tpu.config import config
+
+        self.directory = directory
+        self.name = str(name)
+        self.pid = os.getpid()
+        self._rotate_bytes = int(
+            (config.telemetry_rotate_mb if rotate_mb is None
+             else float(rotate_mb)) * 1e6
+        )
+        self._keep = max(1, int(
+            config.telemetry_keep if keep is None else keep
+        ))
+        cap = int(config.telemetry_queue if queue_cap is None else queue_cap)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, cap))
+        # The anchor pair: captured back-to-back so the wall/perf skew
+        # is one function call's worth. This is the ONE place telemetry
+        # reads the wall clock — every stamp stays monotonic.
+        # lint: ok(KL005) durable telemetry needs a wall anchor to merge processes offline
+        self._anchor_unix = time.time()
+        self._anchor_perf_ns = time.perf_counter_ns()
+        self._lock = threading.Lock()  # guards counters + closed flag
+        self._closed = False
+        self.enqueued = 0
+        self.dropped = 0
+        self.written = 0
+        self.rotations = 0
+        self._seq = 0
+        self._path: Optional[str] = None
+        os.makedirs(directory, exist_ok=True)
+        self._thread = threading.Thread(
+            target=self._writer_loop,
+            name=f"keystone-telemetry-{self.name}", daemon=True,
+        )
+        self._thread.start()
+
+    # -- producer side (hot path adjacent: never blocks) -------------------
+
+    def emit(self, record: Dict[str, Any]) -> bool:
+        """Enqueue one raw record for the writer. Returns False (and
+        counts the drop) when the queue is full or the log is closed —
+        NEVER blocks, never raises into the request path."""
+        with self._lock:
+            if self._closed:
+                self.dropped += 1
+                _telemetry_counters().bump("records_dropped")
+                return False
+        try:
+            self._queue.put_nowait(record)
+        except queue.Full:
+            with self._lock:
+                self.dropped += 1
+            _telemetry_counters().bump("records_dropped")
+            return False
+        with self._lock:
+            self.enqueued += 1
+        _telemetry_counters().bump("records_enqueued")
+        return True
+
+    def journey(self, service: str, rec: Any,
+                trace_id: Optional[str] = None) -> bool:
+        """Export one resolved journey record (anything with
+        ``as_dict()``). The trace id defaults to the record's own
+        ``meta.trace_id`` note."""
+        doc = rec.as_dict()
+        if trace_id is None:
+            trace_id = (doc.get("meta") or {}).get("trace_id")
+        return self.emit({
+            "kind": "journey",
+            "service": service,
+            "pid": self.pid,
+            "trace_id": trace_id,
+            "journey": doc,
+        })
+
+    def spans(self, tracer: Any, only_traced: bool = True) -> bool:
+        """Export the tracer's current ring + tail-retained span trees
+        (native ns schema; the segment meta's anchor maps them to wall
+        time). ``only_traced`` keeps just spans that carry request
+        correlation (``trace_id``/``req_id``/``req_ids`` attrs) so an
+        export at daemon close doesn't ship unrelated solver spans."""
+
+        def keep(s: Dict[str, Any]) -> bool:
+            if not only_traced:
+                return True
+            args = s.get("args") or {}
+            return ("trace_id" in args or "req_id" in args
+                    or "req_ids" in args)
+
+        events = [s for s in tracer.spans() if keep(s)]
+        seen = {(s["name"], s["start_ns"]) for s in events}
+        for spans in tracer.retained().values():
+            events.extend(
+                s for s in spans
+                if keep(s) and (s["name"], s["start_ns"]) not in seen
+            )
+        if not events:
+            return False
+        return self.emit({
+            "kind": "spans",
+            "pid": self.pid,
+            "events": events,
+        })
+
+    # -- the writer thread -------------------------------------------------
+
+    def _meta_record(self) -> Dict[str, Any]:
+        return {
+            "kind": "meta",
+            "schema": self.SCHEMA,
+            "service": self.name,
+            "pid": self.pid,
+            "anchor": {
+                "unix_time": self._anchor_unix,
+                "perf_ns": self._anchor_perf_ns,
+            },
+            "segment": self._seq,
+        }
+
+    def _segment_path(self, seq: int) -> str:
+        return os.path.join(
+            self.directory,
+            f"keystone_telemetry_{self.name}_{self.pid}_{seq:06d}.jsonl",
+        )
+
+    def _open_segment(self, f) -> Tuple[Any, int]:
+        """Close ``f`` (if any), open the next segment, write its meta
+        line, prune retention. Returns (handle, bytes_written)."""
+        if f is not None:
+            f.close()
+            self.rotations += 1
+            _telemetry_counters().bump("segments_rotated")
+        self._seq += 1
+        self._path = self._segment_path(self._seq)
+        f = open(self._path, "w")
+        line = json.dumps(self._meta_record()) + "\n"
+        f.write(line)
+        self._prune_segments()
+        return f, len(line)
+
+    def _prune_segments(self) -> None:
+        """Bounded retention (the ``keep_artifacts`` precedent): keep
+        the newest ``keep`` segments THIS process wrote, delete the
+        rest. Best-effort — retention failing must not kill the
+        writer."""
+        floor = self._seq - self._keep + 1
+        if floor <= 1:
+            return
+        import glob
+
+        prefix = f"keystone_telemetry_{self.name}_{self.pid}_"
+        pattern = os.path.join(self.directory, prefix + "[0-9]*.jsonl")
+        for old in glob.glob(pattern):
+            stem = os.path.basename(old)[len(prefix):-len(".jsonl")]
+            try:
+                seq = int(stem)
+            except ValueError:
+                continue  # not ours
+            if seq < floor:
+                try:
+                    os.unlink(old)
+                    _telemetry_counters().bump("segments_pruned")
+                except OSError:
+                    pass  # retention is best-effort
+
+    def _writer_loop(self) -> None:
+        """The dedicated writer (registered thread root — see
+        tools/keystone_lint.py KNOWN_THREAD_TARGETS): drains the
+        bounded queue to the current JSONL segment, rotating by size.
+        A write error drops the record (counted) and keeps draining —
+        a full disk must degrade telemetry, never the queue's
+        producers."""
+        f = None
+        size = 0
+        try:
+            f, size = self._open_segment(None)
+        except OSError as e:
+            logger.warning("telemetry %s: cannot open segment: %s",
+                           self.name, e)
+        while True:
+            rec = self._queue.get()
+            if rec is _CLOSE:
+                break
+            try:
+                if f is None:
+                    f, size = self._open_segment(None)
+                line = json.dumps(rec) + "\n"
+                f.write(line)
+                f.flush()
+                size += len(line)
+                with self._lock:
+                    self.written += 1
+                _telemetry_counters().bump("records_written")
+                if size >= self._rotate_bytes:
+                    f, size = self._open_segment(f)
+            except (OSError, TypeError, ValueError) as e:
+                with self._lock:
+                    self.dropped += 1
+                _telemetry_counters().bump("records_dropped")
+                logger.warning(
+                    "telemetry %s: record dropped on write error: %s",
+                    self.name, e,
+                )
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+
+    # -- lifecycle / introspection -----------------------------------------
+
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Wait (bounded) until everything enqueued so far is on disk —
+        the daemon-close epilogue, and what tests poll instead of
+        sleeping. True = drained; False = the writer is behind (or
+        wedged) past the timeout. Never raises."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        while True:
+            with self._lock:
+                settled = self.written + self.dropped >= self.enqueued
+            if settled and self._queue.empty():
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.01)
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop accepting records, drain the queue, join the writer.
+        Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        # The close sentinel BLOCKS if the queue is full: the producers
+        # are already refused above, so the writer drains it promptly.
+        self._queue.put(_CLOSE)
+        self._thread.join(timeout=timeout)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "directory": self.directory,
+                "segment": self._path,
+                "enqueued": self.enqueued,
+                "written": self.written,
+                "dropped": self.dropped,
+                "rotations": self.rotations,
+                "closed": self._closed,
+            }
+
+
+_telemetry_lock = threading.Lock()
+_telemetry: Optional[TelemetryLog] = None
+_telemetry_key: Optional[tuple] = None
+
+
+def active_telemetry() -> Optional[TelemetryLog]:
+    """The process-wide TelemetryLog, or None when export is off
+    (``KEYSTONE_TELEMETRY_DIR`` unset/empty). Resolved ONCE per
+    daemon/service — the ``active_tracer()`` discipline — and rebuilt
+    when the directory knob changes, so tests flip the knob without a
+    reload."""
+    global _telemetry, _telemetry_key
+    from keystone_tpu.config import resolved_telemetry_dir
+
+    directory = resolved_telemetry_dir()
+    if not directory:
+        return None
+    key = (directory,)
+    with _telemetry_lock:
+        if key != _telemetry_key or _telemetry is None:
+            if _telemetry is not None:
+                _telemetry.close()
+            _telemetry = TelemetryLog(directory)
+            _telemetry_key = key
+        return _telemetry
+
+
+def reset_telemetry() -> None:
+    """Close and drop the cached log (a fresh one on next resolve)."""
+    global _telemetry, _telemetry_key
+    with _telemetry_lock:
+        if _telemetry is not None:
+            _telemetry.close()
+        _telemetry = None
+        _telemetry_key = None
+
+
+# ---------------------------------------------------------------------------
+# Per-tenant SLO accounting
+# ---------------------------------------------------------------------------
+
+
+#: HTTP statuses that consume error budget: server-side failures. The
+#: client's own errors (400/403) and admission fast-fails (429 — the
+#: daemon REFUSED work, it did not fail it) are excluded from the SLO
+#: denominator; a deadline miss (504) and a dropped connection do burn.
+SLO_BAD_STATUSES = frozenset((500, 503, 504))
+SLO_EXCLUDED_STATUSES = frozenset((400, 403, 429))
+
+
+class SloAccounting:
+    """Rolling-window deadline-hit rate and error-budget burn per
+    (tenant, tier).
+
+    ``observe()`` is one lock + deque append on the response path;
+    windows prune lazily. Memory is bounded twice over: per-key deques
+    cap at ``MAX_EVENTS`` (a flood hotter than the window can hold
+    degrades to the newest events — hit rates stay correct over what is
+    retained), and the key space is the admission table's tenant×tier.
+
+    Burn rate is the SRE error-budget reading: ``miss_rate / (1 -
+    target)``. 1.0 = failing at exactly the sustainable rate; 10 =
+    burning a month of budget in ~3 days."""
+
+    MAX_EVENTS = 65536
+
+    def __init__(self, window_s: Optional[float] = None,
+                 target: Optional[float] = None):
+        from keystone_tpu.config import config
+
+        self.window_s = float(
+            config.slo_window_s if window_s is None else window_s
+        )
+        self.target = float(
+            config.slo_target if target is None else target
+        )
+        self._lock = threading.Lock()
+        # (tenant, tier) -> deque[(t_monotonic, good: bool)]
+        self._events: Dict[Tuple[str, str], deque] = {}
+
+    def observe(self, tenant: str, tier: str, status: int) -> None:
+        """Record one resolved response. Excluded statuses (client
+        errors, admission refusals) don't enter the window."""
+        if status in SLO_EXCLUDED_STATUSES:
+            return
+        good = status not in SLO_BAD_STATUSES
+        now = time.monotonic()
+        with self._lock:
+            dq = self._events.get((tenant, tier))
+            if dq is None:
+                dq = self._events[(tenant, tier)] = deque(
+                    maxlen=self.MAX_EVENTS
+                )
+            dq.append((now, good))
+
+    def _prune_locked(self, dq: deque, now: float) -> None:
+        horizon = now - self.window_s
+        while dq and dq[0][0] < horizon:
+            dq.popleft()
+
+    def snapshot(self, redact_tenants: bool = False) -> Dict[str, Any]:
+        """The live SLO surface: per tenant/tier window totals, hit
+        rate, and burn. With ``redact_tenants`` the per-tenant keys
+        collapse to per-tier aggregates (the /stats anonymous-caller
+        rule — tier names are not secrets, tenant names are)."""
+        now = time.monotonic()
+        with self._lock:
+            items = [
+                (key, list(dq)) for key, dq in self._events.items()
+                if (self._prune_locked(dq, now) or dq)
+            ]
+        agg: Dict[Tuple[str, str], List[int]] = {}
+        for (tenant, tier), events in items:
+            key = ("*", tier) if redact_tenants else (tenant, tier)
+            tot = agg.setdefault(key, [0, 0])
+            for _, good in events:
+                tot[0] += 1
+                tot[1] += int(good)
+        out: Dict[str, Any] = {
+            "window_s": self.window_s,
+            "target": self.target,
+            "tenants": {},
+        }
+        budget = max(1e-9, 1.0 - self.target)
+        for (tenant, tier), (total, good) in sorted(agg.items()):
+            hit = good / total if total else None
+            entry = {
+                "total": total,
+                "good": good,
+                "hit_rate": round(hit, 6) if hit is not None else None,
+                "burn": (
+                    round((1.0 - hit) / budget, 4)
+                    if hit is not None else None
+                ),
+            }
+            out["tenants"].setdefault(tenant, {})[tier] = entry
+        return out
+
+    def tier_rates(self) -> Dict[str, Dict[str, float]]:
+        """Per-tier aggregate hit-rate/burn — the tenant-free numbers
+        the daemon exports as /metrics gauges."""
+        snap = self.snapshot(redact_tenants=True)
+        out: Dict[str, Dict[str, float]] = {}
+        for tiers in snap["tenants"].values():
+            for tier, entry in tiers.items():
+                if entry["hit_rate"] is not None:
+                    out[tier] = {
+                        "hit_rate": entry["hit_rate"],
+                        "burn": entry["burn"],
+                    }
+        return out

@@ -100,7 +100,13 @@ from keystone_tpu.utils.flight_recorder import (
     derive_health,
     next_request_id,
 )
-from keystone_tpu.utils.metrics import metrics_registry
+from keystone_tpu.utils.metrics import active_tracer, metrics_registry
+from keystone_tpu.utils.telemetry import (
+    TRACE_ID_RE,
+    SloAccounting,
+    accept_trace_id,
+    active_telemetry,
+)
 from keystone_tpu.utils.reliability import (
     AuthError,
     DeadlineExceeded,
@@ -372,6 +378,33 @@ def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
     return b"".join(chunks)
 
 
+def trace_of(rec: FlightRecord) -> Optional[str]:
+    """The journey's wire-propagated trace id (``open_record`` notes one
+    on every record, so this is only None for records opened outside the
+    daemon's ingress paths)."""
+    meta = rec.meta
+    return meta.get("trace_id") if meta else None
+
+
+class _SloGauges:
+    """Registry adapter putting per-TIER SLO hit-rate / error-budget
+    burn on ``/metrics`` (``keystone_daemon_slo_<tier>{key=...}``
+    gauges). Tenant names stay OFF the open scrape surface by design —
+    per-tenant detail lives on ``/stats``, where anonymous callers get
+    it redacted. Points at the newest same-named daemon's accounting
+    (the shared-histogram convention when tests reuse a name)."""
+
+    def __init__(self) -> None:
+        self.source: Optional["SloAccounting"] = None
+
+    def snapshot(self) -> Dict[str, Any]:
+        src = self.source
+        return src.tier_rates() if src is not None else {}
+
+    def reset(self) -> None:
+        pass  # a view: the accounting's rolling window forgets on its own
+
+
 class _IngressHandler(BaseHTTPRequestHandler):
     """HTTP/JSON ingress routes. Data plane: ``POST /predict``.
     Control plane: ``POST /swap``, ``GET /healthz|/metrics|/stats``
@@ -398,6 +431,11 @@ class _IngressHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             if "generation" in doc:
                 self.send_header("X-Generation", str(doc["generation"]))
+            if doc.get("trace_id"):
+                # Every response — 2xx and rejections alike — echoes the
+                # request's trace id so a client can stitch its retries
+                # to the daemon-side journey.
+                self.send_header("X-Trace-Id", str(doc["trace_id"]))
             self.end_headers()
             self.wfile.write(body)
             return True
@@ -501,7 +539,10 @@ class _IngressHandler(BaseHTTPRequestHandler):
 
     def _predict(self) -> None:
         owner = self.owner
-        rec = owner.open_record()
+        # Wire-propagated trace context: honour a well-formed client
+        # X-Trace-Id, mint one otherwise (malformed ids never propagate
+        # verbatim into journeys or response headers).
+        rec = owner.open_record(trace_hdr=self.headers.get("X-Trace-Id"))
         # Pre-admission on the HEADER key (and in open mode) BEFORE the
         # body is read: a rejected multi-MB request must not cost the
         # daemon its socket read + JSON parse — that read would be an
@@ -537,7 +578,8 @@ class _IngressHandler(BaseHTTPRequestHandler):
         if payload is None or "x" not in payload:
             doc = {"error": "bad_request",
                    "message": "expected a JSON object body with an 'x' "
-                              "array", "request_id": rec.rid}
+                              "array", "request_id": rec.rid,
+                   "trace_id": trace_of(rec)}
             if body is None:
                 # over-bound/unread body: same RST risk
                 self._drain_body(deadline=body_deadline)
@@ -561,7 +603,8 @@ class _IngressHandler(BaseHTTPRequestHandler):
                 doc = {"error": "bad_request",
                        "message": f"X-Deadline-Ms must be a number, got "
                                   f"{hdr_deadline!r}",
-                       "request_id": rec.rid}
+                       "request_id": rec.rid,
+                       "trace_id": trace_of(rec)}
                 wrote = self._write_json(400, doc)
                 owner.finish_request(
                     rec, "bad_request" if wrote else "conn_drop", tenant, 400
@@ -758,6 +801,17 @@ class ServingDaemon:
         self._flight = FlightRecorder(
             f"daemon-{self.name}", directory=flight_dir, context=self.stats
         )
+        # Per-tenant/tier SLO accounting, exported per-TIER on /metrics
+        # via the shared adapter (tenant names never reach the open
+        # scrape surface) and in full on /stats. The durable telemetry
+        # export resolves to None unless KEYSTONE_TELEMETRY_DIR is set —
+        # default off, and journeys ride its bounded queue so admission
+        # never blocks on disk.
+        self._slo = SloAccounting()
+        self._telemetry = active_telemetry()
+        metrics_registry.part(
+            f"daemon.slo[{self.name}]", _SloGauges
+        ).source = self._slo
         self._lock = threading.Lock()
         self._active: set = set()
         self._draining = False
@@ -936,7 +990,7 @@ class ServingDaemon:
                     sent = self._send_frame(conn, {
                         "status": 400, "error": "bad_request",
                         "message": f"frame length {length} out of bounds",
-                        "request_id": rec.rid,
+                        "request_id": rec.rid, "trace_id": trace_of(rec),
                     })
                     self.finish_request(
                         rec, "bad_request" if sent else "conn_drop",
@@ -957,6 +1011,7 @@ class ServingDaemon:
                     sent = self._send_frame(conn, {
                         "status": 400, "error": "bad_request",
                         "message": str(e)[:200], "request_id": rec.rid,
+                        "trace_id": trace_of(rec),
                     })
                     self.finish_request(
                         rec, "bad_request" if sent else "conn_drop",
@@ -964,6 +1019,13 @@ class ServingDaemon:
                     )
                     continue
                 rec.stamp("parsed")
+                # The framed wire carries its trace id IN the payload
+                # (no headers to ride): a well-formed client id replaces
+                # the placeholder minted at the frame header; garbage
+                # keeps the minted one — same contract as HTTP.
+                raw_tid = payload.get("trace_id")
+                if isinstance(raw_tid, str) and TRACE_ID_RE.match(raw_tid):
+                    rec.note(trace_id=raw_tid)
                 status, doc, tenant, outcome = self.serve_request(
                     rec, payload.get("key"), payload["x"],
                     payload.get("deadline_ms"),
@@ -994,12 +1056,17 @@ class ServingDaemon:
 
     # -- the shared data-plane core -----------------------------------------
 
-    def open_record(self) -> FlightRecord:
+    def open_record(self, trace_hdr: Optional[str] = None) -> FlightRecord:
         """Open one network-leg journey at connection-accept time, before
-        parsing — even an unparseable request leaves a record."""
+        parsing — even an unparseable request leaves a record. A
+        well-formed caller-supplied trace id is adopted; anything else
+        (including nothing) gets a freshly minted one, so EVERY journey
+        — conn_drops included — carries a trace id from its first
+        stamp."""
         rec = self._flight.start(
             next_request_id(), 0, first_phase="accepted"
         )
+        rec.note(trace_id=accept_trace_id(trace_hdr))
         with self._lock:
             self._active.add(rec.rid)
             self._inflight_gauge.set(len(self._active))
@@ -1023,7 +1090,7 @@ class ServingDaemon:
         def rej(status: int, kind: str, message: str):
             return None, (status, {
                 "error": kind, "message": str(message)[:500],
-                "request_id": rid,
+                "request_id": rid, "trace_id": trace_of(rec),
             }, STATUS_OUTCOMES.get(status, "error"))
 
         try:
@@ -1068,6 +1135,7 @@ class ServingDaemon:
             return status, {
                 "error": kind, "message": message[:500], "request_id": rid,
                 "tenant": tenant.name, "tier": tenant.tier,
+                "trace_id": trace_of(rec),
             }, tenant, STATUS_OUTCOMES.get(status, "error")
 
         # Everything after admission runs inside ONE boundary: any
@@ -1133,7 +1201,11 @@ class ServingDaemon:
             if closed:
                 return terr(503, "closed", "daemon is closed")
             try:
-                fut = g.service.submit(x, deadline_ms=remaining_ms)
+                # The trace id crosses the daemon/service boundary here:
+                # the service notes it on its own journey and stamps it
+                # onto every tracer span for this request.
+                fut = g.service.submit(x, deadline_ms=remaining_ms,
+                                       trace_id=trace_of(rec))
             except QueueFullError as e:
                 return terr(429, "queue_full", str(e))
             except DeadlineExceeded as e:
@@ -1173,6 +1245,7 @@ class ServingDaemon:
                 "request_id": rid,
                 "tenant": tenant.name,
                 "tier": tenant.tier,
+                "trace_id": trace_of(rec),
             }
             return 200, doc, tenant, "ok"
         return terr(
@@ -1185,12 +1258,27 @@ class ServingDaemon:
                        tenant: Optional[Tenant], status: Optional[int] = None
                        ) -> None:
         """Close one journey exactly once per request: outcome + status
-        onto the record, outcome counter, tier latency (ok only),
-        admission slot release, and the unlocked flight-recorder poll."""
+        onto the record, outcome counter, SLO accounting, the durable
+        telemetry journey (bounded queue — drops counted, NEVER blocks),
+        tier latency (ok only), admission slot release, and the unlocked
+        flight-recorder poll."""
         if status is not None:
             rec.note(status=status)
         rec.finish(outcome)
         self._outcomes.bump(outcome)
+        # SLO accounting needs a status to classify; a status-less
+        # conn_drop (client vanished mid-frame, nothing served) has no
+        # verdict to record. Client-caused statuses are excluded inside
+        # observe().
+        if status is not None:
+            self._slo.observe(
+                tenant.name if tenant is not None else "anonymous",
+                tenant.tier if tenant is not None else "best_effort",
+                int(status),
+            )
+        tel = self._telemetry
+        if tel is not None:
+            tel.journey(f"daemon-{self.name}", rec)
         if tenant is not None:
             self._admission.release()
             if outcome == "ok":
@@ -1207,12 +1295,15 @@ class ServingDaemon:
 
     def request_swap(self, artifact_path: str, wait: bool = True,
                      timeout_s: Optional[float] = None,
-                     expect_fingerprint: Optional[str] = None):
+                     expect_fingerprint: Optional[str] = None,
+                     trace_id: Optional[str] = None):
         """Queue a hot swap to the artifact at ``artifact_path``.
         ``wait=True`` (default) blocks for the result — the new
         generation number — re-raising the swap's failure;
         ``wait=False`` returns the Future. Swaps serialize on the swap
-        worker thread: one at a time, in request order."""
+        worker thread: one at a time, in request order. ``trace_id``
+        correlates this swap with whatever initiated it (the online
+        trainer mints one per refresh) in spans and telemetry."""
         fut: Future = Future()
         with self._lock:
             # Check AND enqueue under the one lock close() takes: a put
@@ -1221,7 +1312,9 @@ class ServingDaemon:
             # queue — so holding the lock here is safe).
             if self._closed:
                 raise ServiceClosed("daemon is closed")
-            self._swap_q.put((str(artifact_path), expect_fingerprint, fut))
+            self._swap_q.put(
+                (str(artifact_path), expect_fingerprint, trace_id, fut)
+            )
         if not wait:
             return fut
         if timeout_s is None:
@@ -1235,14 +1328,16 @@ class ServingDaemon:
             item = self._swap_q.get()
             if item is None:
                 return
-            path, expect_fp, fut = item
+            path, expect_fp, trace_id, fut = item
             try:
-                fut.set_result(self._do_swap(path, expect_fp))
+                fut.set_result(self._do_swap(path, expect_fp, trace_id))
             except BaseException as e:  # lint: broad-ok any swap failure becomes the requester's exception; the swap worker must survive
                 fut.set_exception(e)
 
     def _do_swap(self, path: str,
-                 expect_fingerprint: Optional[str] = None) -> int:
+                 expect_fingerprint: Optional[str] = None,
+                 trace_id: Optional[str] = None) -> int:
+        t0 = time.perf_counter_ns()
         with self._lock:
             # Captured UNDER the lock: promote_ab() flips self._gen
             # outside the serialized swap worker, so an unlocked read
@@ -1302,6 +1397,31 @@ class ServingDaemon:
             # serve_request's ServiceClosed retry.
             old.service.close(drain=True,
                               join_s=config.swap_drain_ms / 1e3)
+            # Swap observability: one span (trace-correlated when the
+            # refresh that triggered it minted a trace id) plus a
+            # durable record, so the offline timeline shows WHEN the
+            # model changed between the request journeys around it.
+            tracer = active_tracer()
+            if tracer is not None:
+                tracer.record(
+                    "daemon.swap", "serving", t0,
+                    trace_id=trace_id, from_generation=old.number,
+                    generation=number,
+                )
+            tel = self._telemetry
+            if tel is not None:
+                tel.emit({
+                    "kind": "swap",
+                    "service": f"daemon-{self.name}",
+                    "pid": tel.pid,
+                    "trace_id": trace_id,
+                    "from_generation": old.number,
+                    "generation": number,
+                    "artifact": os.path.basename(path),
+                    "fingerprint": art.fingerprint,
+                    "start_ns": t0,
+                    "end_ns": time.perf_counter_ns(),
+                })
             logger.info(
                 "daemon %s: hot-swapped generation %d -> %d "
                 "(artifact %s, %d replica(s) handed over incrementally)",
@@ -1549,6 +1669,20 @@ class ServingDaemon:
             "tier_deadline_ms": dict(self._tier_deadline_ms),
             "admission": admission,
             "outcomes": self._outcomes.snapshot(),
+            # Per-tier e2e latency percentiles (the /metrics histograms,
+            # surfaced next to the SLO block they explain).
+            "latency": {
+                tier: hist.snapshot()
+                for tier, hist in self._tier_hist.items()
+            },
+            # Per-tenant/tier deadline-hit rate + error-budget burn over
+            # the rolling window; anonymous callers get tenant names
+            # collapsed (same redaction contract as the admission table).
+            "slo": self._slo.snapshot(redact_tenants=redact_tenants),
+            "telemetry": (
+                self._telemetry.stats()
+                if self._telemetry is not None else None
+            ),
             "flight": self._flight.stats(),
             "service": g.service.stats(),
         }
@@ -1590,7 +1724,7 @@ class ServingDaemon:
                 break
             if item is None:
                 continue
-            _path, _fp, fut = item
+            fut = item[-1]
             try:
                 fut.set_exception(
                     ServiceClosed("daemon closed; swap abandoned")
@@ -1610,6 +1744,19 @@ class ServingDaemon:
         if ab is not None:
             ab.service.close(drain=True)
         self._gen.service.close(drain=True)
+        # Durable telemetry epilogue: the span trees for traced requests
+        # are exported ONCE here (per-request journey records already
+        # streamed live), then the queue is drained so the offline view
+        # reconstructs the full timeline from KEYSTONE_TELEMETRY_DIR
+        # alone after this process exits. The process-wide log itself
+        # stays open — other components (another daemon, the trainer)
+        # may still be writing.
+        tel = self._telemetry
+        if tel is not None:
+            tracer = active_tracer()
+            if tracer is not None:
+                tel.spans(tracer)
+            tel.drain(timeout=self.CLOSE_JOIN_S)
 
     def __enter__(self) -> "ServingDaemon":
         return self

@@ -64,11 +64,13 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
 from keystone_tpu.utils.mesh import register_reshard_adapter
+from keystone_tpu.utils.telemetry import active_telemetry, mint_trace_id
 
 logger = logging.getLogger("keystone_tpu")
 
@@ -944,6 +946,12 @@ class OnlineTrainer:
     def _refresh_inner(self):
         from keystone_tpu.workflow.serialization import save_artifact
 
+        # One trace id per refresh, minted HERE (no wire to accept one
+        # from): it rides the daemon's swap span + telemetry record, so
+        # the offline timeline links "model changed" back to the refresh
+        # that caused it.
+        refresh_trace = mint_trace_id()
+        t0 = time.perf_counter_ns()
         if self._plan is not None:
             # The chaos seam: a refresh killed here leaves the daemon
             # serving its current generation and the accumulators (plus
@@ -974,7 +982,7 @@ class OnlineTrainer:
                 raise ValueError(
                     "hot-swapping into a daemon needs artifact_dir"
                 )
-            self._daemon.request_swap(path)
+            self._daemon.request_swap(path, trace_id=refresh_trace)
         with self._lock:
             self._fitted = fitted
             self._last_artifact = path
@@ -985,6 +993,19 @@ class OnlineTrainer:
                 0, self._folds_since_refresh - pending
             )
         _online_counters().bump("refreshes_pushed")
+        tel = active_telemetry()
+        if tel is not None:
+            tel.emit({
+                "kind": "refresh",
+                "service": self.name,
+                "pid": tel.pid,
+                "trace_id": refresh_trace,
+                "seq": seq,
+                "artifact": path,
+                "folds_applied": pending,
+                "start_ns": t0,
+                "end_ns": time.perf_counter_ns(),
+            })
         if path is not None:
             self._prune_artifacts(seq)
         return fitted

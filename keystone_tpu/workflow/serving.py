@@ -1264,6 +1264,16 @@ class _Request:
         self.rec = rec
 
 
+def _trace_attrs(rec) -> Dict[str, Any]:
+    """The span-attr fragment carrying a request's wire trace id (noted
+    on the journey record at submit): spread into every per-request
+    tracer span so one trace id stitches daemon journey → service spans
+    → offline export. Empty when the caller sent no trace context."""
+    meta = rec.meta
+    tid = meta.get("trace_id") if meta else None
+    return {"trace_id": tid} if tid else {}
+
+
 class _FlightRec:
     """A flush group launched on a replica, awaiting completion."""
 
@@ -1489,7 +1499,8 @@ class PipelineService:
 
     # -- client side -------------------------------------------------------
 
-    def submit(self, x, deadline_ms: Optional[float] = None) -> Future:
+    def submit(self, x, deadline_ms: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Future:
         """Queue one request: a single example (feature-shaped) or a small
         batch (leading row axis). The future resolves to the transformed
         example/batch respectively — or fails with ``QueueFullError``
@@ -1497,7 +1508,10 @@ class PipelineService:
         ``WorkerDiedError``, or ``ServiceClosed``; it is never stranded.
 
         ``deadline_ms`` overrides the service default for this request;
-        0/None with a 0 default means no deadline."""
+        0/None with a 0 default means no deadline. ``trace_id`` is the
+        caller's wire trace context (the daemon threads its journey's id
+        through here): noted on this request's journey record and
+        stamped onto every tracer span it produces."""
         # lint: ok(KL007) coerces the caller's HOST request payload; no device value is synced
         x = np.asarray(x, dtype=self.compiled.dtype)
         datum = x.shape == self.compiled.feature_shape
@@ -1538,9 +1552,10 @@ class PipelineService:
                     rid=rid,
                 )
                 if self._tracer is not None:
+                    extra = {"trace_id": trace_id} if trace_id else {}
                     self._tracer.instant(
                         "serve.rejected", "serving", rows=int(x.shape[0]),
-                        req_id=rid,
+                        req_id=rid, **extra,
                     )
                 raise QueueFullError(
                     f"serving queue at capacity ({self.max_pending} "
@@ -1554,6 +1569,8 @@ class PipelineService:
                 # dump the black box over a perfectly healthy service.
                 self._last_progress_ns = time.perf_counter_ns()
             rec = self._flight.start(rid, int(x.shape[0]))
+            if trace_id:
+                rec.note(trace_id=trace_id)
             self._pending.append(
                 _Request(x, datum, fut, deadline, t_sub, rid, rec)
             )
@@ -1644,6 +1661,7 @@ class PipelineService:
             self._tracer.record(
                 "serve.request", "serving", rq.t_sub, outcome="expired",
                 rows=int(rq.x.shape[0]), req_id=rq.rid,
+                **_trace_attrs(rq.rec),
             )
             # An expiry IS a latency breach: keep its span tree (scan
             # bounded to the request's lifetime — this runs under the
@@ -1724,6 +1742,7 @@ class PipelineService:
                             self._tracer.record(
                                 "serve.queued", "serving", rq.t_sub, now_ns,
                                 rows=int(rq.x.shape[0]), req_id=rq.rid,
+                                **_trace_attrs(rq.rec),
                             )
             if not group:
                 # Everything popped had expired: still a safe unlocked
@@ -1818,6 +1837,7 @@ class PipelineService:
                 tr.record(
                     "serve.request", "serving", rq.t_sub, now_ns,
                     outcome="ok", rows=m, req_id=rq.rid,
+                    **_trace_attrs(rq.rec),
                 )
                 retains.append((rq, sec))
         if tr is not None:
@@ -1844,7 +1864,7 @@ class PipelineService:
                     tr.record(
                         "serve.request", "serving", rq.t_sub,
                         outcome=type(e).__name__, rows=int(rq.x.shape[0]),
-                        req_id=rq.rid,
+                        req_id=rq.rid, **_trace_attrs(rq.rec),
                     )
                     # Failures keep their span trees like latency
                     # breaches do: the error IS the interesting tail.

@@ -270,6 +270,37 @@ def test_serve_precision_healthy_rerun_passes(history):
     assert result["ok"], result["regressions"]
 
 
+def test_serve_telemetry_family_judged(history):
+    """The serve_telemetry family's regression axes: the telemetry-on
+    overhead blowing past its band, export drops appearing (the
+    LOWER_BETTER ``dropped`` fragment), and the never-blocks
+    accounting gate flipping true -> false."""
+    def mutate(row):
+        row["overhead_frac"] *= 4.0
+        row["records_dropped"] += 50
+        row["pass"]["nonblocking_accounted"] = False
+
+    _append_serve_row(history, mutate, metric="serve_telemetry")
+    result = bench_watch.run(str(history))
+    assert not result["ok"]
+    names = {v["series"] for v in result["regressions"]}
+    assert "serve:serve_telemetry:overhead_frac" in names
+    assert "serve:serve_telemetry:records_dropped" in names
+    assert "serve:serve_telemetry:pass.nonblocking_accounted" in names
+
+
+def test_serve_telemetry_healthy_rerun_passes(history):
+    """A same-fingerprint re-run inside the noise band gates green."""
+    def mutate(row):
+        row["overhead_frac"] *= 1.05
+        row["on"]["req_per_s"] *= 1.02
+        row["on"]["lat"]["p99_ms"] *= 1.04
+
+    _append_serve_row(history, mutate, metric="serve_telemetry")
+    result = bench_watch.run(str(history))
+    assert result["ok"], result["regressions"]
+
+
 def test_online_family_loaded_and_regression_flagged(history):
     """ISSUE-15: the `make bench-online` fit_online row gates under the
     same generic loader — the re-solve speedup regressing down, the

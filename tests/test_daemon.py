@@ -477,9 +477,12 @@ def test_daemon_swap_under_load_two_swaps(tmp_path):
         def http_traffic():
             while not stop.is_set():
                 st, doc = _post(daemon.http_port, "/predict",
-                                {"x": X.tolist()})
+                                {"x": X.tolist()},
+                                {"X-Trace-Id": "swap-trace-http"})
                 with lock:
                     if st == 200:
+                        if doc.get("trace_id") != "swap-trace-http":
+                            failures.append(("trace", doc.get("trace_id")))
                         responses.append(
                             (doc["generation"],
                              np.asarray(doc["y"], np.float32))
@@ -491,12 +494,18 @@ def test_daemon_swap_under_load_two_swaps(tmp_path):
             while not stop.is_set():
                 try:
                     resp = _socket_request(
-                        SocketClient, daemon.socket_port, {"x": X.tolist()}
+                        SocketClient, daemon.socket_port,
+                        {"x": X.tolist(),
+                         "trace_id": "swap-trace-sock"},
                     )
                 except (ConnectionError, OSError):
                     continue
                 with lock:
                     if resp["status"] == 200:
+                        if resp.get("trace_id") != "swap-trace-sock":
+                            failures.append(
+                                ("trace", resp.get("trace_id"))
+                            )
                         responses.append(
                             (resp["generation"],
                              np.asarray(resp["y"], np.float32))
@@ -534,7 +543,17 @@ def test_daemon_swap_under_load_two_swaps(tmp_path):
         assert daemon.generation == 2
         st, health = _get(daemon.http_port, "/healthz")
         assert st == 200 and health["generation"] == 2
-        _settle(daemon)
+        snap = _settle(daemon)
+        # Trace-id continuity across the swap: journeys tagged with the
+        # client's id span more than one generation (the echo itself is
+        # asserted per-response in the traffic loops).
+        gens_by_trace = {
+            r["meta"].get("generation")
+            for r in snap["records"]
+            if (r.get("meta") or {}).get("trace_id") == "swap-trace-http"
+            and r["outcome"] == "ok"
+        }
+        assert len(gens_by_trace) >= 2
         stats = daemon.stats()
         assert stats["swaps"] == 2 and stats["swap_failures"] == 0
         assert stats["active_requests"] == 0
@@ -651,6 +670,183 @@ def test_daemon_swap_rejects_bad_artifact(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Wire-propagated trace context + SLO surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_daemon_trace_id_adopt_mint_and_error_echo(tmp_path):
+    """Propagation contract: a well-formed inbound X-Trace-Id is
+    adopted verbatim and echoed (header AND body) on every response —
+    200s and errors alike; a malformed one is replaced by a minted id,
+    never a rejection."""
+    import urllib.request
+
+    from keystone_tpu.utils.telemetry import TRACE_ID_RE
+
+    _, a1 = _save(tmp_path, 0, "v1")
+    tenants = {"sk-g": Tenant("acme", "sk-g", qps=0, tier="gold")}
+    x = [[1.0] * D]
+    with ServingDaemon(
+        artifact=a1, tenants=tenants, devices=1, buckets=(4,),
+        name="t-trace", gold_deadline_ms=60000, flight_dir=str(tmp_path),
+    ) as daemon:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{daemon.http_port}/predict",
+            data=json.dumps({"x": x}).encode(),
+            headers={"X-API-Key": "sk-g", "X-Trace-Id": "client.trace:1",
+                     "Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            doc = json.loads(resp.read())
+            assert resp.headers["X-Trace-Id"] == "client.trace:1"
+        assert doc["trace_id"] == "client.trace:1"
+        # ...and the journey carries the same id.
+        snap = _settle(daemon)
+        assert any(
+            (r.get("meta") or {}).get("trace_id") == "client.trace:1"
+            for r in snap["records"]
+        )
+        # Malformed (whitespace) -> minted; the request is still served.
+        st, doc = _post(daemon.http_port, "/predict", {"x": x},
+                        {"X-API-Key": "sk-g", "X-Trace-Id": "bad id!"})
+        assert st == 200
+        assert doc["trace_id"] != "bad id!"
+        assert TRACE_ID_RE.match(doc["trace_id"])
+        # Errors echo too: 400 (bad shape) keeps the client's id...
+        st, doc = _post(daemon.http_port, "/predict",
+                        {"x": [[1.0] * (D + 1)]},
+                        {"X-API-Key": "sk-g", "X-Trace-Id": "err-trace"})
+        assert st == 400 and doc["trace_id"] == "err-trace"
+        # ...and so does a 403 (unknown key, pre-admitted on headers).
+        st, doc = _post(daemon.http_port, "/predict", {"x": x},
+                        {"X-API-Key": "sk-nope",
+                         "X-Trace-Id": "auth-trace"}, retries=1)
+        assert st == 403 and doc["trace_id"] == "auth-trace"
+
+
+def test_daemon_socket_trace_roundtrip_and_mint(tmp_path):
+    """The framed wire's spelling of the same contract: ``trace_id`` in
+    the request frame comes back on the response frame — adopted when
+    well-formed, minted otherwise, present even with none sent."""
+    from keystone_tpu.utils.telemetry import TRACE_ID_RE
+
+    _, a1 = _save(tmp_path, 0, "v1")
+    SocketClient = _socket_client()
+    x = [[1.0] * D]
+    with ServingDaemon(
+        artifact=a1, devices=1, buckets=(4,), name="t-socktrace",
+        flight_dir=str(tmp_path),
+    ) as daemon:
+        resp = _socket_request(
+            SocketClient, daemon.socket_port,
+            {"x": x, "trace_id": "sock.trace-9"},
+        )
+        assert resp["status"] == 200
+        assert resp["trace_id"] == "sock.trace-9"
+        resp = _socket_request(
+            SocketClient, daemon.socket_port,
+            {"x": x, "trace_id": "no spaces allowed"},
+        )
+        assert resp["status"] == 200
+        assert resp["trace_id"] != "no spaces allowed"
+        assert TRACE_ID_RE.match(resp["trace_id"])
+        resp = _socket_request(SocketClient, daemon.socket_port, {"x": x})
+        assert resp["status"] == 200
+        assert TRACE_ID_RE.match(resp["trace_id"])
+        # Rejections echo too: a 400 (wrong feature shape) answers with
+        # the id the frame carried; an unparseable frame (adoption never
+        # ran) still answers with the minted placeholder.
+        resp = _socket_request(
+            SocketClient, daemon.socket_port,
+            {"x": [[1.0] * (D + 1)], "trace_id": "bad-shape-trace"},
+        )
+        assert resp["status"] == 400
+        assert resp["trace_id"] == "bad-shape-trace"
+        resp = _socket_request(
+            SocketClient, daemon.socket_port, {"nope": 1}
+        )
+        assert resp["status"] == 400
+        assert TRACE_ID_RE.match(resp["trace_id"])
+
+
+def test_daemon_stats_slo_latency_and_metrics_gauges(tmp_path, monkeypatch):
+    """/stats carries the SLO block (tenant names redacted for
+    anonymous callers), per-tier latency percentiles, and telemetry
+    accounting; /metrics exports per-tier SLO gauges plus the
+    tracer/telemetry loss counters — with tenant names NEVER on the
+    open scrape surface."""
+    from keystone_tpu.utils.metrics import telemetry_counters
+    from keystone_tpu.utils.telemetry import reset_telemetry
+
+    _, a1 = _save(tmp_path, 0, "v1")
+    tenants = {"sk-g": Tenant("acme-corp", "sk-g", qps=0, tier="gold")}
+    x = [[1.0] * D]
+    # Telemetry ON (so the accounting counters move) and a 2-slot
+    # journey ring (so evictions — the flight-recorder loss counter —
+    # actually fire under 4 requests).
+    monkeypatch.setenv("KEYSTONE_TELEMETRY_DIR", str(tmp_path / "tel"))
+    monkeypatch.setattr(config, "flight_records", 2)
+    reset_telemetry()
+    telemetry_counters.reset()
+    try:
+        with ServingDaemon(
+            artifact=a1, tenants=tenants, devices=1, buckets=(4,),
+            name="t-slo", gold_deadline_ms=60000,
+            flight_dir=str(tmp_path), swap_token="s3cret",
+        ) as daemon:
+            for _ in range(3):
+                assert _post(daemon.http_port, "/predict", {"x": x},
+                             {"X-API-Key": "sk-g"})[0] == 200
+            # A client-caused 400 must NOT enter the SLO denominator.
+            assert _post(daemon.http_port, "/predict",
+                         {"x": [[1.0] * (D + 1)]},
+                         {"X-API-Key": "sk-g"})[0] == 400
+            _settle(daemon)
+            st, stats = _get(daemon.http_port, "/stats")
+            assert st == 200
+            slo = stats["slo"]
+            # Anonymous caller: tenant keys collapsed to "*".
+            assert "acme-corp" not in json.dumps(slo)
+            entry = slo["tenants"]["*"]["gold"]
+            assert entry["total"] == 3 and entry["good"] == 3
+            assert entry["hit_rate"] == 1.0 and entry["burn"] == 0.0
+            # Per-tier latency percentiles ride /stats next to the SLO.
+            lat = stats["latency"]["gold"]
+            assert lat["count"] >= 3 and lat["p99_ms"] > 0
+            # Telemetry accounting rides /stats too.
+            assert stats["telemetry"]["enqueued"] >= 3
+            # The operator (swap-token holder) sees the breakdown.
+            import urllib.request
+
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{daemon.http_port}/stats",
+                headers={"X-Swap-Token": "s3cret"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                full = json.loads(resp.read())
+            assert "acme-corp" in full["slo"]["tenants"]
+            # The 2-slot ring evicted resolved journeys, counted.
+            assert full["flight"]["records_evicted"] >= 1
+            # /metrics: per-tier SLO gauges, tracer + telemetry loss
+            # accounting, journey-ring evictions — no tenant names.
+            st, body = _serve_daemon_mod().http_get(
+                daemon.http_port, "/metrics"
+            )
+            assert st == 200
+            body = body.decode() if isinstance(body, bytes) else body
+            assert "keystone_daemon_slo_gold" in body
+            assert "hit_rate" in body and "burn" in body
+            assert "keystone_tracer_" in body
+            assert "keystone_telemetry_total" in body
+            assert "records_enqueued" in body
+            assert "journeys_evicted" in body
+            assert "acme-corp" not in body
+    finally:
+        reset_telemetry()
+
+
+# ---------------------------------------------------------------------------
 # conn_drop semantics
 # ---------------------------------------------------------------------------
 
@@ -668,9 +864,11 @@ def test_daemon_conn_drop_journey_and_no_stranded_future(tmp_path, faults):
     ) as daemon:
         x = [[1.0] * D]
         # First data-plane response is dropped mid-write; the retry
-        # (a fresh request) is served.
-        st, doc = _post(daemon.http_port, "/predict", {"x": x})
+        # (a fresh request) is served. Both carry the client's trace id.
+        st, doc = _post(daemon.http_port, "/predict", {"x": x},
+                        {"X-Trace-Id": "drop-trace-1"})
         assert st == 200
+        assert doc["trace_id"] == "drop-trace-1"
         snap = _settle(daemon)
         outcomes = [r["outcome"] for r in snap["records"]]
         assert "conn_drop" in outcomes
@@ -681,6 +879,9 @@ def test_daemon_conn_drop_journey_and_no_stranded_future(tmp_path, faults):
         # full network leg (through submitted) before the drop.
         phases = [p["phase"] for p in dropped[0]["phases"]]
         assert "submitted" in phases and phases[0] == "accepted"
+        # Trace-id continuity under failure: the client vanished, but
+        # the conn_drop journey is still findable by the id it sent.
+        assert dropped[0]["meta"]["trace_id"] == "drop-trace-1"
         assert daemon._outcomes.snapshot().get("conn_drop", 0) >= 1
         assert reliability_counters.get("faults_injected_conn_drop") >= 1
         # Zero unresolved: no admission slot or active record leaked.
@@ -699,13 +900,16 @@ def test_daemon_socket_conn_drop(tmp_path, faults):
         flight_dir=str(tmp_path),
     ) as daemon:
         resp = _socket_request(
-            SocketClient, daemon.socket_port, {"x": [[1.0] * D]}
+            SocketClient, daemon.socket_port,
+            {"x": [[1.0] * D], "trace_id": "sock-drop-trace"},
         )
         assert resp["status"] == 200  # the retry after the dropped conn
+        assert resp["trace_id"] == "sock-drop-trace"
         snap = _settle(daemon)
-        assert any(
-            r["outcome"] == "conn_drop" for r in snap["records"]
-        )
+        dropped = [r for r in snap["records"]
+                   if r["outcome"] == "conn_drop"]
+        assert dropped
+        assert dropped[0]["meta"]["trace_id"] == "sock-drop-trace"
         assert daemon.stats()["active_requests"] == 0
 
 

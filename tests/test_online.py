@@ -575,6 +575,51 @@ def test_trainer_refresh_hot_swaps_live_daemon(tmp_path):
         daemon.close()
 
 
+def test_trainer_refresh_emits_traced_telemetry(tmp_path, monkeypatch):
+    """The cadence refresh mints ONE trace id and threads it through
+    the re-solve -> artifact -> swap chain: with durable export on,
+    both lifecycle records (refresh + swap) land on disk carrying that
+    id — "why did the model change?" resolves to one grep."""
+    import json as _json
+
+    from keystone_tpu.utils.telemetry import (
+        TRACE_ID_RE,
+        active_telemetry,
+        reset_telemetry,
+    )
+
+    tel_dir = str(tmp_path / "telemetry")
+    monkeypatch.setenv("KEYSTONE_TELEMETRY_DIR", tel_dir)
+    reset_telemetry()
+    try:
+        daemon, trainer, (X, Y) = _trainer_rig(tmp_path)
+        try:
+            Xs, Ys = _data(n=96, seed=9)
+            trainer.submit(Xs[:48], Ys[:48])
+            trainer.refresh()
+            assert daemon.generation == 1
+            tel = active_telemetry()
+            assert tel is not None and tel.drain(timeout=20.0)
+        finally:
+            trainer.close()
+            daemon.close()
+        records = []
+        for name in sorted(os.listdir(tel_dir)):
+            with open(os.path.join(tel_dir, name)) as fh:
+                records.extend(_json.loads(line) for line in fh)
+        refreshes = [r for r in records if r.get("kind") == "refresh"]
+        swaps = [r for r in records if r.get("kind") == "swap"]
+        assert refreshes and swaps
+        tid = refreshes[0]["trace_id"]
+        assert TRACE_ID_RE.match(tid)
+        assert swaps[0]["trace_id"] == tid
+        assert refreshes[0]["folds_applied"] >= 1
+        assert swaps[0]["from_generation"] == 0
+        assert swaps[0]["generation"] == 1
+    finally:
+        reset_telemetry()
+
+
 def test_trainer_refresh_abort_keeps_serving_and_retries(tmp_path, faults):
     """The chaos gate: a refresh killed at the refresh_abort site leaves
     generation 0 answering and the accumulators untouched; the retry

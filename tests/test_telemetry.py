@@ -1,0 +1,460 @@
+"""Durable telemetry pipeline: trace ids, the JSONL export log, SLO
+accounting, and the offline trace_report reconstruction.
+
+Acceptance pins (ISSUE 19):
+
+- **Trace-id contract**: a well-formed inbound id is adopted verbatim,
+  anything else is minted — a bad optional header can never reject a
+  request or propagate garbage into logs/response headers.
+- **Drops-counted-never-blocks**: ``TelemetryLog.emit`` never blocks
+  and never raises — a full queue / closed log / write error drops the
+  record AND counts it. Rotation + bounded retention keep the volume
+  finite under a steady flood.
+- **Offline reconstruction**: ``tools/trace_report.py --telemetry``
+  rebuilds the cross-process timeline (and the per-tenant SLO report)
+  from the on-disk segments ALONE — after the daemon has exited, with
+  the live rings gone.
+"""
+
+import importlib
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from keystone_tpu.config import config
+from keystone_tpu.utils.telemetry import (
+    SLO_BAD_STATUSES,
+    SLO_EXCLUDED_STATUSES,
+    TRACE_ID_RE,
+    SloAccounting,
+    TelemetryLog,
+    accept_trace_id,
+    active_telemetry,
+    mint_trace_id,
+    reset_telemetry,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+D = 6
+
+
+def _trace_report_mod():
+    sys.path.insert(0, TOOLS)
+    try:
+        return importlib.import_module("trace_report")
+    finally:
+        sys.path.pop(0)
+
+
+def _read_segments(directory):
+    records = []
+    for name in sorted(os.listdir(directory)):
+        if not name.startswith("keystone_telemetry_"):
+            continue
+        with open(os.path.join(directory, name)) as fh:
+            for line in fh:
+                records.append(json.loads(line))
+    return records
+
+
+# ---------------------------------------------------------------------------
+# Trace-id contract
+# ---------------------------------------------------------------------------
+
+
+def test_trace_id_accept_and_mint():
+    # Well-formed ids are adopted VERBATIM.
+    for good in ("abc", "a" * 64, "A-Z.0:9_x", "req:1234.span-7"):
+        assert accept_trace_id(good) == good
+    # Absent/empty/malformed ids are replaced with a minted one.
+    for bad in (None, "", "a" * 65, "has space", "new\nline", "ütf8",
+                "semi;colon", "q?x", "a/b"):
+        minted = accept_trace_id(bad)
+        assert minted != bad
+        assert TRACE_ID_RE.match(minted)
+    # Minted ids are well-formed and unique.
+    ids = {mint_trace_id() for _ in range(64)}
+    assert len(ids) == 64
+    assert all(TRACE_ID_RE.match(i) for i in ids)
+
+
+def test_writer_loop_is_a_registered_thread_root():
+    sys.path.insert(0, TOOLS)
+    try:
+        import keystone_lint
+    finally:
+        sys.path.pop(0)
+    assert "_writer_loop" in keystone_lint.KNOWN_THREAD_TARGETS
+
+
+# ---------------------------------------------------------------------------
+# TelemetryLog: durability, rotation, retention, never-blocks
+# ---------------------------------------------------------------------------
+
+
+class _FakeJourney:
+    def __init__(self, trace_id="t-1", outcome="ok"):
+        self._doc = {
+            "id": 1, "rows": 2, "outcome": outcome,
+            "phases": [{"phase": "accepted", "t_ns": 1000},
+                       {"phase": "resolved", "t_ns": 2000}],
+            "meta": {"trace_id": trace_id, "status": 200,
+                     "tenant": "acme", "tier": "gold", "generation": 0},
+        }
+
+    def as_dict(self):
+        return dict(self._doc)
+
+
+def test_telemetry_log_meta_anchor_and_journey_roundtrip(tmp_path):
+    log = TelemetryLog(str(tmp_path), name="unit", queue_cap=64)
+    try:
+        assert log.journey("svc-a", _FakeJourney("trace-xyz"))
+        assert log.drain(timeout=10.0)
+    finally:
+        log.close()
+    records = _read_segments(str(tmp_path))
+    # Segment opens with the meta record: schema + the wall/perf anchor
+    # pair that makes offline cross-process merging possible.
+    assert records[0]["kind"] == "meta"
+    assert records[0]["schema"] == TelemetryLog.SCHEMA
+    anchor = records[0]["anchor"]
+    assert anchor["unix_time"] > 0 and anchor["perf_ns"] > 0
+    journeys = [r for r in records if r["kind"] == "journey"]
+    assert len(journeys) == 1
+    assert journeys[0]["trace_id"] == "trace-xyz"
+    assert journeys[0]["service"] == "svc-a"
+    assert journeys[0]["journey"]["meta"]["tenant"] == "acme"
+    stats = log.stats()
+    assert stats["enqueued"] == stats["written"] == 1
+    assert stats["dropped"] == 0
+
+
+def test_telemetry_rotation_and_bounded_retention(tmp_path):
+    # ~1.6KB records against a 0.004MB (4KB) rotation threshold: many
+    # rotations; retention keeps only the newest 2 segments.
+    log = TelemetryLog(str(tmp_path), name="rot", rotate_mb=0.004,
+                       keep=2, queue_cap=512)
+    try:
+        for i in range(40):
+            assert log.emit({"kind": "journey", "i": i, "pad": "x" * 1500})
+        assert log.drain(timeout=10.0)
+    finally:
+        log.close()
+    segs = [n for n in os.listdir(str(tmp_path))
+            if n.startswith("keystone_telemetry_rot_")]
+    assert len(segs) <= 2, segs
+    assert log.rotations >= 3
+    # Every surviving line is complete JSON; newest records survive.
+    records = _read_segments(str(tmp_path))
+    kept = [r["i"] for r in records if r.get("kind") == "journey"]
+    assert kept and max(kept) == 39
+    assert log.stats()["written"] == 40
+
+
+def test_telemetry_emit_never_blocks_and_counts_drops(tmp_path):
+    log = TelemetryLog(str(tmp_path), name="drops", queue_cap=4)
+    try:
+        # Jam the queue from the producer side faster than the writer
+        # can drain: emit must return (True or False) immediately and
+        # count every False as a drop — by construction it cannot block
+        # (put_nowait) or raise.
+        results = [log.emit({"kind": "journey", "i": i, "pad": "y" * 200})
+                   for i in range(5000)]
+        assert log.drain(timeout=20.0)
+        accepted = sum(results)
+        stats = log.stats()
+        assert stats["enqueued"] == accepted
+        assert stats["written"] == accepted
+        assert stats["dropped"] == len(results) - accepted
+        # The accounting invariant the bench gates on: everything is
+        # either durably written or counted dropped.
+        assert stats["enqueued"] + stats["dropped"] == len(results)
+    finally:
+        log.close()
+    # Emit AFTER close: dropped and counted, never raised.
+    before = log.stats()["dropped"]
+    assert log.emit({"kind": "journey"}) is False
+    assert log.stats()["dropped"] == before + 1
+
+
+def test_active_telemetry_singleton_follows_the_knob(tmp_path, monkeypatch):
+    reset_telemetry()
+    try:
+        monkeypatch.delenv("KEYSTONE_TELEMETRY_DIR", raising=False)
+        monkeypatch.setattr(config, "telemetry_dir", "")
+        assert active_telemetry() is None
+        d1 = str(tmp_path / "a")
+        monkeypatch.setenv("KEYSTONE_TELEMETRY_DIR", d1)
+        t1 = active_telemetry()
+        assert t1 is not None and t1.directory == d1
+        assert active_telemetry() is t1  # cached, resolved once
+        # Flipping the knob rebuilds (tests flip without a reload).
+        d2 = str(tmp_path / "b")
+        monkeypatch.setenv("KEYSTONE_TELEMETRY_DIR", d2)
+        t2 = active_telemetry()
+        assert t2 is not t1 and t2.directory == d2
+        # Env-presence-over-truthiness: exported empty = explicit off.
+        monkeypatch.setenv("KEYSTONE_TELEMETRY_DIR", "")
+        assert active_telemetry() is None
+    finally:
+        reset_telemetry()
+
+
+def test_torn_tail_line_recovers_everything_before_it(tmp_path):
+    log = TelemetryLog(str(tmp_path), name="torn", queue_cap=16)
+    try:
+        for i in range(3):
+            log.emit({"kind": "journey", "i": i, "trace_id": f"t{i}",
+                      "pid": os.getpid()})
+        assert log.drain(timeout=10.0)
+        path = log.stats()["segment"]
+    finally:
+        log.close()
+    # Simulate a process killed mid-write: append half a record.
+    with open(path, "a") as fh:
+        fh.write('{"kind": "journey", "i": 99, "tr')
+    report = _trace_report_mod()
+    records, paths = report.load_telemetry(str(tmp_path))
+    assert paths == [path]
+    idx = [r.get("i") for r in records if r.get("kind") == "journey"]
+    assert idx == [0, 1, 2]  # the torn line is skipped, not fatal
+
+
+# ---------------------------------------------------------------------------
+# SLO accounting
+# ---------------------------------------------------------------------------
+
+
+def test_slo_status_semantics_and_burn_math():
+    slo = SloAccounting(window_s=300.0, target=0.9)
+    # 8 good, 2 server-side bad, plus excluded client-caused statuses
+    # that must not enter the denominator.
+    for _ in range(8):
+        slo.observe("acme", "gold", 200)
+    slo.observe("acme", "gold", 500)
+    slo.observe("acme", "gold", 504)
+    for status in sorted(SLO_EXCLUDED_STATUSES):
+        slo.observe("acme", "gold", status)
+    assert SLO_BAD_STATUSES.isdisjoint(SLO_EXCLUDED_STATUSES)
+    entry = slo.snapshot()["tenants"]["acme"]["gold"]
+    assert entry["total"] == 10 and entry["good"] == 8
+    assert entry["hit_rate"] == 0.8
+    # burn = miss_rate / (1 - target) = 0.2 / 0.1
+    assert entry["burn"] == 2.0
+
+
+def test_slo_redaction_and_tier_rates():
+    slo = SloAccounting(window_s=300.0, target=0.99)
+    slo.observe("acme", "gold", 200)
+    slo.observe("tenant-b", "gold", 503)
+    slo.observe("tenant-c", "best_effort", 200)
+    full = slo.snapshot()
+    assert set(full["tenants"]) == {"acme", "tenant-b", "tenant-c"}
+    red = slo.snapshot(redact_tenants=True)
+    # Tenant names collapse to "*"; per-tier aggregates survive.
+    assert set(red["tenants"]) == {"*"}
+    assert red["tenants"]["*"]["gold"]["total"] == 2
+    assert red["tenants"]["*"]["gold"]["good"] == 1
+    rates = slo.tier_rates()
+    assert rates["gold"]["hit_rate"] == 0.5
+    assert rates["best_effort"]["hit_rate"] == 1.0
+    assert "acme" not in json.dumps(rates)
+
+
+def test_slo_window_expires_old_events(monkeypatch):
+    slo = SloAccounting(window_s=10.0, target=0.99)
+    now = [1000.0]
+    monkeypatch.setattr(time, "monotonic", lambda: now[0])
+    slo.observe("acme", "gold", 500)
+    now[0] += 5.0
+    slo.observe("acme", "gold", 200)
+    entry = slo.snapshot()["tenants"]["acme"]["gold"]
+    assert entry["total"] == 2 and entry["good"] == 1
+    # The failure ages out of the window; the hit rate recovers.
+    now[0] += 7.0
+    entry = slo.snapshot()["tenants"]["acme"]["gold"]
+    assert entry["total"] == 1 and entry["hit_rate"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Offline reconstruction (trace_report --telemetry / --slo)
+# ---------------------------------------------------------------------------
+
+
+def _write_segment(directory, name, pid, anchor_unix, records):
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(
+        directory, f"keystone_telemetry_{name}_{pid}_000001.jsonl"
+    )
+    meta = {"kind": "meta", "schema": 1, "service": name, "pid": pid,
+            "anchor": {"unix_time": anchor_unix, "perf_ns": 1_000_000},
+            "segment": 1}
+    with open(path, "w") as fh:
+        for rec in [meta] + records:
+            fh.write(json.dumps(rec) + "\n")
+    return path
+
+
+def test_merge_telemetry_joins_processes_on_wall_clock(tmp_path):
+    """Two processes with DIFFERENT perf epochs but overlapping wall
+    time merge onto one timeline, joined by trace id — the router →
+    daemon cross-process stitch, reconstructed offline."""
+    report = _trace_report_mod()
+    directory = str(tmp_path)
+    journey = {
+        "kind": "journey", "service": "daemon-a", "pid": 11,
+        "trace_id": "cross-1",
+        "journey": {
+            "id": 7, "rows": 1, "outcome": "ok",
+            "phases": [{"phase": "accepted", "t_ns": 2_000_000},
+                       {"phase": "resolved", "t_ns": 4_000_000}],
+            "meta": {"trace_id": "cross-1", "status": 200,
+                     "tenant": "acme", "tier": "gold", "generation": 0},
+        },
+    }
+    spans = {
+        "kind": "spans", "pid": 22,
+        "events": [{"name": "serve.request", "cat": "serve",
+                    "start_ns": 3_000_000, "dur_ns": 500_000, "tid": 1,
+                    "thread": "w0", "args": {"trace_id": "cross-1"}}],
+    }
+    _write_segment(directory, "procA", 11, 100.0, [journey])
+    _write_segment(directory, "procB", 22, 100.0, [spans])
+    records, paths = report.load_telemetry(directory)
+    assert len(paths) == 2
+    doc = report.merge_telemetry(records)
+    from keystone_tpu.utils.metrics import validate_chrome_trace
+
+    assert validate_chrome_trace(doc) == []
+    idx = report.trace_index(doc)
+    entry = idx["cross-1"]
+    # One trace id crossed both processes.
+    assert set(entry["pids"]) == {11, 22}
+    assert "daemon-a" in entry["services"]
+    assert "ok" in entry["outcomes"]
+    # Wall-clock math: journey accepted at anchor 100s + (2ms - 1ms
+    # anchor perf) = 100.001s -> µs; the two processes share the axis.
+    ts = [ev["ts"] for ev in doc["traceEvents"]
+          if (ev.get("args") or {}).get("trace_id") == "cross-1"
+          and ev["ph"] == "X"]
+    assert min(ts) == pytest.approx(100.001e6, rel=1e-6)
+
+
+def test_slo_report_from_journeys_alone(tmp_path):
+    report = _trace_report_mod()
+    directory = str(tmp_path)
+
+    def j(trace, status, tenant="acme", tier="gold", t_ns=2_000_000):
+        return {
+            "kind": "journey", "service": "d", "pid": 5, "trace_id": trace,
+            "journey": {
+                "id": 1, "rows": 1,
+                "outcome": "ok" if status == 200 else "error",
+                "phases": [{"phase": "accepted", "t_ns": t_ns},
+                           {"phase": "resolved", "t_ns": t_ns + 1000}],
+                "meta": {"trace_id": trace, "status": status,
+                         "tenant": tenant, "tier": tier, "generation": 0},
+            },
+        }
+
+    _write_segment(directory, "d", 5, 50.0, [
+        j("t1", 200), j("t2", 200), j("t3", 504),
+        j("t4", 429),  # excluded: admission refusal, not a failure
+        j("t5", 200, tenant="other", tier="best_effort"),
+    ])
+    records, _ = report.load_telemetry(directory)
+    out = report.slo_report(records, window_s=300.0, target=0.9)
+    gold = out["tenants"]["acme"]["gold"]
+    assert gold["total"] == 3 and gold["good"] == 2  # 429 excluded
+    assert gold["burn"] == pytest.approx((1 / 3) / 0.1, rel=1e-3)
+    be = out["tenants"]["other"]["best_effort"]
+    assert be["hit_rate"] == 1.0
+
+
+def test_trace_report_telemetry_cli_empty_dir_fails(tmp_path):
+    report = _trace_report_mod()
+    rc = report.main(["--telemetry", str(tmp_path)])
+    assert rc == 1  # a dead pipeline must not produce a green report
+
+
+# ---------------------------------------------------------------------------
+# End to end: daemon -> disk -> offline reconstruction (the
+# `make trace-report` smoke, in-process so the gate can't rot)
+# ---------------------------------------------------------------------------
+
+
+def test_daemon_journeys_reconstruct_offline_after_exit(
+        tmp_path, monkeypatch):
+    import numpy as np
+
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+    from keystone_tpu.nodes.stats.random_features import CosineRandomFeatures
+    from keystone_tpu.workflow.daemon import ServingDaemon, Tenant
+    from keystone_tpu.workflow.serialization import save_artifact
+
+    tel_dir = str(tmp_path / "telemetry")
+    monkeypatch.setenv("KEYSTONE_TELEMETRY_DIR", tel_dir)
+    reset_telemetry()
+    pipe = (CosineRandomFeatures.create(D, 12, seed=0)
+            .and_then(L2Normalizer()).fit())
+    art = str(tmp_path / "model.kart")
+    save_artifact(pipe, art, feature_shape=(D,), dtype="float32")
+    sys.path.insert(0, TOOLS)
+    try:
+        import serve_daemon as sd
+    finally:
+        sys.path.pop(0)
+    pipe2 = (CosineRandomFeatures.create(D, 12, seed=1)
+             .and_then(L2Normalizer()).fit())
+    art2 = str(tmp_path / "model2.kart")
+    save_artifact(pipe2, art2, feature_shape=(D,), dtype="float32")
+    x = [[1.0] * D]
+    tenants = {"sk-g": Tenant("acme", "sk-g", qps=0, tier="gold")}
+    try:
+        with ServingDaemon(
+            artifact=art, tenants=tenants, devices=1, buckets=(4,),
+            name="t-offline", gold_deadline_ms=60000,
+            flight_dir=str(tmp_path),
+        ) as daemon:
+            st, doc = sd.http_post(
+                daemon.http_port, "/predict", {"x": x},
+                {"X-API-Key": "sk-g", "X-Trace-Id": "offline-trace-1"},
+            )
+            assert st == 200 and doc["trace_id"] == "offline-trace-1"
+            # A hot swap carries its requester's trace id into the
+            # durable lifecycle record.
+            assert daemon.request_swap(
+                art2, timeout_s=120, trace_id="swap-trace-7"
+            ) == 1
+        # Daemon exited; drop the live singleton too — reconstruction
+        # must need NOTHING but the directory.
+        reset_telemetry()
+        report = _trace_report_mod()
+        records, paths = report.load_telemetry(tel_dir)
+        assert paths, "no segments written"
+        merged = report.merge_telemetry(records)
+        from keystone_tpu.utils.metrics import validate_chrome_trace
+
+        assert validate_chrome_trace(merged) == []
+        idx = report.trace_index(merged)
+        entry = idx["offline-trace-1"]
+        assert "daemon-t-offline" in entry["services"]
+        assert "ok" in entry["outcomes"]
+        # The swap's lifecycle record reconstructs under ITS trace id,
+        # naming both generations.
+        swaps = [r for r in records if r.get("kind") == "swap"]
+        assert swaps and swaps[0]["trace_id"] == "swap-trace-7"
+        assert swaps[0]["from_generation"] == 0
+        assert swaps[0]["generation"] == 1
+        assert "swap-trace-7" in idx
+        slo = report.slo_report(records, window_s=300.0, target=0.99)
+        assert slo["tenants"]["acme"]["gold"]["total"] >= 1
+        assert slo["tenants"]["acme"]["gold"]["hit_rate"] == 1.0
+    finally:
+        reset_telemetry()

@@ -87,7 +87,12 @@ KNOWN_THREAD_TARGETS = {"_watchdog_loop", "_watch_loop", "_solve_watch_loop",
                         "_accept_loop", "_serve_conn", "_swap_loop",
                         # workflow/online.py OnlineTrainer: the cadence
                         # refresh worker (re-solve + artifact + swap).
-                        "_refresh_loop"}
+                        "_refresh_loop",
+                        # utils/telemetry.py TelemetryLog: the durable
+                        # journey-export writer (drains the bounded
+                        # queue to rotated JSONL segments off the hot
+                        # path).
+                        "_writer_loop"}
 HOST_SYNC_CALLS = {"block_until_ready", "device_get", "asarray", "array"}
 
 #: Mutating method names treated as writes for KL001 (deque/list/set/dict

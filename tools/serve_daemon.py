@@ -180,16 +180,21 @@ def run_smoke(d: int = 8, requests: int = 24, out_dir=None) -> dict:
     try:
         st0, doc0 = http_post(
             daemon.http_port, "/predict", {"x": X.tolist()},
-            {"X-API-Key": "sk-gold"},
+            {"X-API-Key": "sk-gold", "X-Trace-Id": "smoke-trace-http"},
         )
         http_ok = st0 == 200 and np.array_equal(
             np.asarray(doc0["y"], np.float32), ref1
         )
+        # Wire trace context round-trips both ingresses: the id the
+        # client sent comes back on its response (and names the daemon
+        # journey — tests/test_daemon.py pins that leg).
+        http_trace_ok = doc0.get("trace_id") == "smoke-trace-http"
         sresp = None
         for _ in range(4):  # reconnect-and-retry across injected drops
             sc = SocketClient(daemon.socket_port)
             try:
-                sresp = sc.request({"x": X.tolist(), "key": "sk-gold"})
+                sresp = sc.request({"x": X.tolist(), "key": "sk-gold",
+                                    "trace_id": "smoke-trace-sock"})
                 break
             except (ConnectionError, OSError):
                 continue
@@ -198,6 +203,10 @@ def run_smoke(d: int = 8, requests: int = 24, out_dir=None) -> dict:
         socket_ok = (
             sresp is not None and sresp["status"] == 200
             and np.array_equal(np.asarray(sresp["y"], np.float32), ref1)
+        )
+        socket_trace_ok = (
+            sresp is not None
+            and sresp.get("trace_id") == "smoke-trace-sock"
         )
         auth_status = http_post(
             daemon.http_port, "/predict", {"x": X.tolist()}
@@ -251,6 +260,8 @@ def run_smoke(d: int = 8, requests: int = 24, out_dir=None) -> dict:
             "pass": {
                 "http_bit_identical": bool(http_ok),
                 "socket_bit_identical": bool(socket_ok),
+                "trace_id_http_echo": bool(http_trace_ok),
+                "trace_id_socket_echo": bool(socket_trace_ok),
                 "auth_403": auth_status == 403,
                 "quota_429": 429 in be_codes,
                 "swap_tokenless_403": swap_denied == 403,

@@ -26,12 +26,34 @@ store after the ring churned past them, broken down into the journey's
 phases: queue wait (submit → flush-group pop), device time (launch →
 materialized), and the resolve tail.
 
+``--telemetry DIR`` switches to the durable-telemetry view: every
+``keystone_telemetry_*.jsonl`` segment in DIR (written by
+``utils.telemetry.TelemetryLog`` — any number of daemon/trainer
+processes) is merged into ONE Chrome-trace JSON on a shared wall-clock
+timeline. Each segment opens with a ``meta`` record carrying a
+``(unix_time, perf_ns)`` anchor pair, which is what maps each process's
+monotonic ``perf_counter_ns`` stamps onto wall time — so journeys from
+process A, the tracer span trees process B exported at close (its live
+ring — the merge the module docstring promises), and the swap/refresh
+lifecycle records all land on one timeline, every event keyed by its
+wire trace id in ``args.trace_id``. ``--out FILE`` writes the merged
+document (opens in Perfetto); stdout gets the per-trace-id index.
+
+``--telemetry DIR --slo`` computes per-tenant/tier deadline-hit rate and
+error-budget burn from the journey records instead: overall and over
+rolling ``--window`` seconds buckets, against ``--target``. Same
+good/excluded status semantics as the live ``/stats`` SLO block
+(``utils.telemetry``): 5xx-family statuses burn budget, client errors
+and admission refusals (400/403/429) stay out of the denominator.
+
 Usage:
     python tools/trace_report.py TRACE.json [--validate-only] [--top N]
         [--request ID]
+    python tools/trace_report.py --telemetry DIR [--out MERGED.json]
+        [--slo --window S --target T]
 
 Exit status: 0 = valid trace, 1 = schema problems / zero spans / unknown
-request id (listed on stderr).
+request id / empty telemetry dir (listed on stderr).
 """
 
 from __future__ import annotations
@@ -188,9 +210,294 @@ def request_report(doc: dict, rid: int) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# Durable-telemetry merge (utils.telemetry.TelemetryLog segments)
+# ---------------------------------------------------------------------------
+
+
+def load_telemetry(directory: str) -> tuple:
+    """Every record from the directory's ``keystone_telemetry_*.jsonl``
+    segments, each tagged (``_anchor``) with its segment's wall/perf
+    anchor pair. Torn tail lines (a segment still being written) and
+    foreign files are skipped, not fatal. Returns (records, paths)."""
+    import glob
+
+    records: list = []
+    paths = sorted(glob.glob(
+        os.path.join(directory, "keystone_telemetry_*.jsonl")
+    ))
+    for path in paths:
+        anchor = None
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail of a live segment
+                if not isinstance(rec, dict):
+                    continue
+                if rec.get("kind") == "meta":
+                    anchor = rec.get("anchor")
+                    continue
+                rec["_anchor"] = anchor
+                records.append(rec)
+    return records, paths
+
+
+def _wall_us(ns: float, anchor: dict) -> float:
+    """A process-local ``perf_counter_ns`` stamp as wall-clock µs, via
+    the segment's anchor pair. Without an anchor (foreign/damaged
+    segment) the raw stamp degrades to µs — ordering within that process
+    survives, cross-process alignment does not."""
+    if not anchor:
+        return ns / 1e3
+    return (anchor["unix_time"] + (ns - anchor["perf_ns"]) / 1e9) * 1e6
+
+
+def merge_telemetry(records: list) -> dict:
+    """All journey / span / lifecycle records as ONE Chrome-trace doc on
+    the shared wall-clock µs timeline, every event carrying its wire
+    trace id in ``args.trace_id`` (the cross-process join key)."""
+    events: list = []
+    for rec in records:
+        anchor = rec.get("_anchor")
+        kind = rec.get("kind")
+        pid = rec.get("pid", 0)
+        if kind == "journey":
+            j = rec.get("journey") or {}
+            phases = j.get("phases") or []
+            if not phases:
+                continue
+            t0, t1 = phases[0]["t_ns"], phases[-1]["t_ns"]
+            meta = j.get("meta") or {}
+            args = {
+                "trace_id": rec.get("trace_id"),
+                "req_id": j.get("id"),
+                "outcome": j.get("outcome"),
+                "service": rec.get("service"),
+            }
+            for k in ("tenant", "tier", "status", "generation"):
+                if k in meta:
+                    args[k] = meta[k]
+            events.append({
+                "name": f"journey:{rec.get('service')}", "cat": "journey",
+                "ph": "X", "ts": _wall_us(t0, anchor),
+                "dur": max(0.0, (t1 - t0) / 1e3), "pid": pid, "tid": 0,
+                "args": args,
+            })
+            # Per-phase legs: where inside the journey the time went.
+            for p0, p1 in zip(phases, phases[1:]):
+                events.append({
+                    "name": f"phase:{p0['phase']}->{p1['phase']}",
+                    "cat": "journey", "ph": "X",
+                    "ts": _wall_us(p0["t_ns"], anchor),
+                    "dur": max(0.0, (p1["t_ns"] - p0["t_ns"]) / 1e3),
+                    "pid": pid, "tid": 0,
+                    "args": {"trace_id": rec.get("trace_id"),
+                             "req_id": j.get("id")},
+                })
+        elif kind == "spans":
+            for s in rec.get("events") or []:
+                events.append({
+                    "name": s["name"], "cat": s.get("cat", ""),
+                    "ph": "X", "ts": _wall_us(s["start_ns"], anchor),
+                    "dur": s.get("dur_ns", 0) / 1e3, "pid": pid,
+                    "tid": s.get("tid") or 0, "args": s.get("args") or {},
+                })
+        elif kind in ("swap", "refresh"):
+            t0 = rec.get("start_ns")
+            if t0 is None:
+                continue
+            t1 = rec.get("end_ns", t0)
+            args = {
+                k: rec[k]
+                for k in ("trace_id", "service", "generation",
+                          "from_generation", "seq", "artifact",
+                          "fingerprint")
+                if rec.get(k) is not None
+            }
+            events.append({
+                "name": f"{kind}:{rec.get('service')}", "cat": "lifecycle",
+                "ph": "X", "ts": _wall_us(t0, anchor),
+                "dur": max(0.0, (t1 - t0) / 1e3), "pid": pid, "tid": 0,
+                "args": args,
+            })
+    events.sort(key=lambda ev: ev["ts"])
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def trace_index(doc: dict) -> dict:
+    """Per-trace-id digest of a merged document: how many events, which
+    processes/services a trace crossed, its wall window, and the journey
+    outcome(s) — the offline answer to "what happened to request X"."""
+    by: dict = {}
+    for ev in doc.get("traceEvents", []):
+        args = ev.get("args") or {}
+        tid = args.get("trace_id")
+        if not tid:
+            continue
+        e = by.setdefault(tid, {
+            "events": 0, "pids": set(), "services": set(),
+            "first_ts_us": ev["ts"], "last_ts_us": ev["ts"],
+            "outcomes": [],
+        })
+        e["events"] += 1
+        e["pids"].add(ev.get("pid"))
+        if args.get("service"):
+            e["services"].add(args["service"])
+        e["first_ts_us"] = min(e["first_ts_us"], ev["ts"])
+        e["last_ts_us"] = max(
+            e["last_ts_us"], ev["ts"] + float(ev.get("dur", 0.0))
+        )
+        if ev.get("cat") == "journey" and args.get("outcome"):
+            e["outcomes"].append(args["outcome"])
+    return {
+        tid: {
+            "events": e["events"],
+            "pids": sorted(p for p in e["pids"] if p is not None),
+            "services": sorted(e["services"]),
+            "span_ms": round((e["last_ts_us"] - e["first_ts_us"]) / 1e3, 3),
+            "outcomes": e["outcomes"],
+        }
+        for tid, e in sorted(by.items())
+    }
+
+
+def slo_report(records: list, window_s: float, target: float) -> dict:
+    """Per-tenant/tier deadline-hit rate + error-budget burn from the
+    journey records: overall, and per rolling ``window_s`` bucket (0 =
+    one bucket over everything). Status semantics shared with the live
+    accounting (``utils.telemetry``)."""
+    from keystone_tpu.utils.telemetry import (
+        SLO_BAD_STATUSES,
+        SLO_EXCLUDED_STATUSES,
+    )
+
+    events: list = []  # (wall_s, tenant, tier, good)
+    for rec in records:
+        if rec.get("kind") != "journey":
+            continue
+        j = rec.get("journey") or {}
+        meta = j.get("meta") or {}
+        status = meta.get("status")
+        phases = j.get("phases") or []
+        if status is None or not phases:
+            continue
+        if int(status) in SLO_EXCLUDED_STATUSES:
+            continue
+        wall = _wall_us(phases[-1]["t_ns"], rec.get("_anchor")) / 1e6
+        events.append((
+            wall,
+            meta.get("tenant") or "anonymous",
+            meta.get("tier") or "best_effort",
+            int(status) not in SLO_BAD_STATUSES,
+        ))
+    out = {
+        "window_s": window_s, "target": target,
+        "events": len(events), "tenants": {}, "windows": [],
+    }
+    if not events:
+        return out
+    events.sort()
+    t_lo = events[0][0]
+    budget = max(1e-9, 1.0 - target)
+
+    def entry(tally):
+        total, good = tally
+        hit = good / total
+        return {
+            "total": total, "good": good,
+            "hit_rate": round(hit, 6),
+            "burn": round((1.0 - hit) / budget, 4),
+        }
+
+    overall: dict = {}
+    buckets: dict = {}
+    for wall, tenant, tier, good in events:
+        w = int((wall - t_lo) // window_s) if window_s > 0 else 0
+        for store in (overall, buckets.setdefault(w, {})):
+            tally = store.setdefault((tenant, tier), [0, 0])
+            tally[0] += 1
+            tally[1] += int(good)
+    for (tenant, tier), tally in sorted(overall.items()):
+        out["tenants"].setdefault(tenant, {})[tier] = entry(tally)
+    for w in sorted(buckets):
+        row: dict = {
+            "window": w,
+            "start_unix": round(t_lo + w * window_s, 3),
+            "tenants": {},
+        }
+        for (tenant, tier), tally in sorted(buckets[w].items()):
+            row["tenants"].setdefault(tenant, {})[tier] = entry(tally)
+        out["windows"].append(row)
+    return out
+
+
+def _telemetry_main(args) -> int:
+    from keystone_tpu.utils.metrics import validate_chrome_trace
+
+    records, paths = load_telemetry(args.telemetry)
+    if not records:
+        print(
+            f"EMPTY: no telemetry records under {args.telemetry} "
+            f"({len(paths)} segment file(s)) — was KEYSTONE_TELEMETRY_DIR "
+            "set for the recorded run?",
+            file=sys.stderr,
+        )
+        return 1
+    if args.slo:
+        rep = slo_report(records, args.window, args.target)
+        print(json.dumps(rep))
+        for tenant, tiers in rep["tenants"].items():
+            for tier, e in tiers.items():
+                print(
+                    f"{tenant}/{tier}: hit_rate={e['hit_rate']} "
+                    f"burn={e['burn']} ({e['good']}/{e['total']} over "
+                    f"{len(rep['windows'])} window(s))",
+                    file=sys.stderr,
+                )
+        return 0
+    doc = merge_telemetry(records)
+    errors = validate_chrome_trace(doc)
+    if errors:
+        for e in errors[:20]:
+            print(f"INVALID: {e}", file=sys.stderr)
+        return 1
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f)
+    index = trace_index(doc)
+    print(json.dumps({
+        "telemetry": args.telemetry,
+        "segments": len(paths),
+        "events": len(doc["traceEvents"]),
+        "merged": args.out,
+        "traces": index,
+    }))
+    if index:
+        w = max(len(t) for t in index)
+        print(
+            f"\n{'trace':<{w}}  {'events':>6}  {'procs':>5}  "
+            f"{'span ms':>9}  services / outcomes",
+            file=sys.stderr,
+        )
+        for tid, e in index.items():
+            print(
+                f"{tid:<{w}}  {e['events']:>6}  {len(e['pids']):>5}  "
+                f"{e['span_ms']:>9.3f}  "
+                f"{','.join(e['services'])} / {','.join(e['outcomes'])}",
+                file=sys.stderr,
+            )
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("trace", help="Chrome-trace JSON file (Tracer.export)")
+    ap.add_argument("trace", nargs="?", default=None,
+                    help="Chrome-trace JSON file (Tracer.export)")
     ap.add_argument("--validate-only", action="store_true",
                     help="schema check only, no summary table")
     ap.add_argument("--top", type=int, default=0,
@@ -201,7 +508,36 @@ def main(argv=None) -> int:
     ap.add_argument("--fit", action="store_true",
                     help="aggregate executor node spans into the "
                          "profile_report attribution-table format")
+    ap.add_argument("--telemetry", default=None, metavar="DIR",
+                    help="merge a KEYSTONE_TELEMETRY_DIR's JSONL segments "
+                         "(multi-process) into one wall-clock Chrome trace "
+                         "keyed by trace id, instead of reading a trace "
+                         "file")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="with --telemetry: write the merged Chrome-trace "
+                         "JSON here (opens in Perfetto)")
+    ap.add_argument("--slo", action="store_true",
+                    help="with --telemetry: per-tenant/tier deadline-hit "
+                         "rate and error-budget burn from the journey "
+                         "records")
+    ap.add_argument("--window", type=float, default=None, metavar="S",
+                    help="--slo rolling window seconds (default "
+                         "KEYSTONE_SLO_WINDOW_S; 0 = one window)")
+    ap.add_argument("--target", type=float, default=None,
+                    help="--slo hit-rate target (default "
+                         "KEYSTONE_SLO_TARGET)")
     args = ap.parse_args(argv)
+
+    if args.telemetry is not None:
+        from keystone_tpu.config import config
+
+        if args.window is None:
+            args.window = config.slo_window_s
+        if args.target is None:
+            args.target = config.slo_target
+        return _telemetry_main(args)
+    if args.trace is None:
+        ap.error("pass a trace file, or --telemetry DIR")
 
     from keystone_tpu.utils.metrics import validate_chrome_trace
 
