@@ -1,6 +1,6 @@
 # Developer entry points. Pipelines launch via bin/run-pipeline.sh.
 
-.PHONY: test t1 chaos chaos-elastic native bench bench-serve bench-serve-overload bench-serve-replicas bench-serve-daemon bench-serve-precision bench-fit bench-opt bench-multichip bench-imagenet bench-online trace-demo trace-report obs-serve serve-daemon profile-demo bench-watch lint dryrun clean tpu-checkride sentinel northstar acceptance
+.PHONY: test t1 chaos chaos-elastic native bench bench-serve bench-serve-overload bench-serve-replicas bench-serve-daemon bench-serve-precision bench-capacity bench-fit bench-opt bench-multichip bench-imagenet bench-online trace-demo trace-report obs-serve serve-daemon profile-demo bench-watch lint dryrun clean tpu-checkride sentinel northstar acceptance
 
 # The canonical tier-1 verify (ROADMAP.md), verbatim at the defaults —
 # builders and CI invoke this one entry point instead of hand-copying the
@@ -108,6 +108,17 @@ serve-daemon:
 # `make bench-watch` regresses against.
 bench-serve-daemon:
 	JAX_PLATFORMS=cpu python tools/bench_serve.py --daemon --out BENCH_serve.json
+
+# Capacity-loop A/B: the same shifting-mix flood with the learned
+# capacity model off (the pre-model baseline) vs on. Hard gates: model-on
+# goodput (deadline-met 200s/s) beats model-off at equal-or-better gold
+# p99, zero predicted-infeasible journeys ever reached a device, at
+# least one cross-tenant micro-batch formed, and the re-plan loop
+# reacted to the mid-flood mix shift. APPENDS the fingerprinted
+# serve_capacity row to the BENCH_serve.json history `make bench-watch`
+# regresses against.
+bench-capacity:
+	JAX_PLATFORMS=cpu python tools/bench_capacity.py --out BENCH_serve.json
 
 # Memory-bounded precision A/B: f32 hand-picked single-bucket ladder vs
 # HBM-planned ladder + bf16 through the same trained canonical head.

@@ -664,6 +664,24 @@ class Config:
             "KEYSTONE_LINT", ("warn", "error", "off"), "off"
         )
     )
+    # Learned serving-capacity model (workflow/capacity.py) — re-plan
+    # cadence of the daemon's traffic-aware autoscaling loop, seconds.
+    # The loop wakes on this period, compares the observed bucket mix
+    # with the mix at the last re-plan, and re-sizes replicas /
+    # re-prices the ladder when the shift crosses its threshold. The
+    # same window backs the no-flap guard (a second re-plan inside one
+    # window is refused, counted). Env: KEYSTONE_CAPACITY_REPLAN_S.
+    capacity_replan_s: float = field(
+        default_factory=lambda: _env_float("KEYSTONE_CAPACITY_REPLAN_S", 5.0)
+    )
+    # Journeys the capacity model must observe before ANY consumer
+    # (predicted admission, autoscaling, micro-batching) acts on it;
+    # below this the model is "cold" and every consumer no-ops
+    # bit-identically to KEYSTONE_CAPACITY_MODEL=0 (counted as
+    # capacity.model_cold_skips). Env: KEYSTONE_CAPACITY_MIN_SAMPLES.
+    capacity_min_samples: int = field(
+        default_factory=lambda: _env_int("KEYSTONE_CAPACITY_MIN_SAMPLES", 64)
+    )
 
 
 config = Config()
@@ -738,6 +756,20 @@ def resolved_telemetry_dir() -> str | None:
     if "KEYSTONE_TELEMETRY_DIR" in os.environ:
         return os.environ["KEYSTONE_TELEMETRY_DIR"] or None
     return config.telemetry_dir or None
+
+
+def resolved_capacity_model() -> bool:
+    """Whether the learned serving-capacity model is enabled. Resolution
+    order (documented contract): an exported KEYSTONE_CAPACITY_MODEL
+    wins outright (env_flag spelling — '', '0', 'false', 'no' disable,
+    anything else enables); unset, the model defaults ON exactly when a
+    telemetry directory is configured (the model trains on and persists
+    through those segments — without them it would relearn from zero
+    every restart) and OFF otherwise. Lives here so the env read stays
+    inside config.py (keystone-lint KL003)."""
+    if "KEYSTONE_CAPACITY_MODEL" in os.environ:
+        return env_flag("KEYSTONE_CAPACITY_MODEL")
+    return resolved_telemetry_dir() is not None
 
 
 def resolved_profile_store() -> str | None:

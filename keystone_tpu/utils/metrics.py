@@ -1932,3 +1932,37 @@ class TelemetryCounters(CounterSet):
 telemetry_counters = TelemetryCounters()
 metrics_registry.register("telemetry", telemetry_counters)
 metrics_registry.register("tracer", _TracerLoss())
+
+
+class CapacityCounters(CounterSet):
+    """Process-wide learned-capacity-model observability
+    (workflow/capacity.py and its three consumers): every refusal,
+    coalesce, and re-plan the model drives is a counted decision —
+    nothing the model does to traffic is silent. Thread-safe
+    (CounterSet).
+
+    Well-known keys:
+
+    - ``predicted_refusals`` — requests 429'd because the model
+      predicted their completion past the deadline
+      (``predicted_infeasible``), before any device work
+    - ``microbatches_formed`` — gold-anchored flush groups that
+      absorbed at least one best-effort request into padding slack
+    - ``microbatch_rows_filled`` — best-effort rows served inside
+      gold groups' pad slack (free device time, measured)
+    - ``replans`` — autoscale re-plans executed (mix shift past the
+      threshold; decision-logged in the optimizer ring)
+    - ``replans_suppressed`` — re-plans refused by the no-flap guard
+      (a second trigger inside the re-plan window)
+    - ``replicas_resized`` — replica-pool grow/shrink operations the
+      re-plan loop performed
+    - ``model_cold_skips`` — consumer consultations that no-op'd
+      because the model had fewer than ``KEYSTONE_CAPACITY_MIN_SAMPLES``
+      journeys (the cold contract, measured)
+    - ``guard_violations`` — strict-accuracy guard hits: a refusal the
+      matured model would call feasible (a bug gate, not a tuning knob)
+    """
+
+
+capacity_counters = CapacityCounters()
+metrics_registry.register("capacity", capacity_counters)

@@ -59,6 +59,15 @@ Rule catalog (KG = Keystone Graph):
   ``KEYSTONE_ELASTIC_MESH=0`` — at resume time. Flagged up front from
   the directory's JSON sidecar (a static dict read: no unpickling, no
   orbax restore, no execution).
+- ``KG108 autoscale-pinned`` — a capacity model is enabled (telemetry
+  dir configured / ``KEYSTONE_CAPACITY_MODEL``) while the replica count
+  and/or the serve bucket ladder are hand-pinned
+  (``KEYSTONE_SERVE_DEVICES`` != 0 / ``KEYSTONE_SERVE_BUCKETS``): the
+  capacity re-plan loop refuses to touch pinned resources (pins win, by
+  contract), so the pin silently defeats the traffic-aware autoscaling
+  the model was enabled for. Same classifier discipline as KG104:
+  static config reads only, pinned configurations only — the un-pinned
+  defaults are exactly what the re-plan loop is allowed to size.
 - ``KG201 dead-node`` — a node in the graph unreachable from the sink
   (composition orphans the pruner should have dropped).
 - ``KG202 cache-advice`` — a non-trivial subchain re-used by >= 2
@@ -70,8 +79,8 @@ Rule catalog (KG = Keystone Graph):
 
 Severity model: serveability rules (KG00x) are *errors* when linting
 with ``serve=True`` (the pre-``compiled()`` gate) and *warnings*
-otherwise; KG101/KG102/KG103/KG104/KG105/KG106/KG107 are warnings;
-KG201/KG202/KG203 are info.
+otherwise; KG101/KG102/KG103/KG104/KG105/KG106/KG107/KG108 are
+warnings; KG201/KG202/KG203 are info.
 
 Wire-up: ``Pipeline.lint()`` runs this directly; the opt-in env gate
 ``KEYSTONE_LINT=warn|error|off`` (default off) runs it before every
@@ -117,6 +126,8 @@ GRAPH_RULES: Dict[str, str] = {
              "caller-owned input)",
     "KG107": "checkpoint_dir holds state recorded under a different mesh "
              "width",
+    "KG108": "capacity model enabled but replica count / serve ladder "
+             "hand-pinned (pin defeats autoscaling)",
     "KG201": "dead node unreachable from the pipeline sink",
     "KG202": "re-used subchain with no cache node",
     "KG203": "stored measured profile exists but auto-cache is model-only",
@@ -689,6 +700,42 @@ def lint_graph(
                     hint="lower KEYSTONE_SOLVE_CHUNK_ROWS, or unset it so "
                          "the profile-guided planner sizes the chunk",
                 ))
+
+    # -- KG108: capacity model enabled under hand-pinned resources ---------
+    # Static config reads only (the KG104 discipline): the pin/enable
+    # state is entirely resolvable without execution, and only PINNED
+    # configurations are flagged — the un-pinned defaults are exactly
+    # what the capacity re-plan loop is allowed to size, so they are the
+    # healthy configuration, not a finding.
+    from keystone_tpu.config import resolved_capacity_model
+
+    if resolved_capacity_model():
+        pins = []
+        if ladder:
+            pins.append(
+                f"serve bucket ladder {tuple(int(b) for b in ladder)} "
+                "(KEYSTONE_SERVE_BUCKETS / config.serve_buckets)"
+            )
+        if config.serve_devices != 0:
+            pins.append(
+                f"replica count {int(config.serve_devices)} "
+                "(KEYSTONE_SERVE_DEVICES)"
+            )
+        if pins:
+            emit(Diagnostic(
+                "KG108", "warning", "-",
+                "the learned capacity model is enabled "
+                "(KEYSTONE_CAPACITY_MODEL / telemetry dir configured) but "
+                f"{' and '.join(pins)} are hand-pinned: the capacity "
+                "re-plan loop refuses pinned resources by contract, so "
+                "traffic-aware autoscaling is silently defeated — the "
+                "model observes mix shifts it is never allowed to act on",
+                hint="unset the pin(s) so the re-plan loop can size the "
+                     "replica pool / re-price the ladder from the observed "
+                     "traffic mix, or disable the model "
+                     "(KEYSTONE_CAPACITY_MODEL=0) if the pins are "
+                     "intentional",
+            ))
 
     # -- KG105: refit-stream head without partial_fit ----------------------
     # Only under the refit contract (refit=True): a batch-only head is a

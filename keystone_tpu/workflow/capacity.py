@@ -1,0 +1,696 @@
+"""Learned serving-capacity model: the closing of ROADMAP cycle item 2.
+
+The fit path learned to price itself (profile store → planner); this
+module does the same for SERVING. A :class:`CapacityModel` is fitted
+online from the journey records the daemon already emits — per-(tier,
+bucket) latency quantiles from the accepted→…→resolved stamps, batch
+device-time quantiles from the service's dispatch→deliver leg, a
+per-tenant arrival-rate EWMA, and a decayed arrival histogram over the
+bucket ladder (the observed traffic *mix*) — and consulted by three
+hot-path consumers:
+
+- **Predicted-deadline admission** (daemon.py): refuse a request whose
+  predicted completion (current queue depth x modeled per-bucket batch
+  latency) already breaches its deadline, as a counted fast-fail 429
+  (``predicted_infeasible``) before any device work.
+- **Traffic-aware autoscaling** (daemon.py ``_replan_loop``): re-size
+  the replica pool and re-price the bucket ladder when the observed mix
+  shifts past a threshold, decision-logged through the optimizer ring.
+- **Deadline-aware cross-tenant micro-batching** (serving.py
+  ``_loop``): coalesce compatible best-effort requests into the padding
+  slack of gold-tier groups when the model predicts the combined batch
+  still makes the gold deadline.
+
+Cold contract: until ``min_samples`` journeys are observed the model
+reports not-ready and EVERY consumer no-ops (counted as
+``capacity.model_cold_skips``) — cold behavior is bit-identical to
+``KEYSTONE_CAPACITY_MODEL=0`` (test-pinned).
+
+Strict-accuracy guard: every refusal is recorded with its prediction
+inputs and re-validated post-hoc against the model as it learns — a
+refusal the matured model would call feasible is counted as a
+``guard_violation`` (a model that refuses feasible work is a bug gate,
+not a tuning knob).
+
+Persistence rides the PR-19 telemetry JSONL segments: ``save()`` emits
+one ``{"kind": "capacity"}`` snapshot record; ``load_capacity_model``
+scans the telemetry directory for the newest snapshot for this daemon
+and falls back to replaying the raw journey records, so a restarted
+daemon starts warm instead of re-learning from zero.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import math
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from keystone_tpu.utils.metrics import capacity_counters
+
+logger = logging.getLogger("keystone_tpu")
+
+#: Schema stamp on capacity snapshot records (forward-compat gate).
+SNAPSHOT_SCHEMA = 1
+
+#: Bounded per-key latency sample rings (quantiles over the newest N).
+SAMPLE_CAP = 512
+
+#: Arrival-rate EWMA smoothing (per observed inter-arrival gap).
+EWMA_ALPHA = 0.2
+
+#: Decay applied to the bucket arrival histogram per observation: the
+#: mix tracks the recent window, not all of history.
+MIX_DECAY = 0.995
+
+#: Bounded ring of refusals awaiting post-hoc guard validation.
+GUARD_CAP = 256
+#: Quantile the admission prediction (and the guard's re-validation —
+#: SAME constant, so pessimism beyond it still counts as a violation)
+#: prices each flush at: a request admitted at the p50 boundary is late
+#: half the time, so the estimate carries queue jitter.
+ADMIT_Q = 0.75
+
+#: Journey-replay bound at restore: a long-lived telemetry dir must not
+#: turn daemon construction into an unbounded scan.
+REPLAY_MAX_RECORDS = 20000
+
+
+class _Ring:
+    """Bounded sample ring with cached nearest-rank quantiles (the
+    bench's ``lat_stats`` convention: q in [0, 1], newest SAMPLE_CAP
+    samples)."""
+
+    __slots__ = ("cap", "samples", "_i", "_sorted")
+
+    def __init__(self, cap: int = SAMPLE_CAP):
+        self.cap = int(cap)
+        self.samples: List[float] = []
+        self._i = 0
+        self._sorted: Optional[List[float]] = None
+
+    def add(self, v: float) -> None:
+        v = float(v)
+        if len(self.samples) < self.cap:
+            self.samples.append(v)
+        else:
+            self.samples[self._i] = v
+            self._i = (self._i + 1) % self.cap
+        self._sorted = None
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def quantile(self, q: float) -> Optional[float]:
+        if not self.samples:
+            return None
+        if self._sorted is None:
+            self._sorted = sorted(self.samples)
+        s = self._sorted
+        k = min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))
+        return s[k]
+
+    def state(self) -> List[float]:
+        return list(self.samples)
+
+    def restore(self, samples) -> None:
+        self.samples = [float(v) for v in samples][-self.cap:]
+        self._i = 0
+        self._sorted = None
+
+
+class CapacityModel:
+    """Online per-(tier, bucket) latency/occupancy model (module
+    docstring has the architecture and the cold/guard contracts).
+
+    Thread-safe: observations arrive from ingress threads, the service's
+    completion threads, and the re-plan loop concurrently; every public
+    method takes the one internal lock and never calls out under it.
+    """
+
+    def __init__(self, name: str = "daemon",
+                 min_samples: Optional[int] = None):
+        from keystone_tpu.config import config
+
+        self.name = str(name)
+        self.min_samples = int(
+            config.capacity_min_samples if min_samples is None
+            else min_samples
+        )
+        self._lock = threading.Lock()
+        # Per-(tier, bucket) end-to-end service ms (daemon journey leg:
+        # submitted -> resolved; queue wait + device time as the tier
+        # actually experienced it).
+        self._lat: Dict[Tuple[str, int], _Ring] = {}
+        # Per-bucket device-batch ms (service leg: launch -> delivered),
+        # the admission/micro-batch prediction primitive.
+        self._batch: Dict[int, _Ring] = {}
+        # Per-tenant offered-rate EWMA (requests/s), from inter-arrival
+        # gaps at admission time — refusals included: this is offered
+        # load, not served load.
+        self._rate: Dict[str, float] = {}
+        self._last_arrival: Dict[str, float] = {}
+        # Decayed arrival histogram over buckets: the traffic mix.
+        self._mix: Dict[int, float] = {}
+        # Observed rows-per-flush EWMA: the queue's real drain rate.
+        # Flushes go out partially filled whenever the delay window
+        # closes first, so pricing the wait as depth / max_rows (perfect
+        # packing) systematically underestimates it under exactly the
+        # load where admission control matters. None until the first
+        # flush is observed (fall back to max_rows — the optimistic
+        # cold default, consistent with the guard's admit bias).
+        self._fill: Optional[float] = None
+        # Signed prediction-bias EWMA (ms): observed minus predicted
+        # over completed journeys that carried an admission prediction.
+        # The flush-cost model prices device time only; ingress parse,
+        # the flush delay window, and response writes are real wall
+        # clock a tight deadline must also survive. Feeding realized
+        # error back keeps the estimator mean-zero AT THE ADMITTED
+        # MARGIN, whichever way it drifts (the guard applies the same
+        # term, so the correction cannot smuggle in pessimism).
+        self._bias: Optional[float] = None
+        self._samples = 0
+        self._started = time.monotonic()
+        self._last_observe: Optional[float] = None
+        # Strict-accuracy guard state: refusals awaiting post-hoc
+        # validation, plus the violation count (the bug gate).
+        self._refusals: List[Dict[str, Any]] = []
+        #: Sample-count watermark of the EARLIEST pending re-validation:
+        #: the per-observation hot path compares one int instead of
+        #: scanning the whole refusal ring (None = nothing pending).
+        self._guard_at: Optional[int] = None
+        self.refusals = 0
+        self.guard_checked = 0
+        self.guard_violations = 0
+        # Predicted-vs-observed p99 per (tier, bucket): the /stats
+        # accuracy surface (prediction recorded at admit, observation at
+        # finish).
+        self._pred_p99: Dict[Tuple[str, int], _Ring] = {}
+
+    # -- observation channels ---------------------------------------------
+
+    def observe_arrival(self, tenant: str, now: Optional[float] = None
+                        ) -> None:
+        """One offered request from ``tenant`` (called at admission,
+        before any accept/refuse decision)."""
+        now = time.monotonic() if now is None else float(now)
+        key = str(tenant)
+        with self._lock:
+            last = self._last_arrival.get(key)
+            self._last_arrival[key] = now
+            if last is None or now <= last:
+                return
+            rate = 1.0 / (now - last)
+            prev = self._rate.get(key)
+            self._rate[key] = (
+                rate if prev is None
+                else (1.0 - EWMA_ALPHA) * prev + EWMA_ALPHA * rate
+            )
+
+    def observe_journey(self, tier: str, tenant: str, rows: int,
+                        bucket: Optional[int], service_ms: Optional[float],
+                        outcome: str = "ok",
+                        predicted_ms: Optional[float] = None) -> None:
+        """One finished daemon journey: per-(tier, bucket) latency
+        sample, mix histogram update, sample count, and a post-hoc pass
+        over pending refusal validations."""
+        b = int(bucket) if bucket else 0
+        with self._lock:
+            self._samples += 1
+            self._last_observe = time.monotonic()
+            decayed = {}
+            for k, v in self._mix.items():
+                v *= MIX_DECAY
+                if v > 1e-3:
+                    decayed[k] = v
+            decayed[b] = decayed.get(b, 0.0) + 1.0
+            self._mix = decayed
+            if service_ms is not None and service_ms >= 0 and outcome == "ok":
+                ring = self._lat.get((tier, b))
+                if ring is None:
+                    ring = self._lat[(tier, b)] = _Ring()
+                ring.add(service_ms)
+                if predicted_ms is not None:
+                    pring = self._pred_p99.get((tier, b))
+                    if pring is None:
+                        pring = self._pred_p99[(tier, b)] = _Ring()
+                    pring.add(predicted_ms)
+                    err = float(service_ms) - float(predicted_ms)
+                    self._bias = (
+                        err if self._bias is None
+                        else (1.0 - EWMA_ALPHA) * self._bias
+                        + EWMA_ALPHA * err
+                    )
+            if self._guard_at is not None and self._samples >= self._guard_at:
+                self._validate_refusals_locked()
+
+    def observe_batch(self, bucket: Optional[int], rows: int,
+                      device_ms: float) -> None:
+        """One completed device batch from the service (launch ->
+        delivered), keyed by the bucket rung it padded to."""
+        if bucket is None or device_ms < 0:
+            return
+        with self._lock:
+            ring = self._batch.get(int(bucket))
+            if ring is None:
+                ring = self._batch[int(bucket)] = _Ring()
+            ring.add(float(device_ms))
+            if rows > 0:
+                self._fill = (
+                    float(rows) if self._fill is None
+                    else (1.0 - EWMA_ALPHA) * self._fill
+                    + EWMA_ALPHA * float(rows)
+                )
+
+    # -- readiness ---------------------------------------------------------
+
+    def ready(self) -> bool:
+        """True once enough journeys were observed for predictions to be
+        trustworthy; until then every consumer must no-op (the cold
+        contract — bit-identical to model-off, counted)."""
+        with self._lock:
+            return self._samples >= self.min_samples
+
+    def samples(self) -> int:
+        with self._lock:
+            return self._samples
+
+    # -- prediction --------------------------------------------------------
+
+    def _batch_ms_locked(self, bucket: int, q: float) -> Optional[float]:
+        ring = self._batch.get(bucket)
+        if ring is not None and len(ring):
+            return ring.quantile(q)
+        # Nearest observed rung, scaled by the row ratio (row-linear
+        # device cost — the ladder's pricing assumption).
+        best = None
+        for b, r in self._batch.items():
+            if not len(r):
+                continue
+            d = abs(math.log((b or 1) / max(bucket, 1)))
+            if best is None or d < best[0]:
+                best = (d, b, r)
+        if best is not None:
+            _, b, r = best
+            v = r.quantile(q)
+            if v is not None:
+                return v * max(bucket, 1) / max(b, 1)
+        return None
+
+    def _drain_batches_locked(self, queue_depth: int, max_rows: int) -> int:
+        """Flushes needed to drain ``queue_depth`` rows plus one for the
+        request itself, at the OBSERVED rows-per-flush rate (partial
+        flushes drain the queue slower than perfect ``max_rows`` packing
+        would; cold fill falls back to ``max_rows`` — optimistic, so a
+        cold-ish model under-refuses rather than over-refuses)."""
+        mr = max(1, int(max_rows))
+        fill = mr if self._fill is None else min(float(mr),
+                                                 max(1.0, self._fill))
+        return 1 + int(max(0, int(queue_depth)) / fill)
+
+    def _lat_ms_locked(self, tier: str, bucket: int,
+                       q: float) -> Optional[float]:
+        ring = self._lat.get((tier, bucket))
+        if ring is not None and len(ring):
+            return ring.quantile(q)
+        # Any bucket of this tier, then any tier at all.
+        for (t, _b), r in self._lat.items():
+            if t == tier and len(r):
+                return r.quantile(q)
+        for r in self._lat.values():
+            if len(r):
+                return r.quantile(q)
+        return None
+
+    def predict_completion_ms(self, tier: str, rows: int, queue_depth: int,
+                              max_rows: int, bucket: Optional[int] = None
+                              ) -> Optional[Dict[str, Any]]:
+        """Predicted completion for a request arriving NOW: the queued
+        rows ahead of it drain at the OBSERVED rows-per-flush rate (see
+        ``_drain_batches_locked`` — partial flushes drain slower than
+        perfect ``max_rows`` packing), each flush costing the modeled
+        per-bucket batch latency at ``ADMIT_Q`` (p75 — see the
+        constant: the p50 boundary is a coin flip, and the guard
+        re-validates refusals at the same quantile). None when the
+        model is cold or has no usable latency data yet.
+
+        The batch cost is keyed by the request's EFFECTIVE flush bucket:
+        a request joining a non-empty queue coalesces with the rows
+        ahead of it, so its own flush fills toward ``max_rows`` and its
+        device cost is the full bucket's — pricing a 1-row request in a
+        deep queue at the solo 1-row rung would systematically
+        underestimate exactly when admission control matters most."""
+        rows = max(1, int(rows))
+        mr = max(1, int(max_rows))
+        eff = min(mr, rows + max(0, int(queue_depth)))
+        b = max(int(bucket) if bucket else 0, eff)
+        with self._lock:
+            if self._samples < self.min_samples:
+                return None
+            batch_ms = self._batch_ms_locked(b, ADMIT_Q)
+            if batch_ms is None:
+                lat = self._lat_ms_locked(tier, b, ADMIT_Q)
+                if lat is None:
+                    return None
+                batch_ms = lat
+            batches_ahead = self._drain_batches_locked(queue_depth, mr)
+            bias = self._bias or 0.0
+            predicted = batches_ahead * batch_ms + bias
+            return {
+                "predicted_ms": float(predicted),
+                "batch_ms": float(batch_ms),
+                "batches_ahead": int(batches_ahead),
+                "bias_ms": float(bias),
+                "bucket": b,
+                "queue_depth": int(queue_depth),
+            }
+
+    def predict_batch_ms(self, bucket: int, q: float = 0.99
+                         ) -> Optional[float]:
+        """Modeled device-batch latency at a rung (micro-batching's
+        feasibility primitive; p99 by default — a gold deadline must
+        survive the combined batch's tail, not its median)."""
+        with self._lock:
+            if self._samples < self.min_samples:
+                return None
+            return self._batch_ms_locked(int(bucket), q)
+
+    # -- strict-accuracy guard --------------------------------------------
+
+    def note_refusal(self, tier: str, rows: int, queue_depth: int,
+                     max_rows: int, deadline_ms: float, predicted_ms: float,
+                     trace_id: Optional[str] = None,
+                     bucket: Optional[int] = None) -> None:
+        """Record one predicted-infeasible refusal for post-hoc
+        validation (bounded ring; validated as observations arrive).
+        ``bucket`` is the effective flush bucket the prediction priced
+        (so the guard re-validates the same estimate, not a different
+        one)."""
+        with self._lock:
+            self.refusals += 1
+            check_at = max(self._samples + self.min_samples,
+                           self._samples * 2)
+            self._refusals.append({
+                "tier": str(tier),
+                "rows": int(rows),
+                "queue_depth": int(queue_depth),
+                "max_rows": int(max_rows),
+                "deadline_ms": float(deadline_ms),
+                "predicted_ms": float(predicted_ms),
+                # Bias AS OF the refusal: the guard re-validates with
+                # maturer QUANTILES but this frozen bias — the live bias
+                # tracks the operating regime, and a refusal that
+                # shallowed the queue must not be judged against the
+                # healthy regime it created (the admission paradox).
+                "bias_ms": float(self._bias or 0.0),
+                "bucket": int(bucket) if bucket else None,
+                "trace_id": trace_id,
+                "samples_at": self._samples,
+                "check_at": check_at,
+            })
+            if len(self._refusals) > GUARD_CAP:
+                del self._refusals[: len(self._refusals) - GUARD_CAP]
+            if self._guard_at is None or check_at < self._guard_at:
+                self._guard_at = check_at
+
+    def _validate_refusals_locked(self) -> None:
+        """Re-run each pending refusal's prediction against the model as
+        it stands NOW: once fresh observations have doubled the evidence
+        since the refusal, a prediction that flipped to feasible counts
+        as a guard violation — the refusal denied work the model itself
+        now calls servable."""
+        if not self._refusals:
+            self._guard_at = None
+            return
+        keep = []
+        for ref in self._refusals:
+            if self._samples < ref.get("check_at", max(
+                    ref["samples_at"] + self.min_samples,
+                    ref["samples_at"] * 2)):
+                keep.append(ref)
+                continue
+            self.guard_checked += 1
+            b = ref.get("bucket") or min(
+                max(1, ref["max_rows"]),
+                max(1, ref["rows"]) + max(0, ref["queue_depth"]),
+            )
+            batch_ms = self._batch_ms_locked(b, ADMIT_Q)
+            if batch_ms is None:
+                batch_ms = self._lat_ms_locked(ref["tier"], b, ADMIT_Q)
+            if batch_ms is None:
+                continue
+            batches = self._drain_batches_locked(
+                ref["queue_depth"], ref["max_rows"])
+            predicted_now = (batches * batch_ms
+                             + float(ref.get("bias_ms") or 0.0))
+            if predicted_now <= ref["deadline_ms"]:
+                self.guard_violations += 1
+                capacity_counters.bump("guard_violations")
+                logger.warning(
+                    "capacity model %s: STRICT-ACCURACY GUARD — refusal "
+                    "(trace %s, tier %s, depth %d, predicted %.1fms > "
+                    "deadline %.1fms) would be FEASIBLE under the matured "
+                    "model (%.1fms); the model refused servable work",
+                    self.name, ref["trace_id"], ref["tier"],
+                    ref["queue_depth"], ref["predicted_ms"],
+                    ref["deadline_ms"], predicted_now,
+                )
+        self._refusals = keep
+        self._guard_at = (
+            min(ref.get("check_at", 0) for ref in keep) if keep else None
+        )
+
+    # -- traffic mix / rates ----------------------------------------------
+
+    def traffic_mix(self) -> Dict[int, float]:
+        """Observed arrival mix over buckets, normalized to fractions
+        (decayed — the recent window, not all history)."""
+        with self._lock:
+            total = sum(self._mix.values())
+            if total <= 0:
+                return {}
+            return {b: v / total for b, v in sorted(self._mix.items())}
+
+    def arrival_rate(self, tenant: Optional[str] = None) -> float:
+        """EWMA offered rate (requests/s): one tenant's, or the sum."""
+        with self._lock:
+            if tenant is not None:
+                return float(self._rate.get(str(tenant), 0.0))
+            return float(sum(self._rate.values()))
+
+    @staticmethod
+    def mix_shift(a: Dict[int, float], b: Dict[int, float]) -> float:
+        """Total-variation distance between two bucket mixes in [0, 1]
+        (the re-plan trigger metric)."""
+        keys = set(a) | set(b)
+        return 0.5 * sum(abs(a.get(k, 0.0) - b.get(k, 0.0)) for k in keys)
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self, redact_tenants: bool = False) -> Dict[str, Any]:
+        """The /stats ``capacity`` payload: freshness, per-bucket
+        predicted-vs-observed p99, guard accounting. Tenant names follow
+        the SLO redaction contract — anonymous callers see rates
+        collapsed under ``"*"``."""
+        with self._lock:
+            per_bucket: Dict[str, Any] = {}
+            for (tier, b), ring in sorted(self._lat.items()):
+                key = f"{tier}:{b}"
+                pred = self._pred_p99.get((tier, b))
+                per_bucket[key] = {
+                    "observed_p99_ms": ring.quantile(0.99),
+                    "observed_p50_ms": ring.quantile(0.5),
+                    "predicted_p99_ms": (
+                        pred.quantile(0.99) if pred is not None and len(pred)
+                        else None
+                    ),
+                    "samples": len(ring),
+                }
+            batch = {
+                str(b): {"p50_ms": r.quantile(0.5), "p99_ms": r.quantile(0.99),
+                         "samples": len(r)}
+                for b, r in sorted(self._batch.items())
+            }
+            if redact_tenants:
+                rates = {"*": float(sum(self._rate.values()))}
+            else:
+                rates = {k: float(v) for k, v in sorted(self._rate.items())}
+            total = sum(self._mix.values())
+            return {
+                "samples": self._samples,
+                "min_samples": self.min_samples,
+                "ready": self._samples >= self.min_samples,
+                "age_s": time.monotonic() - self._started,
+                "staleness_s": (
+                    time.monotonic() - self._last_observe
+                    if self._last_observe is not None else None
+                ),
+                "per_bucket": per_bucket,
+                "batch_ms": batch,
+                "fill_rows": self._fill,
+                "bias_ms": self._bias,
+                "arrival_rate_per_s": rates,
+                "traffic_mix": {
+                    str(b): v / total for b, v in sorted(self._mix.items())
+                } if total > 0 else {},
+                "refusals": self.refusals,
+                "guard_checked": self.guard_checked,
+                "guard_violations": self.guard_violations,
+            }
+
+    # -- persistence (PR-19 telemetry segments) ----------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The durable model state (everything restore() needs; the
+        monotonic-clock fields — arrival stamps, freshness — are
+        process-local and deliberately NOT persisted)."""
+        with self._lock:
+            return {
+                "schema": SNAPSHOT_SCHEMA,
+                "samples": self._samples,
+                "min_samples": self.min_samples,
+                "lat": {
+                    f"{t}:{b}": r.state()
+                    for (t, b), r in self._lat.items()
+                },
+                "batch": {str(b): r.state() for b, r in self._batch.items()},
+                "rate": {k: float(v) for k, v in self._rate.items()},
+                "mix": {str(b): float(v) for b, v in self._mix.items()},
+                "fill": self._fill,
+                "bias": self._bias,
+            }
+
+    def restore(self, snap: Dict[str, Any]) -> bool:
+        """Load a snapshot() payload; False (and untouched state) on a
+        schema/shape mismatch — a corrupt segment must not poison a
+        fresh model."""
+        try:
+            if int(snap.get("schema", -1)) != SNAPSHOT_SCHEMA:
+                return False
+            lat = {}
+            for key, samples in dict(snap.get("lat", {})).items():
+                tier, _, b = key.rpartition(":")
+                ring = _Ring()
+                ring.restore(samples)
+                lat[(tier, int(b))] = ring
+            batch = {}
+            for b, samples in dict(snap.get("batch", {})).items():
+                ring = _Ring()
+                ring.restore(samples)
+                batch[int(b)] = ring
+            rate = {str(k): float(v)
+                    for k, v in dict(snap.get("rate", {})).items()}
+            mix = {int(b): float(v)
+                   for b, v in dict(snap.get("mix", {})).items()}
+            fill_raw = snap.get("fill")
+            fill = None if fill_raw is None else float(fill_raw)
+            bias_raw = snap.get("bias")
+            bias = None if bias_raw is None else float(bias_raw)
+            samples = int(snap["samples"])
+        except (KeyError, TypeError, ValueError):
+            return False
+        with self._lock:
+            self._lat = lat
+            self._batch = batch
+            self._rate = rate
+            self._mix = mix
+            self._fill = fill
+            self._bias = bias
+            self._samples = samples
+        return True
+
+    def save(self, telemetry, service: Optional[str] = None) -> None:
+        """Emit one durable snapshot record onto the telemetry log's
+        bounded queue (never blocks; drops are counted by the log)."""
+        if telemetry is None:
+            return
+        telemetry.emit({
+            "kind": "capacity",
+            "service": service or f"daemon-{self.name}",
+            "pid": telemetry.pid,
+            "model": self.snapshot(),
+        })
+
+    def replay_journey(self, journey: Dict[str, Any]) -> None:
+        """Warm from one exported journey record (the restore fallback:
+        no snapshot found, raw journeys replayed instead)."""
+        meta = journey.get("meta") or {}
+        phases = {
+            p.get("phase"): p.get("t_ns")
+            for p in journey.get("phases", ())
+            if isinstance(p, dict)
+        }
+        t_sub, t_res = phases.get("submitted"), phases.get("resolved")
+        service_ms = (
+            (t_res - t_sub) / 1e6
+            if t_sub is not None and t_res is not None else None
+        )
+        self.observe_journey(
+            tier=str(meta.get("tier", "best_effort")),
+            tenant=str(meta.get("tenant", "anonymous")),
+            rows=int(journey.get("rows") or 1),
+            bucket=journey.get("bucket"),
+            service_ms=service_ms,
+            outcome=str(journey.get("outcome") or "ok"),
+        )
+
+
+def load_capacity_model(directory: Optional[str], name: str,
+                        min_samples: Optional[int] = None) -> CapacityModel:
+    """Build a CapacityModel, warm-started from the telemetry segments
+    in ``directory`` when possible: the NEWEST ``{"kind": "capacity"}``
+    snapshot for ``daemon-{name}`` wins; with no snapshot, the raw
+    journey records for that daemon are replayed (bounded). Unreadable
+    files and undecodable lines are skipped — restore is best-effort by
+    contract; the model relearns whatever the segments failed to carry."""
+    model = CapacityModel(name=name, min_samples=min_samples)
+    if not directory or not os.path.isdir(directory):
+        return model
+    service = f"daemon-{name}"
+    best_snap: Optional[Dict[str, Any]] = None
+    journeys: List[Dict[str, Any]] = []
+    paths = sorted(
+        glob.glob(os.path.join(directory, "keystone_telemetry_*.jsonl")),
+        key=lambda p: (os.path.getmtime(p), p),
+    )
+    for path in paths:
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                for line in fh:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail line of a crashed writer
+                    if rec.get("service") != service:
+                        continue
+                    kind = rec.get("kind")
+                    if kind == "capacity" and isinstance(
+                        rec.get("model"), dict
+                    ):
+                        best_snap = rec["model"]  # newest-by-order wins
+                    elif kind == "journey" and isinstance(
+                        rec.get("journey"), dict
+                    ):
+                        journeys.append(rec["journey"])
+                        if len(journeys) > REPLAY_MAX_RECORDS:
+                            del journeys[: len(journeys) // 2]
+        except OSError:
+            continue
+    if best_snap is not None and model.restore(best_snap):
+        logger.info(
+            "capacity model %s: restored snapshot (%d samples) from "
+            "telemetry segments in %s", name, model.samples(), directory,
+        )
+        return model
+    for j in journeys:
+        model.replay_journey(j)
+    if journeys:
+        logger.info(
+            "capacity model %s: warmed from %d exported journey record(s) "
+            "in %s (no snapshot found)", name, len(journeys), directory,
+        )
+    return model

@@ -29,6 +29,20 @@ ingress over the existing replica-pool service:
   HTTP 504 (``DeadlineExceeded``), a full service queue as 429, a
   closed/mid-flip service as 503.
 
+- **Learned capacity loop** (workflow/capacity.py, the serving analog
+  of the learned TPU cost model in arXiv:2008.01040): when
+  ``KEYSTONE_CAPACITY_MODEL`` resolves on, a per-(tier, bucket)
+  latency/occupancy model fitted from this daemon's own journey records
+  adds a fourth admission leg — refuse a request whose PREDICTED
+  completion (queue depth x modeled batch latency) already breaches its
+  deadline (counted 429, ``predicted_infeasible``) — drives a
+  traffic-aware autoscale loop (``_replan_loop``: replica resize +
+  mix-driven ladder re-price through the PR-13 planner, no-flap
+  guarded), and prices the service's deadline-aware cross-tenant
+  micro-batching. Cold model (fewer than
+  ``KEYSTONE_CAPACITY_MIN_SAMPLES`` journeys) = every consumer no-ops,
+  bit-identical to model-off.
+
 - **Fit→serve handoff + zero-downtime hot-swap.** The daemon serves one
   :class:`~keystone_tpu.workflow.serialization.ModelArtifact` at a time,
   tagged with an atomic generation counter. ``request_swap(path)`` (or
@@ -93,14 +107,22 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from keystone_tpu.config import config
+from keystone_tpu.config import (
+    config,
+    resolved_capacity_model,
+    resolved_telemetry_dir,
+)
 from keystone_tpu.utils.flight_recorder import (
     FlightRecord,
     FlightRecorder,
     derive_health,
     next_request_id,
 )
-from keystone_tpu.utils.metrics import active_tracer, metrics_registry
+from keystone_tpu.utils.metrics import (
+    active_tracer,
+    capacity_counters,
+    metrics_registry,
+)
 from keystone_tpu.utils.telemetry import (
     TRACE_ID_RE,
     SloAccounting,
@@ -120,9 +142,11 @@ from keystone_tpu.workflow.serialization import (
     ModelArtifact,
     load_artifact,
 )
+from keystone_tpu.workflow.capacity import CapacityModel, load_capacity_model
 from keystone_tpu.workflow.serving import (
     CompiledPipeline,
     PipelineService,
+    bucket_for,
     resolve_serve_devices,
 )
 
@@ -148,6 +172,11 @@ RESULT_TIMEOUT_S = 60.0
 SUBMIT_ATTEMPTS = 4
 
 VALID_TIERS = ("gold", "best_effort")
+
+#: Observed-mix total-variation shift that triggers an autoscale
+#: re-plan (workflow/capacity.py consumers; tuned against the
+#: bench_capacity shifting-mix flood).
+REPLAN_MIX_SHIFT = 0.25
 
 #: HTTP status → journey/counter outcome for data-plane responses.
 STATUS_OUTCOMES = {
@@ -270,6 +299,13 @@ class AdmissionController:
                 f"pending budget must be >= 1, got {self.pending_budget}"
             )
         self.be_frac = float(be_frac)
+        # Per-tier pending limits, hoisted OUT of the admit hot path:
+        # both are pure functions of construction-time knobs, and
+        # admit() runs once per request on every ingress thread.
+        self._tier_limits = {
+            "gold": self.pending_budget,
+            "best_effort": max(1, int(self.pending_budget * self.be_frac)),
+        }
         self._anonymous = Tenant("anonymous", None, qps=0.0,
                                  tier="best_effort")
         self._buckets = {
@@ -303,10 +339,7 @@ class AdmissionController:
                     f"({tenant.qps:g}/s, burst {tenant.burst:g}) exhausted; "
                     "request rejected fast"
                 )
-        limit = (
-            self.pending_budget if tenant.tier == "gold"
-            else max(1, int(self.pending_budget * self.be_frac))
-        )
+        limit = self._tier_limits.get(tenant.tier, self.pending_budget)
         with self._lock:
             if self._inflight >= limit:
                 self.rejected_budget += 1
@@ -334,6 +367,9 @@ class AdmissionController:
                 "tenants": [t.as_dict() for t in self.tenants.values()],
                 "pending_budget": self.pending_budget,
                 "be_frac": self.be_frac,
+                # Both tier limits, explicit: operators should not have
+                # to re-derive the best-effort share from be_frac.
+                "tier_budgets": dict(self._tier_limits),
                 "inflight": self._inflight,
                 "admitted": self.admitted,
                 "rejected_auth": self.rejected_auth,
@@ -812,6 +848,25 @@ class ServingDaemon:
         metrics_registry.part(
             f"daemon.slo[{self.name}]", _SloGauges
         ).source = self._slo
+        # Learned capacity model (workflow/capacity.py), resolved ONCE
+        # per daemon: None = disabled (KEYSTONE_CAPACITY_MODEL resolution
+        # order lives in config.resolved_capacity_model), and every
+        # consumer — predicted admission, the re-plan loop, the
+        # service's micro-batcher — no-ops on None. Warm-started from
+        # the telemetry segments when they exist, so a restarted daemon
+        # predicts from its predecessor's observations.
+        self._capacity: Optional[CapacityModel] = (
+            load_capacity_model(resolved_telemetry_dir(), self.name)
+            if resolved_capacity_model() else None
+        )
+        # Autoscale re-plan state (the traffic-aware consumer): the mix
+        # snapshot the last re-plan acted on, the no-flap stamp, and the
+        # last decision for /stats.
+        self._replan_stop = threading.Event()
+        self._replan_thread: Optional[threading.Thread] = None
+        self._capacity_last_mix: Dict[int, float] = {}
+        self._last_replan_t = 0.0
+        self._last_replan: Optional[Dict[str, Any]] = None
         self._lock = threading.Lock()
         self._active: set = set()
         self._draining = False
@@ -869,6 +924,12 @@ class ServingDaemon:
             daemon=True,
         )
         self._swap_thread.start()
+        if self._capacity is not None:
+            self._replan_thread = threading.Thread(
+                target=self._replan_loop,
+                name=f"keystone-daemon-replan-{self.name}", daemon=True,
+            )
+            self._replan_thread.start()
         try:
             self._start_http(
                 config.serve_port if http_port is None else int(http_port)
@@ -910,6 +971,7 @@ class ServingDaemon:
             inflight=self._inflight_opt,
             name=f"{self.name}-g{number}",
             flight_dir=self._flight_dir,
+            capacity=self._capacity,
         )
 
     def _start_http(self, port: int) -> None:
@@ -1079,19 +1141,29 @@ class ServingDaemon:
         return plan is not None and plan.check("conn_drop")
 
     def admit_request(
-        self, rec: FlightRecord, key: Optional[str]
+        self, rec: FlightRecord, key: Optional[str],
+        deadline_ms: Optional[float] = None,
     ) -> Tuple[Optional[Tenant], Optional[Tuple[int, Dict[str, Any], str]]]:
         """Admission for one journey: ``(tenant, None)`` on success —
         journey stamped ``admitted``, slot taken — or
         ``(None, (status, doc, outcome))`` on rejection. Side-effect-ful
-        (quota token + budget slot), so call exactly once per request."""
+        (quota token + budget slot), so call exactly once per request.
+
+        Admission chain order: auth (403) → tenant quota (429) → pending
+        budget (429) → predicted deadline (429 ``predicted_infeasible``
+        — only with a warm capacity model; the refused slot is released
+        before returning, so a refusal costs no budget). ``deadline_ms``
+        is the caller's explicit deadline when its transport already
+        parsed one (the framed socket); the HTTP pre-admission path
+        passes None and the tier default applies."""
         rid = rec.rid
 
-        def rej(status: int, kind: str, message: str):
+        def rej(status: int, kind: str, message: str,
+                outcome: Optional[str] = None):
             return None, (status, {
                 "error": kind, "message": str(message)[:500],
                 "request_id": rid, "trace_id": trace_of(rec),
-            }, STATUS_OUTCOMES.get(status, "error"))
+            }, outcome or STATUS_OUTCOMES.get(status, "error"))
 
         try:
             tenant = self._admission.admit(key)
@@ -1102,8 +1174,70 @@ class ServingDaemon:
         except QueueFullError as e:
             return rej(429, "budget", str(e))
         rec.note(tenant=tenant.name, tier=tenant.tier)
+        model = self._capacity
+        if model is not None:
+            # Offered-rate EWMA per tenant: fed at admission so the
+            # autoscaler sees load the moment it arrives, not a full
+            # journey later.
+            model.observe_arrival(tenant.name)
+            rejection = self._predict_admission(rec, tenant, deadline_ms,
+                                                model, rej)
+            if rejection is not None:
+                return rejection
         rec.stamp("admitted")
         return tenant, None
+
+    def _predict_admission(self, rec: FlightRecord, tenant: Tenant,
+                           deadline_ms: Optional[float],
+                           model: CapacityModel, rej):
+        """The predicted-deadline admission leg: refuse (a counted 429,
+        ``predicted_infeasible``, never silent) when the model predicts
+        this request's completion past its deadline — BEFORE any device
+        work. Cold model = no-op (counted); a refusal releases the
+        admission slot admit() just took and is recorded for the model's
+        strict-accuracy guard."""
+        if not model.ready():
+            capacity_counters.bump("model_cold_skips")
+            return None
+        if deadline_ms is None:
+            eff_deadline = float(self._tier_deadline_ms[tenant.tier])
+        else:
+            try:
+                eff_deadline = float(deadline_ms)
+            except (TypeError, ValueError):
+                return None  # garbage deadline: the 400 path owns it
+        if eff_deadline <= 0:
+            return None  # no deadline, nothing to breach
+        _closed, g = self._route(tenant)
+        svc = g.service
+        depth = svc.queue_depth()
+        pred = model.predict_completion_ms(
+            tenant.tier, max(1, rec.rows), depth, svc.max_rows,
+            bucket=bucket_for(max(1, rec.rows), g.engine.ladder),
+        )
+        if pred is None:
+            return None
+        rec.note(predicted_ms=round(pred["predicted_ms"], 3))
+        if pred["predicted_ms"] <= eff_deadline:
+            return None
+        capacity_counters.bump("predicted_refusals")
+        model.note_refusal(
+            tenant.tier, max(1, rec.rows), depth, svc.max_rows,
+            eff_deadline, pred["predicted_ms"], trace_id=trace_of(rec),
+            bucket=pred["bucket"],
+        )
+        # The slot admit() took goes straight back: this request is
+        # refused with None tenant, so finish_request will NOT release.
+        self._admission.release()
+        return rej(
+            429, "predicted_infeasible",
+            f"predicted completion {pred['predicted_ms']:.0f}ms breaches "
+            f"the {eff_deadline:.0f}ms deadline before any device work "
+            f"({pred['batches_ahead']} batch(es) ahead at "
+            f"{pred['batch_ms']:.1f}ms modeled bucket-{pred['bucket']} "
+            "latency); request refused fast",
+            outcome="predicted_infeasible",
+        )
 
     def serve_request(
         self, rec: FlightRecord, key: Optional[str], x_payload: Any,
@@ -1123,7 +1257,7 @@ class ServingDaemon:
         # body and passes the tenant in; the framed-socket ingress must
         # read its frame regardless (to stay in sync) and admits here.
         if tenant is None:
-            tenant, rejection = self.admit_request(rec, key)
+            tenant, rejection = self.admit_request(rec, key, deadline_ms)
             if rejection is not None:
                 status, doc, outcome = rejection
                 return status, doc, None, outcome
@@ -1205,7 +1339,8 @@ class ServingDaemon:
                 # the service notes it on its own journey and stamps it
                 # onto every tracer span for this request.
                 fut = g.service.submit(x, deadline_ms=remaining_ms,
-                                       trace_id=trace_of(rec))
+                                       trace_id=trace_of(rec),
+                                       tier=tenant.tier)
             except QueueFullError as e:
                 return terr(429, "queue_full", str(e))
             except DeadlineExceeded as e:
@@ -1280,6 +1415,8 @@ class ServingDaemon:
         if tel is not None:
             tel.journey(f"daemon-{self.name}", rec)
         if tenant is not None:
+            if self._capacity is not None:
+                self._observe_capacity(rec, tenant, outcome)
             self._admission.release()
             if outcome == "ok":
                 t0 = rec.phases[0][1]
@@ -1290,6 +1427,184 @@ class ServingDaemon:
             self._active.discard(rec.rid)
             self._inflight_gauge.set(len(self._active))
         self._flight.poll()
+
+    def _observe_capacity(self, rec: FlightRecord, tenant: Tenant,
+                          outcome: str) -> None:
+        """Feed one finished journey into the capacity model: the
+        submitted→resolved leg (queue wait + device time as the tier
+        experienced it), the bucket its rows pad to on the live ladder,
+        and — when predicted-deadline admission priced it — the
+        prediction, for the /stats predicted-vs-observed surface."""
+        model = self._capacity
+        if model is None:
+            return
+        t_sub = t_res = None
+        for phase, t_ns in rec.phases:
+            if phase == "submitted" and t_sub is None:
+                t_sub = t_ns
+            elif phase == "resolved":
+                t_res = t_ns
+        service_ms = (
+            (t_res - t_sub) / 1e6
+            if t_sub is not None and t_res is not None else None
+        )
+        meta = rec.meta or {}
+        model.observe_journey(
+            tier=tenant.tier,
+            tenant=tenant.name,
+            rows=max(1, rec.rows),
+            bucket=rec.bucket if rec.bucket else bucket_for(
+                max(1, rec.rows), self._gen.engine.ladder
+            ),
+            service_ms=service_ms,
+            outcome=outcome,
+            predicted_ms=meta.get("predicted_ms"),
+        )
+
+    # -- traffic-aware autoscaling (capacity re-plan loop) -------------------
+
+    def _replan_loop(self) -> None:
+        """The autoscale worker: wake every ``KEYSTONE_CAPACITY_REPLAN_S``
+        seconds, compare the observed bucket mix with the mix the last
+        re-plan acted on, and re-size the replica pool / re-price the
+        ladder when the shift crosses ``REPLAN_MIX_SHIFT``. Never dies:
+        a re-plan failure is logged and the next tick retries."""
+        period = max(0.1, float(config.capacity_replan_s))
+        while not self._replan_stop.wait(period):
+            try:
+                self._maybe_replan()
+            except Exception:  # lint: broad-ok a re-plan failure must not kill the loop; the daemon keeps serving on the old plan
+                logger.exception(
+                    "daemon %s: capacity re-plan failed; serving "
+                    "continues on the previous plan", self.name,
+                )
+
+    def _maybe_replan(self) -> None:
+        """One autoscale evaluation (called from the re-plan loop and,
+        in tests, directly): cold model and too-small mix shifts no-op;
+        a triggered re-plan inside the no-flap window is refused and
+        counted; an executed re-plan resizes replicas toward the
+        offered-load estimate, re-prices the ladder from the observed
+        mix through the PR-13 planner, and decision-logs itself."""
+        from keystone_tpu.workflow.rules import record_decision
+
+        model = self._capacity
+        if model is None:
+            return
+        if not model.ready():
+            capacity_counters.bump("model_cold_skips")
+            return
+        mix = model.traffic_mix()
+        if not mix:
+            return
+        if not self._capacity_last_mix:
+            # First warm tick: baseline the mix, nothing to compare yet.
+            self._capacity_last_mix = mix
+            return
+        shift = CapacityModel.mix_shift(mix, self._capacity_last_mix)
+        if shift < REPLAN_MIX_SHIFT:
+            return
+        now = time.monotonic()
+        window = 2.0 * max(0.1, float(config.capacity_replan_s))
+        if now - self._last_replan_t < window:
+            # No-flap guard: two consecutive re-plans within the window
+            # refuse — counted and decision-logged, never silent.
+            capacity_counters.bump("replans_suppressed")
+            record_decision(
+                rule="CapacityReplan", node=self.name,
+                action="suppress",
+                provenance="capacity",
+                reason=(
+                    f"mix shifted {shift:.2f} but the last re-plan ran "
+                    f"{now - self._last_replan_t:.1f}s ago (no-flap "
+                    f"window {window:.1f}s)"
+                ),
+            )
+            return
+        g = self._gen
+        svc, engine = g.service, g.engine
+        # Replica sizing: offered req/s against the modeled throughput
+        # of one replica at the modal rung, 20% headroom, clamped to
+        # the device pool the engine was built over.
+        rate = model.arrival_rate()
+        modal = max(mix, key=mix.get)
+        batch_ms = model.predict_batch_ms(modal, q=0.5)
+        pool = len(engine.replicas)
+        svc_stats = svc.stats()["replicas"]
+        live = sum(
+            1 for i in range(svc_stats["count"])
+            if not svc_stats["retired"][i]
+        )
+        desired = live
+        if batch_ms and batch_ms > 0 and rate > 0:
+            per_replica_rps = max(1, modal) / (batch_ms / 1e3)
+            desired = max(1, min(pool, int(
+                1 + (1.2 * rate) // max(per_replica_rps, 1e-9)
+            )))
+        resized = 0
+        if desired > live:
+            grow = [
+                i for i in range(svc_stats["count"])
+                if svc_stats["retired"][i]
+            ][: desired - live]
+            if grow:
+                svc.unretire_replicas(grow)
+                resized = len(grow)
+        elif desired < live:
+            for i in range(svc_stats["count"] - 1, -1, -1):
+                if live - resized <= desired:
+                    break
+                if not svc_stats["retired"][i] and svc.retire_replica(i):
+                    resized += 1
+        if resized:
+            capacity_counters.bump("replicas_resized")
+        # Ladder re-price from the MIX (not just the shape): keep rungs
+        # the traffic actually arrives at (>= 2% of the mix), always
+        # keep the top candidate rung (oversize coverage), and let the
+        # engine push the survivors back through the HBM planner.
+        base = [int(b) for b in engine.base_ladder]
+        wanted = sorted({
+            b for b in base
+            if mix.get(b, 0.0) >= 0.02 or b == base[-1]
+        })
+        repriced = engine.reprice_ladder(wanted)
+        action = (
+            f"replicas={live}->{live + (resized if desired > live else -resized)};"
+            f"ladder={','.join(str(b) for b in engine.ladder)}"
+        )
+        reason = (
+            f"observed mix shifted {shift:.2f} (TV distance) past "
+            f"{REPLAN_MIX_SHIFT}; modal bucket {modal}, offered "
+            f"{rate:.1f} req/s"
+        )
+        capacity_counters.bump("replans")
+        record_decision(
+            rule="CapacityReplan", node=self.name, action=action,
+            provenance="capacity", reason=reason,
+            cost={
+                "mix_shift": round(shift, 4),
+                "modal_bucket": int(modal),
+                "offered_rps": round(rate, 3),
+                "replicas_resized": resized,
+                "ladder_repriced": bool(repriced),
+            },
+        )
+        self._capacity_last_mix = mix
+        self._last_replan_t = now
+        self._last_replan = {
+            "action": action,
+            "reason": reason,
+            "mix_shift": round(shift, 4),
+            "t_monotonic": now,
+        }
+        # Persistence cadence: each executed re-plan checkpoints the
+        # model through the telemetry segments (bounded queue, never
+        # blocks), so a crash between re-plans loses little learning.
+        model.save(self._telemetry)
+        logger.info(
+            "daemon %s: capacity re-plan — %s (%s)",
+            self.name, action, reason,
+        )
 
     # -- hot swap ------------------------------------------------------------
 
@@ -1679,6 +1994,18 @@ class ServingDaemon:
             # the rolling window; anonymous callers get tenant names
             # collapsed (same redaction contract as the admission table).
             "slo": self._slo.snapshot(redact_tenants=redact_tenants),
+            # The learned capacity model: freshness, per-bucket
+            # predicted-vs-observed p99, guard accounting, and the last
+            # autoscale decision. Tenant arrival rates follow the SLO
+            # redaction contract for anonymous callers.
+            "capacity": (
+                dict(
+                    self._capacity.stats(redact_tenants=redact_tenants),
+                    enabled=True,
+                    last_replan=self._last_replan,
+                )
+                if self._capacity is not None else {"enabled": False}
+            ),
             "telemetry": (
                 self._telemetry.stats()
                 if self._telemetry is not None else None
@@ -1700,6 +2027,9 @@ class ServingDaemon:
             if self._closed:
                 return
             self._closed = True
+        self._replan_stop.set()
+        if self._replan_thread is not None:
+            self._replan_thread.join(timeout=self.CLOSE_JOIN_S)
         self._swap_q.put(None)
         if self._httpd is not None:
             self._httpd.shutdown()
@@ -1753,6 +2083,11 @@ class ServingDaemon:
         # may still be writing.
         tel = self._telemetry
         if tel is not None:
+            # Final capacity snapshot BEFORE the drain: the successor
+            # daemon restores the fitted model from this record instead
+            # of relearning from zero.
+            if self._capacity is not None:
+                self._capacity.save(tel)
             tracer = active_tracer()
             if tracer is not None:
                 tel.spans(tracer)

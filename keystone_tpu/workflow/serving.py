@@ -75,6 +75,7 @@ from keystone_tpu.utils.flight_recorder import FlightRecorder, next_request_id
 from keystone_tpu.utils.metrics import (
     LatencyHistogram,
     active_tracer,
+    capacity_counters,
     metrics_registry,
     reliability_counters,
     serving_counters,
@@ -941,6 +942,55 @@ class CompiledPipeline:
         self.max_batch = kept[-1]
         self._planned = dict(info, enabled=True)
 
+    @property
+    def base_ladder(self) -> Tuple[int, ...]:
+        """The pre-plan candidate rungs: the ladder as resolved at
+        construction, BEFORE HBM planning or capacity re-pricing. Every
+        re-plan (``reprice_ladder``) selects from these, so a rung dropped
+        for today's traffic mix can come back when the mix shifts again."""
+        return tuple(self._base_ladder)
+
+    def reprice_ladder(self, ladder) -> bool:
+        """Re-price the active bucket ladder from a new candidate rung set
+        (the capacity re-plan consumer: the daemon feeds the rungs the
+        OBSERVED traffic mix actually uses, always including the top
+        rung). Candidates route back through the HBM planner
+        (``rules.plan_serve_ladder``) when planning is enabled, then any
+        missing bucket AOT-compiles on every replica before this returns —
+        an in-flight dispatch never sees an unwarmed rung. Old executables
+        are kept: an idle rung costs host memory, not correctness, and a
+        mix that shifts back re-uses them without a recompile. Refuses
+        (returns False) on a pinned ladder, an unwarmed engine, or a no-op
+        candidate set; never touches ``base_ladder``."""
+        wanted = tuple(sorted({int(b) for b in ladder}))
+        if not wanted or wanted[0] <= 0:
+            raise ValueError(
+                f"bucket ladder must be positive ints, got {ladder!r}"
+            )
+        with self._lock:
+            if self._ladder_pinned or self.feature_shape is None:
+                return False
+            if wanted == tuple(self.ladder):
+                return False
+            kept = wanted
+            if config.plan_resources:
+                bpr, provenance = self._bytes_per_row_locked()
+                if bpr is not None:
+                    from keystone_tpu.workflow.rules import plan_serve_ladder
+
+                    kept, _trimmed, info = plan_serve_ladder(
+                        wanted, bpr, len(self.replicas),
+                        provenance=provenance, node=self.name,
+                    )
+                    self._planned = dict(info, enabled=True)
+            self.ladder = tuple(kept)
+            self.max_batch = self.ladder[-1]
+            for r in self.replicas:
+                for b in self.ladder:
+                    if b not in r.executables:
+                        self._compile_bucket_locked(r, b)
+        return True
+
     def _bytes_per_row_locked(self):
         """Per-row resident bytes of one serve call, provenance-laddered
         like every planner price (measured → model): the stored measured
@@ -1248,13 +1298,17 @@ class CompiledPipeline:
 
 class _Request:
     """One accepted request in the micro-batcher: payload + future +
-    deadline, the monotonic request id minted at submit, and the
-    always-on flight-recorder journey record that follows it across the
+    deadline, the monotonic request id minted at submit, the caller's
+    SLA tier (None for direct service users — tier is what makes a
+    request eligible for cross-tenant micro-batching), and the always-on
+    flight-recorder journey record that follows it across the
     dispatcher/replica/completion threads."""
 
-    __slots__ = ("x", "datum", "fut", "deadline", "t_sub", "rid", "rec")
+    __slots__ = ("x", "datum", "fut", "deadline", "t_sub", "rid", "rec",
+                 "tier")
 
-    def __init__(self, x, datum, fut, deadline, t_sub, rid, rec):
+    def __init__(self, x, datum, fut, deadline, t_sub, rid, rec,
+                 tier=None):
         self.x = x
         self.datum = datum
         self.fut = fut
@@ -1262,6 +1316,7 @@ class _Request:
         self.t_sub = t_sub
         self.rid = rid
         self.rec = rec
+        self.tier = tier
 
 
 def _trace_attrs(rec) -> Dict[str, Any]:
@@ -1275,15 +1330,21 @@ def _trace_attrs(rec) -> Dict[str, Any]:
 
 
 class _FlightRec:
-    """A flush group launched on a replica, awaiting completion."""
+    """A flush group launched on a replica, awaiting completion. Carries
+    the bucket it padded onto and its launch stamp so the completion
+    thread can feed launch→materialized device time to the capacity
+    model."""
 
-    __slots__ = ("live", "handle", "t_flush", "rows")
+    __slots__ = ("live", "handle", "t_flush", "rows", "bucket", "t_launch")
 
-    def __init__(self, live, handle, t_flush, rows):
+    def __init__(self, live, handle, t_flush, rows, bucket=None,
+                 t_launch=0):
         self.live = live
         self.handle = handle
         self.t_flush = t_flush
         self.rows = rows
+        self.bucket = bucket
+        self.t_launch = t_launch
 
 
 class PipelineService:
@@ -1351,6 +1412,7 @@ class PipelineService:
         name: Optional[str] = None,
         watchdog_ms: Optional[float] = None,
         flight_dir: Optional[str] = None,
+        capacity=None,
     ):
         if compiled.feature_shape is None:
             raise RuntimeError(
@@ -1383,6 +1445,13 @@ class PipelineService:
         self.name = name or f"svc{next(_service_seq)}"
         self._plan = active_plan()
         self._tracer = active_tracer()  # resolved once per service
+        # The learned capacity model (workflow/capacity.CapacityModel, or
+        # None = every capacity consumer disabled, bit-identical to
+        # PR-19): prices deadline-aware micro-batching in _loop and is
+        # fed per-batch device time from the completion threads. The
+        # DAEMON owns fitting it (journeys, arrivals); the service only
+        # consults and feeds it.
+        self._capacity = capacity
         # Per-SERVICE latency/depth (the process-global registry metrics
         # aggregate every service; two services in one process must not
         # read each other's numbers off their own stats()).
@@ -1499,8 +1568,17 @@ class PipelineService:
 
     # -- client side -------------------------------------------------------
 
+    def queue_depth(self) -> int:
+        """Pending (queued, un-popped) request count — the occupancy input
+        to predicted-deadline admission. Deliberately lock-free: a deque
+        ``len`` is atomic under the GIL, and the consumer (the daemon's
+        admission path) only needs a load estimate, not a linearizable
+        read."""
+        return len(self._pending)
+
     def submit(self, x, deadline_ms: Optional[float] = None,
-               trace_id: Optional[str] = None) -> Future:
+               trace_id: Optional[str] = None,
+               tier: Optional[str] = None) -> Future:
         """Queue one request: a single example (feature-shaped) or a small
         batch (leading row axis). The future resolves to the transformed
         example/batch respectively — or fails with ``QueueFullError``
@@ -1511,7 +1589,12 @@ class PipelineService:
         0/None with a 0 default means no deadline. ``trace_id`` is the
         caller's wire trace context (the daemon threads its journey's id
         through here): noted on this request's journey record and
-        stamped onto every tracer span it produces."""
+        stamped onto every tracer span it produces. ``tier`` is the
+        caller's SLA tier ("gold" / "best_effort"; the daemon threads the
+        admitted tenant's tier): it gates deadline-aware cross-tenant
+        micro-batching — untiered requests (direct service users) neither
+        anchor nor ride a micro-batch, so the pre-capacity batching
+        behavior is preserved bit-identically for them."""
         # lint: ok(KL007) coerces the caller's HOST request payload; no device value is synced
         x = np.asarray(x, dtype=self.compiled.dtype)
         datum = x.shape == self.compiled.feature_shape
@@ -1572,7 +1655,7 @@ class PipelineService:
             if trace_id:
                 rec.note(trace_id=trace_id)
             self._pending.append(
-                _Request(x, datum, fut, deadline, t_sub, rid, rec)
+                _Request(x, datum, fut, deadline, t_sub, rid, rec, tier)
             )
             self.requests += 1
             depth = len(self._pending)
@@ -1722,6 +1805,12 @@ class PipelineService:
                     if remaining <= 0 or self._closed:
                         break
                     self._cv.wait(remaining)
+                if group and self._capacity is not None:
+                    # Deadline-aware cross-tenant micro-batching: fill
+                    # this group's padding slack with best-effort work
+                    # the FIFO scan above skipped past. No-op without a
+                    # capacity model (bit-identical PR-19 batching).
+                    rows = self._microbatch_fill_locked(group, rows)
                 # Gauge updated even when everything popped had expired
                 # (group empty): the queue really did shrink. Either way
                 # the dispatcher made progress — re-arm the stall
@@ -1761,6 +1850,72 @@ class PipelineService:
             # pending while this iteration held the lock (e.g. a
             # deadline storm detected during coalescing).
             self._flight.poll()
+
+    def _microbatch_fill_locked(self, group: list, rows: int) -> int:
+        """Deadline-aware cross-tenant micro-batching (caller holds the
+        lock; the flush group is formed). The group's rows pad up to the
+        bucket rung anyway — filling those pad rows with REAL best-effort
+        work is free device time — so when the group anchors gold-tier
+        work and the capacity model is warm, scan the pending queue PAST
+        the FIFO head for best-effort requests that (a) fit the padding
+        slack and (b) the model predicts still make both their own
+        deadline and the gold group's earliest deadline at the rung's p99
+        device time. The bucket never changes, so the gold group's device
+        call is the same executable on the same shape — gold latency is
+        unchanged by construction, and the model check is the
+        belt-and-braces contract the bench gates. Every coalesce is
+        counted (``capacity.microbatches_formed`` / ``_rows_filled``) and
+        journey-attributed (``microbatched`` meta on the rider's record).
+        Cold model = counted skip, bit-identical batching. Returns the
+        (possibly grown) group row count."""
+        model = self._capacity
+        if not any(rq.tier == "gold" for rq in group):
+            return rows
+        b = bucket_for(rows, getattr(self.compiled, "ladder", ()))
+        if b is None or b <= rows:
+            return rows  # oversize or exact-fit group: no slack to fill
+        if not self._pending:
+            return rows
+        if not model.ready():
+            capacity_counters.bump("model_cold_skips")
+            return rows
+        batch_ms = model.predict_batch_ms(b, q=0.99)
+        if batch_ms is None:
+            capacity_counters.bump("model_cold_skips")
+            return rows
+        now = time.monotonic()
+        eta = now + batch_ms / 1e3
+        gold_deadlines = [
+            rq.deadline for rq in group
+            if rq.tier == "gold" and rq.deadline is not None
+        ]
+        if gold_deadlines and eta > min(gold_deadlines):
+            return rows  # the anchor itself is at risk: don't add riders
+        slack = b - rows
+        filled = 0
+        kept: deque = deque()
+        while self._pending and slack > 0:
+            rq = self._pending.popleft()
+            n = int(rq.x.shape[0])
+            if (
+                rq.tier == "best_effort"
+                and n <= slack
+                and not self._expired(rq)
+                and (rq.deadline is None or eta <= rq.deadline)
+            ):
+                rq.rec.note(microbatched=True, microbatch_bucket=b)
+                group.append(rq)
+                slack -= n
+                filled += n
+            else:
+                kept.append(rq)
+        while kept:  # skipped requests go back, order preserved
+            self._pending.appendleft(kept.pop())
+        if filled:
+            capacity_counters.bump("microbatches_formed")
+            capacity_counters.bump("microbatch_rows_filled", filled)
+            rows += filled
+        return rows
 
     @staticmethod
     def _resolve(fut: Future, value=None, exc=None) -> bool:
@@ -1889,7 +2044,15 @@ class PipelineService:
             b = bucket_for(X.shape[0], getattr(self.compiled, "ladder", ()))
             for rq in live:
                 rq.rec.dispatched(0, b)
+            t_dev = time.perf_counter_ns()
             out = self.compiled(X)
+            if self._capacity is not None and b is not None:
+                # Launch→materialized device time: the per-bucket price
+                # predicted-deadline admission and micro-batching consult.
+                self._capacity.observe_batch(
+                    b, int(X.shape[0]),
+                    (time.perf_counter_ns() - t_dev) / 1e6,
+                )
             # Under the lock even though the serial path has no completer
             # threads: these counters are ALSO bumped from _complete_loop
             # on the pipelined path, and the lock discipline (keystone-lint
@@ -1954,6 +2117,8 @@ class PipelineService:
         handle = None
         t_flush = 0
         rows = 0
+        b = None
+        t_launch = 0
         try:
             # Deadlines re-checked AFTER the slot wait: under overload
             # the window can hold a group long enough to expire it, and
@@ -1972,6 +2137,7 @@ class PipelineService:
                     X, replica=r, window=self.inflight_limit,
                     req_ids=[rq.rid for rq in live],
                 )
+                t_launch = time.perf_counter_ns()
                 b = bucket_for(rows, getattr(self.compiled, "ladder", ()))
                 for rq in live:
                     rq.rec.dispatched(r, b)
@@ -1986,7 +2152,7 @@ class PipelineService:
                 self._inflight = []
                 self._cv.notify_all()
             return
-        rec = _FlightRec(live, handle, t_flush, rows)
+        rec = _FlightRec(live, handle, t_flush, rows, b, t_launch)
         with self._cv:
             if self._dead[r]:
                 # The replica died between the slot pick and this enqueue
@@ -2045,6 +2211,17 @@ class PipelineService:
             except Exception as e:  # lint: broad-ok device failure of any kind becomes the group's futures' exception
                 out = None
                 self._fail_group(rec.live, e, tr)
+            if (
+                out is not None
+                and self._capacity is not None
+                and rec.bucket is not None
+            ):
+                # Launch→materialized device time for the capacity
+                # model's per-bucket price (admission + micro-batching).
+                self._capacity.observe_batch(
+                    rec.bucket, rec.rows,
+                    (time.perf_counter_ns() - rec.t_launch) / 1e6,
+                )
             if out is not None:
                 try:
                     with self._lock:

@@ -301,6 +301,38 @@ def test_serve_telemetry_healthy_rerun_passes(history):
     assert result["ok"], result["regressions"]
 
 
+def test_serve_capacity_family_judged(history):
+    """ISSUE-20: the `make bench-capacity` serve_capacity row gates
+    under the same generic loader — the model-on goodput regressing
+    down, the model-on gold p99 blowing past its band, or the
+    goodput-improved A/B gate flipping true -> false all fail the
+    watch."""
+    def mutate(row):
+        row["goodput_on_per_s"] /= 3.0
+        row["on"]["gold"]["p99_ms"] *= 3.0
+        row["pass"]["goodput_improved"] = False
+
+    _append_serve_row(history, mutate, metric="serve_capacity")
+    result = bench_watch.run(str(history))
+    assert not result["ok"]
+    names = {v["series"] for v in result["regressions"]}
+    assert "serve:serve_capacity:goodput_on_per_s" in names
+    assert "serve:serve_capacity:on.gold.p99_ms" in names
+    assert "serve:serve_capacity:pass.goodput_improved" in names
+
+
+def test_serve_capacity_healthy_rerun_passes(history):
+    """A same-fingerprint re-run inside the noise band gates green."""
+    def mutate(row):
+        row["goodput_on_per_s"] *= 1.03
+        row["goodput_off_per_s"] *= 0.98
+        row["on"]["gold"]["p99_ms"] *= 1.05
+
+    _append_serve_row(history, mutate, metric="serve_capacity")
+    result = bench_watch.run(str(history))
+    assert result["ok"], result["regressions"]
+
+
 def test_online_family_loaded_and_regression_flagged(history):
     """ISSUE-15: the `make bench-online` fit_online row gates under the
     same generic loader — the re-solve speedup regressing down, the

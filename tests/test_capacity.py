@@ -1,0 +1,688 @@
+"""Learned serving-capacity model (ISSUE 20): prediction oracle,
+predicted-deadline admission on both wires, deadline-aware cross-tenant
+micro-batching, traffic-aware autoscaling, and the cold/persistence
+contracts.
+
+Acceptance pins:
+
+- **Prediction oracle**: ``predict_completion_ms`` against hand-computed
+  values — effective flush bucket (``eff = min(max_rows, rows + depth)``,
+  ``b = max(bucket, eff)``), nearest-rung row-ratio scaling, the
+  observed rows-per-flush drain rate, the ``ADMIT_Q`` quantile, and the
+  signed prediction-bias EWMA.
+- **Refusal wire contract**: a warm model that predicts past the
+  caller's deadline answers a counted 429 ``predicted_infeasible``
+  BEFORE any device work, on the framed socket AND the HTTP wire, with
+  the caller's trace id echoed; the refused admission slot is released.
+- **Strict-accuracy guard**: refusals re-validate once the evidence
+  doubles (the ``check_at`` watermark), at the recorded effective bucket
+  and the refusal-time bias — a refusal the matured model calls feasible
+  is a counted violation; a consistent one is not.
+- **Cold = bit-identical no-op**: below ``min_samples`` every consumer
+  no-ops (counted); ``KEYSTONE_CAPACITY_MODEL=0`` builds no model at all
+  and /stats reports ``{"enabled": False}``.
+- **Micro-batching**: riders fill a gold group's padding slack only when
+  tier, slack, and both deadlines allow; skipped requests keep FIFO
+  order; everything is counted and journey-attributed.
+- **Autoscale re-plan**: a mix shift past the threshold executes and
+  decision-logs a re-plan; a second shift inside the no-flap window is
+  refused and counted.
+- **Persistence**: snapshot/restore round-trips (fill and bias
+  included); corrupt snapshots are refused untouched; the telemetry-dir
+  loader prefers the newest snapshot and falls back to journey replay.
+"""
+
+import json
+import math
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from keystone_tpu.config import config, resolved_capacity_model
+from keystone_tpu.utils.metrics import capacity_counters
+from keystone_tpu.workflow.capacity import (
+    ADMIT_Q,
+    CapacityModel,
+    load_capacity_model,
+)
+from keystone_tpu.workflow.daemon import ServingDaemon, Tenant
+from keystone_tpu.workflow.serialization import save_artifact
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+D = 6
+
+
+@pytest.fixture(autouse=True)
+def capacity_env(monkeypatch):
+    """Isolate the capacity knobs and counters per test: model off by
+    default (tests opt in), fast warmup, counters reset both sides."""
+    monkeypatch.delenv("KEYSTONE_CAPACITY_MODEL", raising=False)
+    monkeypatch.delenv("KEYSTONE_TELEMETRY_DIR", raising=False)
+    prior = (config.capacity_min_samples, config.capacity_replan_s,
+             config.telemetry_dir)
+    config.telemetry_dir = None
+    capacity_counters.reset()
+    yield
+    (config.capacity_min_samples, config.capacity_replan_s,
+     config.telemetry_dir) = prior
+    capacity_counters.reset()
+
+
+def _serve_daemon_mod():
+    sys.path.insert(0, TOOLS)
+    try:
+        import serve_daemon
+    finally:
+        sys.path.pop(0)
+    return serve_daemon
+
+
+def _build_pipeline(seed=0):
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+    from keystone_tpu.nodes.stats.random_features import CosineRandomFeatures
+
+    return (
+        CosineRandomFeatures.create(D, 12, seed=seed)
+        .and_then(L2Normalizer())
+        .fit()
+    )
+
+
+def _save(tmp_path, tag="v1"):
+    pipe = _build_pipeline()
+    path = str(tmp_path / f"model_{tag}.kart")
+    save_artifact(pipe, path, feature_shape=(D,), dtype="float32")
+    return path
+
+
+def _warm(model, n=None, tier="best_effort", bucket=4, service_ms=5.0):
+    """Observe ``n`` (default min_samples) journeys so the model turns
+    ready."""
+    n = model.min_samples if n is None else n
+    for _ in range(n):
+        model.observe_journey(tier, "t", 1, bucket, service_ms)
+
+
+# ---------------------------------------------------------------------------
+# Prediction oracle (unit, no daemon)
+# ---------------------------------------------------------------------------
+
+
+def test_cold_model_predicts_none_and_not_ready():
+    m = CapacityModel("t", min_samples=4)
+    assert not m.ready()
+    assert m.predict_completion_ms("gold", 1, 0, 4) is None
+    assert m.predict_batch_ms(4) is None
+    _warm(m, 4)
+    assert m.ready()
+
+
+def test_prediction_oracle_effective_bucket_and_quantile():
+    m = CapacityModel("t", min_samples=4)
+    _warm(m, 4)
+    for v in (10.0, 20.0, 30.0, 40.0):
+        m.observe_batch(4, 4, v)
+    # Nearest-rank ADMIT_Q over [10, 20, 30, 40]: index
+    # ceil(0.75 * 4) - 1 = 2 -> 30.0. Full flushes: fill = max_rows.
+    q = math.ceil(ADMIT_Q * 4) - 1
+    assert q == 2
+    pred = m.predict_completion_ms("best_effort", 4, 0, 4, bucket=4)
+    assert pred["batch_ms"] == pytest.approx(30.0)
+    assert pred["batches_ahead"] == 1
+    assert pred["bias_ms"] == 0.0
+    assert pred["predicted_ms"] == pytest.approx(30.0)
+
+    # Effective flush bucket: a 1-row request at queue depth 6 flushes
+    # as part of a FULL bucket (eff = min(4, 1 + 6) = 4), never at the
+    # solo rung — and two more flushes drain ahead of it.
+    pred = m.predict_completion_ms("best_effort", 1, 6, 4, bucket=1)
+    assert pred["bucket"] == 4
+    assert pred["batch_ms"] == pytest.approx(30.0)
+    assert pred["batches_ahead"] == 1 + 6 // 4
+    assert pred["predicted_ms"] == pytest.approx(2 * 30.0)
+
+    # Unobserved rung: nearest observed rung scaled by the row ratio
+    # (row-linear pricing) — bucket 2 from the bucket-4 ring.
+    pred = m.predict_completion_ms("best_effort", 2, 0, 4, bucket=2)
+    assert pred["bucket"] == 2
+    assert pred["batch_ms"] == pytest.approx(30.0 * 2 / 4)
+
+
+def test_prediction_uses_observed_fill_as_drain_rate():
+    m = CapacityModel("t", min_samples=4)
+    _warm(m, 4)
+    for _ in range(8):
+        m.observe_batch(4, 4, 10.0)
+    full = m.predict_completion_ms("best_effort", 1, 8, 4, bucket=1)
+    assert full["batches_ahead"] == 1 + 8 // 4  # fill == max_rows
+    # Partial flushes observed: the queue drains SLOWER than perfect
+    # packing, so the same depth now prices more batches ahead.
+    for _ in range(40):
+        m.observe_batch(4, 1, 10.0)
+    fill = m.stats()["fill_rows"]
+    assert 1.0 <= fill < 2.0
+    part = m.predict_completion_ms("best_effort", 1, 8, 4, bucket=1)
+    assert part["batches_ahead"] == 1 + int(8 / fill)
+    assert part["batches_ahead"] > full["batches_ahead"]
+    assert part["predicted_ms"] > full["predicted_ms"]
+
+
+def test_prediction_bias_feedback_corrects_underestimates():
+    m = CapacityModel("t", min_samples=4)
+    _warm(m, 4)
+    for _ in range(8):
+        m.observe_batch(4, 4, 10.0)
+    base = m.predict_completion_ms("best_effort", 4, 0, 4, bucket=4)
+    assert base["bias_ms"] == 0.0
+    # Realized journeys keep coming in 6ms past their prediction: the
+    # bias EWMA feeds the systematic error straight back.
+    for _ in range(64):
+        m.observe_journey("best_effort", "t", 4, 4, 16.0, predicted_ms=10.0)
+    stats = m.stats()
+    assert stats["bias_ms"] == pytest.approx(6.0, abs=0.5)
+    pred = m.predict_completion_ms("best_effort", 4, 0, 4, bucket=4)
+    assert pred["bias_ms"] == pytest.approx(stats["bias_ms"])
+    assert pred["predicted_ms"] == pytest.approx(
+        base["predicted_ms"] + pred["bias_ms"]
+    )
+
+
+def test_mix_shift_is_total_variation_distance():
+    a = {1: 0.5, 4: 0.5}
+    assert CapacityModel.mix_shift(a, a) == pytest.approx(0.0)
+    assert CapacityModel.mix_shift(
+        {1: 1.0}, {4: 1.0}
+    ) == pytest.approx(1.0)
+    assert CapacityModel.mix_shift(
+        {1: 0.5, 4: 0.5}, {1: 1.0}
+    ) == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# Strict-accuracy guard
+# ---------------------------------------------------------------------------
+
+
+def test_guard_watermark_validates_once_evidence_doubles():
+    m = CapacityModel("t", min_samples=4)
+    _warm(m, 4)  # samples = 4
+    for _ in range(8):
+        m.observe_batch(4, 4, 1.0)  # cheap batches: 1ms at every rung
+    # A refusal the model should NEVER have made (predicted 200ms
+    # against a 100ms deadline while batches cost 1ms): check_at =
+    # max(4 + 4, 4 * 2) = 8 observations.
+    m.note_refusal("best_effort", 1, 0, 4, 100.0, 200.0,
+                   trace_id="g1", bucket=4)
+    stats = m.stats()
+    assert stats["refusals"] == 1 and stats["guard_checked"] == 0
+    _warm(m, 3)  # samples = 7: still below the watermark
+    assert m.stats()["guard_checked"] == 0
+    _warm(m, 1)  # samples = 8: validation fires
+    stats = m.stats()
+    assert stats["guard_checked"] == 1
+    assert stats["guard_violations"] == 1
+
+
+def test_guard_accepts_consistent_refusal_and_frozen_bias():
+    m = CapacityModel("t", min_samples=4)
+    _warm(m, 4)
+    for _ in range(8):
+        m.observe_batch(4, 4, 50.0)
+    # Consistent refusal: 50ms batch against a 10ms deadline stays
+    # infeasible under the matured model — checked, no violation.
+    m.note_refusal("best_effort", 1, 0, 4, 10.0, 50.0, bucket=4)
+    _warm(m, 4)
+    stats = m.stats()
+    assert stats["guard_checked"] == 1 and stats["guard_violations"] == 0
+
+    # Refusal-time bias is FROZEN in the record: drive the live bias up,
+    # refuse at a deadline only the biased estimate breaches, then let
+    # the live bias decay to zero before validation. Re-validating with
+    # the live bias would flag it; the frozen bias must not.
+    for _ in range(64):
+        m.observe_journey("best_effort", "t", 4, 4, 80.0, predicted_ms=50.0)
+    biased = m.stats()["bias_ms"]
+    assert biased == pytest.approx(30.0, abs=2.0)
+    samples = m.stats()["samples"]
+    m.note_refusal("best_effort", 1, 0, 4, 60.0, 50.0 + biased, bucket=4)
+    for _ in range(samples + 8):  # decay bias, cross the watermark
+        m.observe_journey("best_effort", "t", 4, 4, 50.0, predicted_ms=50.0)
+    stats = m.stats()
+    assert abs(stats["bias_ms"]) < 1.0  # live bias decayed
+    assert stats["guard_checked"] == 2
+    assert stats["guard_violations"] == 0  # frozen 30ms bias held
+
+
+# ---------------------------------------------------------------------------
+# Persistence
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_restore_roundtrip_carries_fill_and_bias():
+    m = CapacityModel("t", min_samples=4)
+    _warm(m, 6)
+    for v in (10.0, 20.0, 30.0, 40.0):
+        m.observe_batch(4, 3, v)
+    for _ in range(8):
+        m.observe_journey("gold", "t", 4, 4, 20.0, predicted_ms=15.0)
+    snap = m.snapshot()
+    m2 = CapacityModel("t2", min_samples=4)
+    assert m2.restore(snap)
+    s1, s2 = m.stats(), m2.stats()
+    assert s2["samples"] == s1["samples"]
+    assert s2["fill_rows"] == pytest.approx(s1["fill_rows"])
+    assert s2["bias_ms"] == pytest.approx(s1["bias_ms"])
+    assert s2["batch_ms"] == s1["batch_ms"]
+    p1 = m.predict_completion_ms("gold", 1, 5, 4, bucket=1)
+    p2 = m2.predict_completion_ms("gold", 1, 5, 4, bucket=1)
+    assert p2 == p1
+    # Corrupt snapshots are refused with state untouched.
+    m3 = CapacityModel("t3", min_samples=4)
+    assert not m3.restore({"schema": 999})
+    assert not m3.restore({"schema": snap["schema"], "samples": "nope"})
+    assert m3.samples() == 0
+
+
+def test_load_capacity_model_snapshot_wins_then_journey_replay(tmp_path):
+    m = CapacityModel("alpha", min_samples=4)
+    _warm(m, 10)
+    m.observe_batch(4, 4, 25.0)
+    seg = tmp_path / "keystone_telemetry_0001.jsonl"
+    journey = {
+        "id": 1, "rows": 2, "bucket": 2, "replicas": 1,
+        "phases": [{"phase": "submitted", "t_ns": 0},
+                   {"phase": "resolved", "t_ns": int(7e6)}],
+        "outcome": "ok", "meta": {"tier": "gold", "tenant": "g"},
+    }
+    with open(seg, "w") as f:
+        f.write("this line is torn\n")
+        f.write(json.dumps({"kind": "journey", "service": "daemon-alpha",
+                            "journey": journey}) + "\n")
+        f.write(json.dumps({"kind": "capacity", "service": "daemon-alpha",
+                            "pid": 1, "model": m.snapshot()}) + "\n")
+        f.write(json.dumps({"kind": "capacity", "service": "daemon-other",
+                            "pid": 1, "model": {"schema": -5}}) + "\n")
+    # Snapshot wins over replay; other services' records are ignored.
+    loaded = load_capacity_model(str(tmp_path), "alpha", min_samples=4)
+    assert loaded.samples() == m.samples()
+    assert loaded.stats()["batch_ms"] == m.stats()["batch_ms"]
+    # No snapshot for this daemon: journeys replay instead.
+    with open(seg, "a") as f:
+        f.write(json.dumps({"kind": "journey", "service": "daemon-beta",
+                            "journey": journey}) + "\n")
+    replayed = load_capacity_model(str(tmp_path), "beta", min_samples=1)
+    assert replayed.samples() == 1
+    per = replayed.stats()["per_bucket"]
+    assert per["gold:2"]["observed_p50_ms"] == pytest.approx(7.0)
+    # Missing/empty directory: a cold model, not an error.
+    assert load_capacity_model(None, "x").samples() == 0
+    assert load_capacity_model(str(tmp_path / "nope"), "x").samples() == 0
+
+
+# ---------------------------------------------------------------------------
+# Config resolution
+# ---------------------------------------------------------------------------
+
+
+def test_resolved_capacity_model_resolution_order(monkeypatch, tmp_path):
+    # Unset env + no telemetry dir: off.
+    assert resolved_capacity_model() is False
+    # Telemetry dir configured: defaults ON (the model persists through
+    # those segments; without them it would relearn every restart).
+    config.telemetry_dir = str(tmp_path)
+    assert resolved_capacity_model() is True
+    # An exported env wins outright, both directions.
+    monkeypatch.setenv("KEYSTONE_CAPACITY_MODEL", "0")
+    assert resolved_capacity_model() is False
+    monkeypatch.setenv("KEYSTONE_CAPACITY_MODEL", "1")
+    config.telemetry_dir = None
+    assert resolved_capacity_model() is True
+
+
+# ---------------------------------------------------------------------------
+# Predicted-deadline admission on both wires (live daemon)
+# ---------------------------------------------------------------------------
+
+
+def _capacity_daemon(tmp_path, monkeypatch, min_samples=8, **kw):
+    monkeypatch.setenv("KEYSTONE_CAPACITY_MODEL", "1")
+    config.capacity_min_samples = min_samples
+    art = _save(tmp_path)
+    kw.setdefault("tenants", {
+        "k-gold": Tenant("gold", "k-gold", qps=0, tier="gold"),
+        "k-be": Tenant("be", "k-be", qps=0, tier="best_effort"),
+    })
+    return ServingDaemon(
+        artifact=art, devices=1, buckets=(4,), name="t-capacity",
+        flight_dir=str(tmp_path), **kw,
+    )
+
+
+def _force_infeasible(daemon, batch_ms=60000.0):
+    """Warm the daemon's model with absurdly slow observed batches so
+    ANY finite deadline is predicted infeasible."""
+    model = daemon._capacity
+    assert model is not None
+    _warm(model)
+    for _ in range(8):
+        model.observe_batch(4, 4, batch_ms)
+
+
+def test_refusal_counted_and_trace_echoed_on_both_wires(
+        tmp_path, monkeypatch):
+    sd = _serve_daemon_mod()
+    x = [[1.0] * D]
+    with _capacity_daemon(tmp_path, monkeypatch) as daemon:
+        _force_infeasible(daemon)
+        before = capacity_counters.snapshot().get("predicted_refusals", 0)
+
+        # Framed socket: explicit deadline, caller trace adopted.
+        sc = sd.SocketClient(daemon.socket_port)
+        try:
+            resp = sc.request({"x": x, "key": "k-be", "deadline_ms": 50.0,
+                               "trace_id": "cap.sock-1"})
+        finally:
+            sc.close()
+        assert resp["status"] == 429
+        assert resp["error"] == "predicted_infeasible"
+        assert resp["trace_id"] == "cap.sock-1"
+
+        # HTTP wire: same contract — trace via header, key + deadline in
+        # the body (the body-key path: a header key pre-admits before
+        # the body — and thus the deadline — is even read).
+        status, doc = sd.http_post(
+            daemon.http_port, "/predict",
+            {"x": x, "key": "k-be", "deadline_ms": 50.0},
+            {"X-Trace-Id": "cap.http-1"},
+        )
+        assert status == 429
+        assert doc["error"] == "predicted_infeasible"
+        assert doc["trace_id"] == "cap.http-1"
+
+        after = capacity_counters.snapshot()["predicted_refusals"]
+        assert after - before == 2
+        assert daemon._capacity.stats()["refusals"] == 2
+
+        # The refused slot was released: an undeadlined request on the
+        # same tenant still serves (prediction never breaches "none").
+        sc = sd.SocketClient(daemon.socket_port)
+        try:
+            ok = sc.request({"x": x, "key": "k-be"})
+        finally:
+            sc.close()
+        assert ok["status"] == 200
+
+        # finish_request runs AFTER the response write: settle before
+        # reading the journeys (the test_daemon _settle contract).
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            snap = daemon._flight.snapshot()
+            if daemon.stats()["active_requests"] == 0 and all(
+                r["outcome"] is not None for r in snap["records"]
+            ):
+                break
+            time.sleep(0.01)
+        assert daemon.stats()["active_requests"] == 0
+
+        # The refused journeys closed as predicted_infeasible — never
+        # admitted, never submitted (no device work).
+        refused = [r for r in snap["records"]
+                   if r["outcome"] == "predicted_infeasible"]
+        assert len(refused) == 2
+        for r in refused:
+            phases = {p["phase"] for p in r["phases"]}
+            assert "admitted" not in phases
+            assert "submitted" not in phases
+
+
+def test_cold_model_is_counted_noop_and_off_builds_none(
+        tmp_path, monkeypatch):
+    sd = _serve_daemon_mod()
+    x = [[1.0] * D]
+    # Cold (enabled but below min_samples): tight deadlines still serve,
+    # the skip is counted, nothing is refused.
+    with _capacity_daemon(tmp_path, monkeypatch, min_samples=10_000) as d:
+        before = capacity_counters.snapshot().get("model_cold_skips", 0)
+        sc = sd.SocketClient(d.socket_port)
+        try:
+            resp = sc.request({"x": x, "key": "k-be", "deadline_ms": 30000.0})
+        finally:
+            sc.close()
+        assert resp["status"] == 200
+        assert capacity_counters.snapshot()["model_cold_skips"] > before
+        assert d.stats()["capacity"]["enabled"] is True
+        assert d.stats()["capacity"]["ready"] is False
+        assert d.stats()["capacity"]["refusals"] == 0
+    # KEYSTONE_CAPACITY_MODEL=0: no model object at all — the PR-19
+    # daemon, bit-identically — and /stats says so.
+    monkeypatch.setenv("KEYSTONE_CAPACITY_MODEL", "0")
+    art = _save(tmp_path, "off")
+    with ServingDaemon(artifact=art, devices=1, buckets=(4,),
+                      name="t-cap-off", flight_dir=str(tmp_path)) as d:
+        assert d._capacity is None
+        assert d.stats()["capacity"] == {"enabled": False}
+        sc = sd.SocketClient(d.socket_port)
+        try:
+            resp = sc.request({"x": x, "deadline_ms": 30000.0})
+        finally:
+            sc.close()
+        assert resp["status"] == 200
+
+
+# ---------------------------------------------------------------------------
+# Traffic-aware autoscaling
+# ---------------------------------------------------------------------------
+
+
+def test_autoscale_replan_executes_then_no_flap_suppresses(
+        tmp_path, monkeypatch):
+    config.capacity_replan_s = 30.0  # no-flap window: 60s — never expires
+    with _capacity_daemon(tmp_path, monkeypatch, min_samples=8) as daemon:
+        model = daemon._capacity
+        _warm(model, 20, bucket=1, service_ms=5.0)
+        for _ in range(8):
+            model.observe_batch(1, 1, 2.0)
+            model.observe_batch(4, 4, 5.0)
+        model.observe_arrival("be", now=0.0)
+        model.observe_arrival("be", now=0.01)
+
+        daemon._maybe_replan()  # first warm tick: baselines the mix
+        assert capacity_counters.snapshot().get("replans", 0) == 0
+        assert daemon.stats()["capacity"]["last_replan"] is None
+
+        _warm(model, 200, bucket=4, service_ms=5.0)  # the shift
+        daemon._maybe_replan()
+        snap = capacity_counters.snapshot()
+        assert snap["replans"] == 1
+        last = daemon.stats()["capacity"]["last_replan"]
+        assert last is not None
+        assert last["mix_shift"] >= 0.25
+        assert "replicas=" in last["action"]
+
+        _warm(model, 200, bucket=1, service_ms=5.0)  # shift straight back
+        daemon._maybe_replan()
+        snap = capacity_counters.snapshot()
+        assert snap["replans"] == 1  # not executed again...
+        assert snap["replans_suppressed"] == 1  # ...refused and counted
+        # Decision-logged, both ways.
+        from keystone_tpu.workflow.rules import optimizer_decisions
+
+        acts = [d for d in optimizer_decisions()
+                if d.rule == "CapacityReplan"]
+        assert {a.action for a in acts} >= {last["action"], "suppress"}
+
+
+def test_replan_noops_cold_and_small_shift(tmp_path, monkeypatch):
+    config.capacity_replan_s = 30.0
+    with _capacity_daemon(tmp_path, monkeypatch, min_samples=50) as daemon:
+        model = daemon._capacity
+        _warm(model, 10)  # still cold
+        before = capacity_counters.snapshot().get("model_cold_skips", 0)
+        daemon._maybe_replan()
+        assert capacity_counters.snapshot()["model_cold_skips"] == before + 1
+        _warm(model, 40)  # warm now; baseline then barely-shifted mix
+        daemon._maybe_replan()
+        _warm(model, 2, bucket=1)
+        daemon._maybe_replan()
+        snap = capacity_counters.snapshot()
+        assert snap.get("replans", 0) == 0
+        assert snap.get("replans_suppressed", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# Deadline-aware cross-tenant micro-batching
+# ---------------------------------------------------------------------------
+
+
+class _Rec:
+    """Duck-typed journey record for white-box micro-batch tests (note
+    for attribution, finish for the service's close() sweep)."""
+
+    def __init__(self):
+        self.meta = {}
+
+    def note(self, **kw):
+        self.meta.update(kw)
+
+    def finish(self, *a, **kw):
+        pass
+
+    def stamp(self, *a, **kw):
+        pass
+
+
+def _mk_req(rows, tier, deadline_s=None):
+    from concurrent.futures import Future
+
+    from keystone_tpu.workflow.serving import _Request
+
+    return _Request(
+        x=np.zeros((rows, D), np.float32), datum=False, fut=Future(),
+        deadline=(time.monotonic() + deadline_s
+                  if deadline_s is not None else None),
+        t_sub=time.perf_counter_ns(), rid=0, rec=_Rec(), tier=tier,
+    )
+
+
+@pytest.fixture
+def svc(tmp_path):
+    """A real PipelineService (loop parked: we drive the fill helper
+    directly under its own lock discipline) with no capacity model."""
+    from keystone_tpu.workflow import CompiledPipeline
+    from keystone_tpu.workflow.serving import PipelineService
+
+    cp = CompiledPipeline(_build_pipeline(), max_batch=4).warmup((D,))
+    s = PipelineService(cp, max_rows=4, name="t-microbatch")
+    yield s
+    s.close(drain=False)
+
+
+def test_microbatch_fills_gold_slack_deadline_aware(svc):
+    model = CapacityModel("t", min_samples=4)
+    _warm(model, 4)
+    for _ in range(8):
+        model.observe_batch(4, 4, 2.0)  # rung p99: 2ms
+    svc._capacity = model
+    gold = _mk_req(3, "gold", deadline_s=10.0)
+    tight = _mk_req(1, "best_effort", deadline_s=0.0001)  # can't survive
+    big = _mk_req(2, "best_effort", deadline_s=10.0)      # over slack
+    untiered = _mk_req(1, None, deadline_s=10.0)
+    rider = _mk_req(1, "best_effort", deadline_s=10.0)
+    group = [gold]
+    svc._pending.extend([tight, big, untiered, rider])
+    rows = svc._microbatch_fill_locked(group, 3)
+    # Only the eligible best-effort rider rode the 1-row padding slack.
+    assert rows == 4
+    assert group == [gold, rider]
+    assert rider.rec.meta["microbatched"] is True
+    assert rider.rec.meta["microbatch_bucket"] == 4
+    # Skipped requests kept their FIFO order.
+    assert list(svc._pending) == [tight, big, untiered]
+    snap = capacity_counters.snapshot()
+    assert snap["microbatches_formed"] == 1
+    assert snap["microbatch_rows_filled"] == 1
+
+
+def test_microbatch_noops_without_anchor_slack_or_warm_model(svc):
+    rider = _mk_req(1, "best_effort", deadline_s=10.0)
+    # No capacity model: the _loop gate never calls the fill helper —
+    # the PR-19 path. The helper itself is also anchor-gated:
+    svc._capacity = CapacityModel("t", min_samples=4)  # cold
+    group_be = [_mk_req(3, "best_effort", deadline_s=10.0)]
+    svc._pending.append(rider)
+    assert svc._microbatch_fill_locked(group_be, 3) == 3  # no gold anchor
+    assert list(svc._pending) == [rider]
+
+    gold_group = [_mk_req(3, "gold", deadline_s=10.0)]
+    before = capacity_counters.snapshot().get("model_cold_skips", 0)
+    assert svc._microbatch_fill_locked(gold_group, 3) == 3  # cold model
+    assert capacity_counters.snapshot()["model_cold_skips"] == before + 1
+    assert len(gold_group) == 1 and list(svc._pending) == [rider]
+
+    # Exact-fit group: no padding slack to fill.
+    model = CapacityModel("t", min_samples=4)
+    _warm(model, 4)
+    for _ in range(4):
+        model.observe_batch(4, 4, 2.0)
+    svc._capacity = model
+    full_group = [_mk_req(4, "gold", deadline_s=10.0)]
+    assert svc._microbatch_fill_locked(full_group, 4) == 4
+    assert list(svc._pending) == [rider]
+
+
+def test_microbatch_protects_gold_anchor_deadline(svc):
+    model = CapacityModel("t", min_samples=4)
+    _warm(model, 4)
+    for _ in range(8):
+        model.observe_batch(4, 4, 50.0)  # rung p99: 50ms
+    svc._capacity = model
+    # The anchor's own deadline is inside the modeled batch tail: adding
+    # riders is forbidden outright.
+    gold = _mk_req(3, "gold", deadline_s=0.005)
+    rider = _mk_req(1, "best_effort", deadline_s=10.0)
+    svc._pending.append(rider)
+    assert svc._microbatch_fill_locked([gold], 3) == 3
+    assert list(svc._pending) == [rider]
+
+
+# ---------------------------------------------------------------------------
+# Lint registration
+# ---------------------------------------------------------------------------
+
+
+def test_replan_thread_registered_in_keystone_lint():
+    sys.path.insert(0, TOOLS)
+    try:
+        import keystone_lint
+    finally:
+        sys.path.pop(0)
+    assert "_replan_loop" in keystone_lint.KNOWN_THREAD_TARGETS
+
+
+def test_kg108_flags_pinned_resources_under_enabled_model(monkeypatch):
+    from keystone_tpu.nodes.stats.normalizer import L2Normalizer
+
+    p = _build_pipeline()
+    prior = (config.serve_buckets, config.serve_devices)
+    try:
+        monkeypatch.setenv("KEYSTONE_CAPACITY_MODEL", "1")
+        config.serve_buckets = (4, 8)
+        report = p.lint()
+        hits = report.by_rule("KG108")
+        assert hits and hits[0].severity == "warning"
+        assert "hand-pinned" in hits[0].message
+        # Un-pinned defaults are the healthy configuration, not a finding.
+        config.serve_buckets = ()
+        config.serve_devices = 0
+        assert not p.lint().by_rule("KG108")
+        # Model off: pins are fine (nothing is being defeated).
+        monkeypatch.setenv("KEYSTONE_CAPACITY_MODEL", "0")
+        config.serve_buckets = (4, 8)
+        assert not p.lint().by_rule("KG108")
+    finally:
+        config.serve_buckets, config.serve_devices = prior

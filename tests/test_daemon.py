@@ -40,6 +40,7 @@ from keystone_tpu.utils.reliability import (
     QuotaExceeded,
     ServiceClosed,
     SwapAborted,
+    active_plan,
 )
 from keystone_tpu.workflow.daemon import (
     BE_BUDGET_FRAC,
@@ -809,7 +810,16 @@ def test_daemon_stats_slo_latency_and_metrics_gauges(tmp_path, monkeypatch):
             # Anonymous caller: tenant keys collapsed to "*".
             assert "acme-corp" not in json.dumps(slo)
             entry = slo["tenants"]["*"]["gold"]
-            assert entry["total"] == 3 and entry["good"] == 3
+            plan = active_plan()
+            if plan is not None and "conn_drop" in plan.sites:
+                # Chaos runs: an injected conn_drop loses only the
+                # response; the shipped retrying client re-sends, so
+                # each drop adds one more (good) serve to the
+                # denominator. The exact-count pin holds clean runs.
+                assert entry["total"] >= 3
+                assert entry["good"] == entry["total"]
+            else:
+                assert entry["total"] == 3 and entry["good"] == 3
             assert entry["hit_rate"] == 1.0 and entry["burn"] == 0.0
             # Per-tier latency percentiles ride /stats next to the SLO.
             lat = stats["latency"]["gold"]

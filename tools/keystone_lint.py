@@ -92,7 +92,11 @@ KNOWN_THREAD_TARGETS = {"_watchdog_loop", "_watch_loop", "_solve_watch_loop",
                         # journey-export writer (drains the bounded
                         # queue to rotated JSONL segments off the hot
                         # path).
-                        "_writer_loop"}
+                        "_writer_loop",
+                        # workflow/daemon.py ServingDaemon: the capacity
+                        # re-plan worker (traffic-aware autoscaling off
+                        # the learned capacity model).
+                        "_replan_loop"}
 HOST_SYNC_CALLS = {"block_until_ready", "device_get", "asarray", "array"}
 
 #: Mutating method names treated as writes for KL001 (deque/list/set/dict
